@@ -1,0 +1,27 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU platform.
+
+Multi-chip TPU hardware is not available in CI; sharding tests run on an
+8-device CPU mesh (mirrors the reference's approach of simulating
+multi-node topologies in-process, /root/reference/forward_test.go:18-60).
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.  Force-assign (not
+# setdefault): the dev environment presets JAX_PLATFORMS to the real TPU
+# backend, but the suite needs the virtual 8-device CPU topology.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()

@@ -1,0 +1,95 @@
+"""HLL kernel tests: estimate accuracy, union semantics, merge-rows —
+mirrors reference samplers set tests (samplers/samplers_test.go) and the
+~0.81% std-error bound of p=14 (hyperloglog.go:32-40)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from veneur_tpu.ops import hll
+from veneur_tpu.utils import hashing
+
+
+def _insert_members(regs, row, members):
+    idx, rank = hashing.hash_members(members)
+    n = len(members)
+    rows = jnp.full((n,), row, dtype=jnp.int32)
+    return hll.insert(regs, rows, jnp.asarray(idx), jnp.asarray(rank))
+
+
+@pytest.mark.parametrize("n", [100, 10_000, 200_000])
+def test_estimate_within_error_bound(n):
+    regs = hll.empty_state(1)
+    members = [f"member-{i}".encode() for i in range(n)]
+    regs = _insert_members(regs, 0, members)
+    est = float(hll.estimate(regs)[0])
+    # p=14 std err ~0.81%; allow 4 sigma plus small-n slack
+    assert abs(est - n) / n < 0.04
+
+
+def test_duplicates_do_not_inflate():
+    regs = hll.empty_state(1)
+    members = [f"m{i % 50}".encode() for i in range(5000)]
+    regs = _insert_members(regs, 0, members)
+    est = float(hll.estimate(regs)[0])
+    assert abs(est - 50) / 50 < 0.1
+
+
+def test_union_equals_combined_insert():
+    a = hll.empty_state(1)
+    b = hll.empty_state(1)
+    both = hll.empty_state(1)
+    ma = [f"a{i}".encode() for i in range(5000)]
+    mb = [f"b{i}".encode() for i in range(5000)]
+    a = _insert_members(a, 0, ma)
+    b = _insert_members(b, 0, mb)
+    both = _insert_members(both, 0, ma + mb)
+    np.testing.assert_array_equal(np.asarray(hll.union(a, b)),
+                                  np.asarray(both))
+
+
+def test_merge_rows_matches_union():
+    regs = hll.empty_state(2)
+    regs = _insert_members(regs, 0, [b"x1", b"x2", b"x3"])
+    other = hll.empty_state(1)
+    other = _insert_members(other, 0, [b"x3", b"x4"])
+    merged = hll.merge_rows(regs, jnp.array([0], dtype=jnp.int32),
+                            other)
+    expect = hll.empty_state(1)
+    expect = _insert_members(expect, 0, [b"x1", b"x2", b"x3", b"x4"])
+    np.testing.assert_array_equal(np.asarray(merged[0]),
+                                  np.asarray(expect[0]))
+    # row 1 untouched
+    assert int(np.asarray(merged[1]).max()) == 0
+
+
+def test_multi_row_batched_insert():
+    regs = hll.empty_state(4)
+    members, rows = [], []
+    for r in range(4):
+        for i in range((r + 1) * 1000):
+            members.append(f"r{r}-{i}".encode())
+            rows.append(r)
+    idx, rank = hashing.hash_members(members)
+    regs = hll.insert(regs, jnp.asarray(np.array(rows, np.int32)),
+                      jnp.asarray(idx), jnp.asarray(rank))
+    ests = np.asarray(hll.estimate(regs))
+    for r in range(4):
+        true = (r + 1) * 1000
+        assert abs(ests[r] - true) / true < 0.05
+
+
+def test_hash64_no_trivial_collisions():
+    members = [f"k-{i}".encode() for i in range(100_000)]
+    h = hashing.hash64(members)
+    assert len(np.unique(h)) == len(members)
+
+
+def test_rank_distribution_sane():
+    h = hashing.hash64([f"v{i}".encode() for i in range(100_000)])
+    idx, rank = hashing.hll_position(h)
+    assert idx.min() >= 0 and idx.max() < hll.M
+    assert rank.min() >= 1 and rank.max() <= 64 - 14 + 1
+    # ~half of ranks should be 1
+    frac1 = float((rank == 1).mean())
+    assert 0.45 < frac1 < 0.55

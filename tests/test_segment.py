@@ -1,0 +1,94 @@
+"""Unit tests for counter/gauge/histo-stat segment kernels vs exact
+references (mirrors reference samplers/samplers_test.go merge/flush
+semantics)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veneur_tpu.ops import segment
+
+
+def test_counter_rate_corrected_sum():
+    state = segment.empty_counter_state(4)
+    ids = jnp.array([0, 1, 0, 3, 4], dtype=jnp.int32)  # 4 = padding
+    vals = jnp.array([1.0, 2.0, 3.0, 5.0, 99.0], dtype=jnp.float32)
+    wts = jnp.array([1.0, 2.0, 1.0, 1.0, 1.0], dtype=jnp.float32)
+    out = segment.counter_update(state, ids, vals, wts)
+    np.testing.assert_allclose(np.asarray(out), [4.0, 4.0, 0.0, 5.0])
+
+
+def test_counter_accumulates_across_batches():
+    state = segment.empty_counter_state(2)
+    ids = jnp.array([0], dtype=jnp.int32)
+    v = jnp.array([1.5], dtype=jnp.float32)
+    w = jnp.array([1.0], dtype=jnp.float32)
+    state = segment.counter_update(state, ids, v, w)
+    state = segment.counter_update(state, ids, v, w)
+    np.testing.assert_allclose(np.asarray(state), [3.0, 0.0])
+
+
+def test_gauge_last_write_wins():
+    state = segment.empty_gauge_state(3).at[2].set(7.0)
+    ids = jnp.array([0, 1, 0, 3], dtype=jnp.int32)  # 3 = padding
+    vals = jnp.array([1.0, 2.0, 9.0, 55.0], dtype=jnp.float32)
+    out = segment.gauge_update(state, ids, vals)
+    # row 0: latest sample (9.0); row 2: untouched
+    np.testing.assert_allclose(np.asarray(out), [9.0, 2.0, 7.0])
+
+
+def test_histo_stats_match_numpy():
+    rng = np.random.default_rng(0)
+    R, N = 16, 1000
+    ids_np = rng.integers(0, R, size=N).astype(np.int32)
+    vals_np = rng.normal(10, 5, size=N).astype(np.float32)
+    wts_np = rng.choice([1.0, 2.0, 4.0], size=N).astype(np.float32)
+    stats = segment.empty_histo_stats(R)
+    out = np.asarray(segment.histo_stats_update(
+        stats, jnp.asarray(ids_np), jnp.asarray(vals_np),
+        jnp.asarray(wts_np)))
+    for r in range(R):
+        m = ids_np == r
+        assert m.any()
+        np.testing.assert_allclose(out[r, segment.STAT_WEIGHT],
+                                   wts_np[m].sum(), rtol=1e-5)
+        np.testing.assert_allclose(out[r, segment.STAT_MIN],
+                                   vals_np[m].min(), rtol=1e-6)
+        np.testing.assert_allclose(out[r, segment.STAT_MAX],
+                                   vals_np[m].max(), rtol=1e-6)
+        np.testing.assert_allclose(out[r, segment.STAT_SUM],
+                                   (vals_np[m] * wts_np[m]).sum(),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(out[r, segment.STAT_RSUM],
+                                   (wts_np[m] / vals_np[m]).sum(),
+                                   rtol=1e-4)
+
+
+def test_histo_stats_empty_row_sentinels():
+    stats = np.asarray(segment.empty_histo_stats(2))
+    assert stats[0, segment.STAT_WEIGHT] == 0.0
+    assert stats[0, segment.STAT_MIN] > 1e37
+    assert stats[0, segment.STAT_MAX] < -1e37
+
+
+def test_merge_counter_and_histo_stats():
+    state = segment.empty_counter_state(3)
+    state = segment.merge_counter(state, jnp.array([1, 1], dtype=jnp.int32),
+                                  jnp.array([2.0, 3.0], dtype=jnp.float32))
+    np.testing.assert_allclose(np.asarray(state), [0.0, 5.0, 0.0])
+
+    stats = segment.empty_histo_stats(2)
+    inc = jnp.array([[3.0, 1.0, 9.0, 12.0, 0.5],
+                     [2.0, 0.5, 4.0, 5.0, 1.0]], dtype=jnp.float32)
+    out = np.asarray(segment.merge_histo_stats(
+        stats, jnp.array([0, 0], dtype=jnp.int32), inc))
+    np.testing.assert_allclose(out[0], [5.0, 0.5, 9.0, 17.0, 1.5])
+
+
+def test_update_jits_and_donates():
+    f = jax.jit(segment.counter_update, donate_argnums=0)
+    state = segment.empty_counter_state(8)
+    out = f(state, jnp.array([2], dtype=jnp.int32),
+            jnp.array([1.0], dtype=jnp.float32),
+            jnp.array([1.0], dtype=jnp.float32))
+    assert float(out[2]) == 1.0

@@ -1,0 +1,28 @@
+"""veneur-tpu: a TPU-native metrics-aggregation framework.
+
+A brand-new implementation of the capability surface of Stripe's Veneur
+(reference: /root/reference, github.com/stripe/veneur): a distributed,
+fault-tolerant observability pipeline speaking DogStatsD/StatsD/SSF that
+aggregates counters, gauges, timers/histograms (t-digest) and sets
+(HyperLogLog) across a local -> proxy -> global tier topology and flushes
+to pluggable sinks.
+
+Unlike the Go reference (goroutines x hash-sharded maps x pointer-heavy
+samplers), the aggregation hot path here is columnar tensor state resident
+in TPU HBM:
+
+- counters/gauges/histogram-stats update via XLA segment reductions
+  (ops/segment.py)
+- t-digest centroid merging is a batched sort + cumulative-weight +
+  k-scale clustering kernel (ops/tdigest.py, in progress)
+- HyperLogLog register planes update via scatter-max and union via
+  elementwise maximum (ops/hll.py)
+- the global tier shards the series table over a jax.sharding.Mesh and
+  merges cross-chip state with ICI collectives (parallel/, in progress)
+
+Host-side code (parsing, key indexing, networking, sinks) orchestrates the
+device step; the DCN-facing forward protocol mirrors the reference's
+forwardrpc gRPC service.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,125 @@
+"""Batched segment kernels for counter / gauge / histogram-stat aggregation.
+
+The reference aggregates one sample at a time into per-series sampler
+structs behind a per-worker goroutine (reference worker.go:344
+``ProcessMetric`` -> samplers/samplers.go:142 ``Counter.Sample``, :225
+``Gauge.Sample``, :484 ``Histo.Sample``).  Here a whole ingest batch is a
+set of flat columnar arrays ``(row_ids, values, weights)`` and the update
+is one XLA scatter/segment reduction over the device-resident state
+tables, so throughput scales with batch size instead of goroutine count.
+
+Conventions
+-----------
+* ``row_ids`` index into a fixed-capacity table of ``num_rows`` rows.
+  Padding entries use ``row_id == num_rows`` (one past the end); JAX
+  drops out-of-bounds scatter updates, so padding is free.
+* ``weights`` carry the DogStatsD sample-rate correction ``1/rate``
+  (reference samplers/samplers.go:142 does ``value * (1/rate)``).
+* All state is float32: TPU has no native float64, and the relative
+  error of f32 batch summation (~sqrt(N) * 1e-7) is far below metric
+  noise floors.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+# Number of per-row local histogram statistics tracked alongside the
+# t-digest (reference samplers/samplers.go:467-509 Histo fields
+# LocalWeight/LocalMin/LocalMax/LocalSum/LocalReciprocalSum).
+HISTO_STAT_COLS = 5
+STAT_WEIGHT, STAT_MIN, STAT_MAX, STAT_SUM, STAT_RSUM = range(HISTO_STAT_COLS)
+
+_F32_MAX = jnp.float32(jnp.finfo(jnp.float32).max)
+
+
+def counter_update(state: Array, row_ids: Array, values: Array,
+                   weights: Array) -> Array:
+    """Add rate-corrected sample values into counter rows.
+
+    state: f32[R]; row_ids: i32[N]; values, weights: f32[N].
+    Equivalent of reference samplers/samplers.go:142 over a whole batch.
+    """
+    return state.at[row_ids].add(values * weights, mode="drop")
+
+
+def gauge_update(state: Array, row_ids: Array, values: Array) -> Array:
+    """Last-write-wins gauge update (reference samplers/samplers.go:225).
+
+    Batch order is arrival order: for each row the *latest* sample in the
+    batch wins.  Deterministic winner selection via a segment-max over
+    arrival indices (plain ``.at[].set`` with duplicate indices has
+    unspecified winner ordering).
+    """
+    n = row_ids.shape[0]
+    if n == 0:
+        return state
+    num_rows = state.shape[0]
+    arrival = jnp.arange(n, dtype=jnp.int32)
+    winner = jax.ops.segment_max(arrival, row_ids, num_segments=num_rows)
+    has_sample = winner >= 0
+    winner_clipped = jnp.clip(winner, 0, n - 1)
+    return jnp.where(has_sample, values[winner_clipped], state)
+
+
+def histo_stats_update(stats: Array, row_ids: Array, values: Array,
+                       weights: Array) -> Array:
+    """Update per-row local histogram aggregates.
+
+    stats: f32[R, 5] columns (weight, min, max, sum, reciprocal_sum) as in
+    reference samplers/samplers.go:484-494.  min/max use +/-inf-free
+    sentinels so that empty rows read back as untouched.
+
+    A raw sample of value v / weight w contributes the stat row
+    (w, v, v, v*w, w/v); merging those rows is the same operation as
+    merging forwarded partial aggregates, so this composes onto
+    merge_histo_stats.
+    """
+    incoming = jnp.stack([
+        weights, values, values, values * weights,
+        jnp.where(values != 0, weights / values, 0.0)
+    ], axis=1)
+    return merge_histo_stats(stats, row_ids, incoming)
+
+
+def empty_counter_state(num_rows: int) -> Array:
+    return jnp.zeros((num_rows,), dtype=jnp.float32)
+
+
+def empty_gauge_state(num_rows: int) -> Array:
+    return jnp.zeros((num_rows,), dtype=jnp.float32)
+
+
+def empty_histo_stats(num_rows: int) -> Array:
+    """min column initialised to +f32max, max to -f32max so the first
+    sample always wins; weight==0 marks an empty row."""
+    stats = jnp.zeros((num_rows, HISTO_STAT_COLS), dtype=jnp.float32)
+    stats = stats.at[:, STAT_MIN].set(_F32_MAX)
+    stats = stats.at[:, STAT_MAX].set(-_F32_MAX)
+    return stats
+
+
+def merge_counter(state: Array, row_ids: Array, totals: Array) -> Array:
+    """Global-tier merge of forwarded counter totals (reference
+    samplers/samplers.go:208 ``Counter.Merge`` is ``+=``)."""
+    return state.at[row_ids].add(totals, mode="drop")
+
+
+def merge_histo_stats(stats: Array, row_ids: Array,
+                      incoming: Array) -> Array:
+    """Merge forwarded (weight, min, max, sum, rsum) rows into the table
+    (global node combining many locals' partial aggregates)."""
+    new_w = stats[:, STAT_WEIGHT].at[row_ids].add(
+        incoming[:, STAT_WEIGHT], mode="drop")
+    new_min = stats[:, STAT_MIN].at[row_ids].min(
+        incoming[:, STAT_MIN], mode="drop")
+    new_max = stats[:, STAT_MAX].at[row_ids].max(
+        incoming[:, STAT_MAX], mode="drop")
+    new_sum = stats[:, STAT_SUM].at[row_ids].add(
+        incoming[:, STAT_SUM], mode="drop")
+    new_rsum = stats[:, STAT_RSUM].at[row_ids].add(
+        incoming[:, STAT_RSUM], mode="drop")
+    return jnp.stack([new_w, new_min, new_max, new_sum, new_rsum], axis=1)
