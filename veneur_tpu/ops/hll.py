@@ -25,9 +25,11 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from veneur_tpu.utils.hashing import HLL_P
+
 Array = jax.Array
 
-P = 14
+P = HLL_P  # single source of truth shared with the host hash split
 M = 1 << P  # 16384 registers, ~0.81% standard error
 
 # LogLog-Beta bias-correction polynomial for p=14 — published constants

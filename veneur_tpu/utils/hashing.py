@@ -24,7 +24,10 @@ FNV1A_32_PRIME = np.uint32(16777619)
 FNV1A_64_OFFSET = np.uint64(14695981039346656037)
 FNV1A_64_PRIME = np.uint64(1099511628211)
 
-_HLL_P = 14  # precision: 2^14 registers (reference worker.go:247)
+# HLL precision: 2^14 registers (reference worker.go:247).  This is THE
+# authoritative constant — veneur_tpu.ops.hll imports it so the host
+# hash split and the device register-plane width can never diverge.
+HLL_P = 14
 
 
 def fnv1a_32(data: bytes) -> int:
@@ -40,8 +43,10 @@ def fnv1a_32(data: bytes) -> int:
 def pack_bytes_matrix(members: Sequence[bytes],
                       max_len: int = 256) -> tuple[np.ndarray, np.ndarray]:
     """Pack variable-length byte strings into (matrix u8[N, L], lens
-    i64[N]) for column-wise hashing.  Members longer than max_len are
-    pre-compressed by hashing their tail into 8 suffix bytes."""
+    i64[N]) for column-wise hashing, without a per-member Python loop:
+    one join + a vectorized scatter by (row, column) index.  Members
+    longer than max_len are pre-compressed (rare path only) by hashing
+    their tail into 8 suffix bytes."""
     n = len(members)
     lens = np.fromiter((len(m) for m in members), dtype=np.int64, count=n)
     longest = int(lens.max(initial=0))
@@ -55,9 +60,13 @@ def pack_bytes_matrix(members: Sequence[bytes],
                            count=n)
         longest = int(lens.max(initial=0))
     mat = np.zeros((n, max(longest, 1)), dtype=np.uint8)
-    for i, m in enumerate(members):
-        if m:
-            mat[i, :len(m)] = np.frombuffer(m, dtype=np.uint8)
+    total = int(lens.sum())
+    if total:
+        buf = np.frombuffer(b"".join(members), dtype=np.uint8)
+        rows = np.repeat(np.arange(n, dtype=np.int64), lens)
+        offsets = np.concatenate(([0], np.cumsum(lens)[:-1]))
+        cols = np.arange(total, dtype=np.int64) - np.repeat(offsets, lens)
+        mat[rows, cols] = buf
     return mat, lens
 
 
@@ -105,7 +114,7 @@ def _floor_log2_u64(x: np.ndarray) -> np.ndarray:
 
 
 def hll_position(hashes: np.ndarray,
-                 p: int = _HLL_P) -> tuple[np.ndarray, np.ndarray]:
+                 p: int = HLL_P) -> tuple[np.ndarray, np.ndarray]:
     """Split u64 hashes into (register index i32[N], rank i32[N]) exactly
     as the reference's getPosVal (hyperloglog/utils.go): index = top p
     bits, rank = leading-zero count of the remaining bits (with a stop
@@ -120,6 +129,6 @@ def hll_position(hashes: np.ndarray,
 
 
 def hash_members(members: Sequence[bytes],
-                 p: int = _HLL_P) -> tuple[np.ndarray, np.ndarray]:
+                 p: int = HLL_P) -> tuple[np.ndarray, np.ndarray]:
     """bytes batch -> (register index, rank) ready for device scatter."""
     return hll_position(hash64(members), p)
