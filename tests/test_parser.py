@@ -1,0 +1,168 @@
+"""Parser tests: DogStatsD grammar, malformed packets, scope tags —
+modeled on the reference's parser_test.go coverage."""
+
+import pytest
+
+from veneur_tpu.protocol import dogstatsd as dsd
+
+
+def test_counter_basic():
+    s = dsd.parse_metric(b"page.views:1|c")
+    assert s.name == "page.views"
+    assert s.type == dsd.COUNTER
+    assert s.value == 1.0
+    assert s.sample_rate == 1.0
+    assert s.tags == ()
+    assert s.digest != 0
+
+
+def test_gauge_with_tags():
+    s = dsd.parse_metric(b"fuel.level:0.5|g|#vehicle:car,zone:b")
+    assert s.type == dsd.GAUGE
+    assert s.value == 0.5
+    assert s.tags == ("vehicle:car", "zone:b")
+
+
+def test_tags_sorted_and_digest_stable():
+    a = dsd.parse_metric(b"x:1|c|#b:2,a:1")
+    b = dsd.parse_metric(b"x:1|c|#a:1,b:2")
+    assert a.tags == b.tags == ("a:1", "b:2")
+    assert a.digest == b.digest
+
+
+def test_timer_with_rate():
+    s = dsd.parse_metric(b"req.latency:320|ms|@0.1|#svc:api")
+    assert s.type == dsd.TIMER
+    assert s.sample_rate == pytest.approx(0.1)
+
+
+def test_histogram_type():
+    assert dsd.parse_metric(b"x:1|h").type == dsd.HISTOGRAM
+
+
+def test_set_string_member():
+    s = dsd.parse_metric(b"users.unique:alice|s")
+    assert s.type == dsd.SET
+    assert s.value == "alice"
+
+
+def test_scope_tags_extracted():
+    s = dsd.parse_metric(b"x:1|c|#veneurglobalonly,env:prod")
+    assert s.scope == dsd.SCOPE_GLOBAL
+    assert s.tags == ("env:prod",)
+    s = dsd.parse_metric(b"x:1|ms|#veneurlocalonly")
+    assert s.scope == dsd.SCOPE_LOCAL
+    assert s.tags == ()
+
+
+def test_sinkonly_tag_kept():
+    s = dsd.parse_metric(b"x:1|c|#veneursinkonly:datadog")
+    assert "veneursinkonly:datadog" in s.tags
+
+
+@pytest.mark.parametrize("bad", [
+    b"",
+    b"no.value",
+    b"novalue:|c",
+    b":1|c",
+    b"x:1",
+    b"x:1|q",
+    b"x:notanumber|c",
+    b"x:1|c|@2.0",
+    b"x:1|c|@0",
+    b"x:1|c|@nope",
+    b"x:1|g|@0.5",       # gauges cannot be sampled
+    b"x:1|c|unknown",
+])
+def test_malformed_rejected(bad):
+    with pytest.raises(dsd.ParseError):
+        dsd.parse_metric(bad)
+
+
+def test_event_full():
+    e = dsd.parse_event(
+        b"_e{5,4}:title|text|d:1136239445|h:h1|k:agg|p:low|s:src"
+        b"|t:warning|#env:prod")
+    assert e.title == "title"
+    assert e.text == "text"
+    assert e.timestamp == 1136239445
+    assert e.hostname == "h1"
+    assert e.aggregation_key == "agg"
+    assert e.priority == "low"
+    assert e.source_type == "src"
+    assert e.alert_type == "warning"
+    assert e.tags == ("env:prod",)
+
+
+def test_event_newline_unescape():
+    e = dsd.parse_event(b"_e{2,5}:ab|x\\nyz")
+    assert e.text == "x\nyz"
+
+
+@pytest.mark.parametrize("bad", [
+    b"_e{4,4}:ab|cdef",        # title length mismatch
+    b"_e{2,10}:ab|cd",         # body too short
+    b"_e{x,1}:a|b",            # non-numeric length
+    b"_e{1,1}:a|b|junk",       # bad trailer section
+])
+def test_malformed_event(bad):
+    with pytest.raises(dsd.ParseError):
+        dsd.parse_event(bad)
+
+
+def test_service_check():
+    sc = dsd.parse_service_check(
+        b"_sc|svc.up|0|d:1136239445|h:h1|#env:prod|m:all good")
+    assert sc.name == "svc.up"
+    assert sc.status == 0
+    assert sc.hostname == "h1"
+    assert sc.message == "all good"
+    assert sc.tags == ("env:prod",)
+
+
+@pytest.mark.parametrize("bad", [
+    b"_sc|x",
+    b"_sc|x|9",
+    b"_sc|x|notanint",
+    b"_sc||0",
+])
+def test_malformed_service_check(bad):
+    with pytest.raises(dsd.ParseError):
+        dsd.parse_service_check(bad)
+
+
+def test_parse_line_dispatch():
+    assert isinstance(dsd.parse_line(b"x:1|c"), dsd.Sample)
+    assert isinstance(dsd.parse_line(b"_e{1,1}:a|b"), dsd.Event)
+    assert isinstance(dsd.parse_line(b"_sc|x|0"), dsd.ServiceCheck)
+
+
+def test_split_packet():
+    lines = list(dsd.split_packet(b"a:1|c\nb:2|g\n\nc:3|c\n"))
+    assert lines == [b"a:1|c", b"b:2|g", b"c:3|c"]
+
+
+def test_distribution_maps_to_histogram():
+    assert dsd.parse_metric(b"x:1|d").type == dsd.HISTOGRAM
+
+
+def test_bare_m_is_timer():
+    assert dsd.parse_metric(b"x:1|m").type == dsd.TIMER
+
+
+@pytest.mark.parametrize("bad", [b"x:nan|c", b"x:inf|ms", b"x:-inf|g"])
+def test_nonfinite_rejected(bad):
+    with pytest.raises(dsd.ParseError):
+        dsd.parse_metric(bad)
+
+
+def test_scope_tag_prefix_form():
+    s = dsd.parse_metric(b"x:1|c|#veneurglobalonly:true")
+    assert s.scope == dsd.SCOPE_GLOBAL
+    assert s.tags == ()
+
+
+@pytest.mark.parametrize("bad", [b"_e{1,1}:a|b|d:xyz", b"_sc|x|0|d:xyz"])
+def test_bad_timestamp_is_parse_error(bad):
+    with pytest.raises(dsd.ParseError):
+        dsd.parse_line(bad)

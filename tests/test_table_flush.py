@@ -1,0 +1,183 @@
+"""End-to-end table + flusher tests: ingest -> device step -> swap ->
+InterMetrics, for both local and global roles (mirrors the reference's
+server-level flush assertions in server_test.go via capture sinks)."""
+
+import numpy as np
+import pytest
+
+from veneur_tpu.core.flusher import Flusher
+from veneur_tpu.core.table import MetricTable, TableConfig
+from veneur_tpu.protocol import dogstatsd as dsd
+
+
+def small_table():
+    return MetricTable(TableConfig(counter_rows=64, gauge_rows=64,
+                                   histo_rows=64, set_rows=16))
+
+
+def ingest_lines(table, lines):
+    for line in lines:
+        table.ingest(dsd.parse_metric(line))
+
+
+def by_name(metrics):
+    return {m.name: m for m in metrics}
+
+
+def test_counter_global_flush():
+    t = small_table()
+    ingest_lines(t, [b"hits:3|c", b"hits:2|c", b"hits:5|c|@0.5"])
+    res = Flusher(is_local=False).flush(t.swap())
+    m = by_name(res.metrics)
+    assert m["hits"].value == pytest.approx(3 + 2 + 10)
+    assert m["hits"].type == "counter"
+    assert not res.forward
+
+
+def test_gauge_last_write():
+    t = small_table()
+    ingest_lines(t, [b"temp:1|g", b"temp:9|g", b"temp:4|g"])
+    res = Flusher(is_local=False).flush(t.swap())
+    assert by_name(res.metrics)["temp"].value == 4.0
+
+
+def test_tag_cardinality_distinct_rows():
+    t = small_table()
+    ingest_lines(t, [b"api:1|c|#route:a", b"api:2|c|#route:b",
+                     b"api:3|c|#route:a"])
+    res = Flusher(is_local=False).flush(t.swap())
+    vals = {m.tags: m.value for m in res.metrics}
+    assert vals[("route:a",)] == 4.0
+    assert vals[("route:b",)] == 2.0
+
+
+def test_histo_global_emits_aggregates_and_percentiles():
+    t = small_table()
+    rng = np.random.default_rng(0)
+    vals = rng.uniform(0, 100, 2000)
+    for v in vals:
+        t.ingest(dsd.parse_metric(f"lat:{v}|ms".encode()))
+    res = Flusher(is_local=False,
+                  percentiles=(0.5, 0.99),
+                  aggregates=("min", "max", "count", "median")).flush(
+        t.swap())
+    m = by_name(res.metrics)
+    assert m["lat.min"].value == pytest.approx(vals.min(), abs=1e-3)
+    assert m["lat.max"].value == pytest.approx(vals.max(), abs=1e-3)
+    assert m["lat.count"].value == pytest.approx(2000)
+    assert m["lat.count"].type == "counter"
+    assert m["lat.50percentile"].value == pytest.approx(
+        np.quantile(vals, 0.5), rel=0.05)
+    assert m["lat.99percentile"].value == pytest.approx(
+        np.quantile(vals, 0.99), rel=0.05)
+    assert m["lat.median"].value == pytest.approx(
+        np.quantile(vals, 0.5), rel=0.05)
+
+
+def test_histo_timer_rate_weighting():
+    t = small_table()
+    for _ in range(10):
+        t.ingest(dsd.parse_metric(b"d:10|ms|@0.1"))
+    res = Flusher(is_local=False, aggregates=("count",)).flush(t.swap())
+    assert by_name(res.metrics)["d.count"].value == pytest.approx(100)
+
+
+def test_set_cardinality():
+    t = small_table()
+    for i in range(500):
+        t.ingest(dsd.parse_metric(f"users:u{i}|s".encode()))
+        if i % 3 == 0:  # duplicates shouldn't inflate
+            t.ingest(dsd.parse_metric(f"users:u{i}|s".encode()))
+    res = Flusher(is_local=False).flush(t.swap())
+    assert by_name(res.metrics)["users"].value == pytest.approx(500,
+                                                                rel=0.05)
+
+
+def test_local_role_forwards_histos_and_sets():
+    t = small_table()
+    ingest_lines(t, [b"lat:5|ms", b"lat:6|ms", b"users:a|s",
+                     b"hits:1|c", b"temp:3|g"])
+    res = Flusher(is_local=True, aggregates=("count",)).flush(t.swap())
+    m = by_name(res.metrics)
+    # local histo aggregates, no percentiles
+    assert "lat.count" in m
+    assert not any("percentile" in k for k in m)
+    # sets forward, do not emit locally
+    assert "users" not in m
+    # plain counters/gauges emit locally
+    assert m["hits"].value == 1.0
+    assert m["temp"].value == 3.0
+    kinds = {f.kind for f in res.forward}
+    assert kinds == {"histo", "set"}
+    hf = [f for f in res.forward if f.kind == "histo"][0]
+    assert hf.weights.sum() == pytest.approx(2.0)
+
+
+def test_scope_global_counter_forwarded_not_emitted():
+    t = small_table()
+    ingest_lines(t, [b"g.hits:7|c|#veneurglobalonly"])
+    res = Flusher(is_local=True).flush(t.swap())
+    assert not res.metrics
+    assert res.forward[0].kind == "counter"
+    assert res.forward[0].value == 7.0
+
+
+def test_scope_local_histo_emits_percentiles_never_forwards():
+    t = small_table()
+    for v in range(100):
+        t.ingest(dsd.parse_metric(f"l:{v}|ms|#veneurlocalonly".encode()))
+    res = Flusher(is_local=True, percentiles=(0.5,),
+                  aggregates=("count",)).flush(t.swap())
+    m = by_name(res.metrics)
+    assert "l.50percentile" in m
+    assert not res.forward
+
+
+def test_interval_reset():
+    t = small_table()
+    ingest_lines(t, [b"hits:5|c"])
+    Flusher(is_local=False).flush(t.swap())
+    ingest_lines(t, [b"hits:2|c"])
+    res = Flusher(is_local=False).flush(t.swap())
+    assert by_name(res.metrics)["hits"].value == 2.0  # not 7
+
+
+def test_untouched_rows_not_emitted():
+    t = small_table()
+    ingest_lines(t, [b"a:1|c", b"b:1|c"])
+    t.swap()
+    ingest_lines(t, [b"a:1|c"])
+    res = Flusher(is_local=False).flush(t.swap())
+    names = {m.name for m in res.metrics}
+    assert names == {"a"}
+
+
+def test_overflow_counted():
+    t = MetricTable(TableConfig(counter_rows=2))
+    for i in range(5):
+        t.ingest(dsd.parse_metric(f"c{i}:1|c".encode()))
+    snap = t.swap()
+    assert snap.overflow["counter"] == 3
+
+
+def test_compaction_keeps_hot_keys():
+    t = MetricTable(TableConfig(counter_rows=8,
+                                compact_threshold=0.5))
+    for i in range(6):
+        t.ingest(dsd.parse_metric(f"c{i}:1|c".encode()))
+    t.swap()  # occupancy 6/8 > 0.5 -> compact, all keys touched gen 0
+    t.ingest(dsd.parse_metric(b"c0:1|c"))
+    t.swap()
+    t.ingest(dsd.parse_metric(b"c0:1|c"))
+    snap = t.swap()
+    assert snap.overflow["counter"] == 0
+    assert t.counter_idx.occupancy() <= 6
+
+
+def test_status_checks_host_side():
+    t = small_table()
+    sc = dsd.parse_service_check(b"_sc|db.up|0|m:fine")
+    t.ingest(dsd.Sample(name=sc.name, type=dsd.STATUS,
+                        value=float(sc.status), tags=sc.tags))
+    status = t.take_status()
+    assert list(status.values())[0][0] == 0.0

@@ -1,0 +1,254 @@
+"""Flush: device table snapshot -> InterMetrics + forwardable state.
+
+The reference's flush pipeline (flusher.go:28 ``Flush`` ->
+:172 ``tallyMetrics`` -> :228 ``generateInterMetrics``) walks every
+sampler object and calls its ``Flush()``.  Here the equivalent work is a
+handful of device readouts over whole tables — counter/gauge vectors,
+the histo quantile kernel over all rows at once, the HLL estimate kernel
+over all register planes — followed by host-side assembly of
+InterMetrics from row metadata.
+
+Role semantics (reference flusher.go:61-99, worker.go:181
+``ForwardableMetrics``):
+
+- A LOCAL node (has a forward address) emits counters/gauges of
+  default/local scope, histo aggregates from local stats (NO
+  percentiles), and forwards histos/timers/sets plus global-scope
+  counters/gauges upstream as mergeable state.
+- A GLOBAL node emits everything, computing percentiles from the merged
+  digests and min/max/etc from the merged stat columns.
+- ``veneurlocalonly`` metrics never forward; ``veneurglobalonly``
+  metrics never emit locally (samplers/parser.go:397-407 scope
+  semantics).
+
+Histo aggregate emission matches samplers/samplers.go:511-672: .min
+.max .sum .avg .count .median .hmean gauges (count is a counter) plus
+``.<p>percentile`` gauges, with the reference's sparse-emission guards.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+
+from veneur_tpu.core import metrics as im
+from veneur_tpu.core.table import RowMeta, Snapshot
+from veneur_tpu.ops import hll, segment, tdigest
+from veneur_tpu.protocol import dogstatsd as dsd
+
+DEFAULT_AGGREGATES = ("min", "max", "count")
+DEFAULT_PERCENTILES = (0.5, 0.75, 0.99)
+
+
+@dataclass
+class ForwardRow:
+    """One row of mergeable state bound for the global tier."""
+    meta: RowMeta
+    kind: str  # counter | gauge | histo | set
+    value: float = 0.0
+    stats: np.ndarray | None = None  # f32[5]
+    means: np.ndarray | None = None  # f32[C]
+    weights: np.ndarray | None = None  # f32[C]
+    regs: np.ndarray | None = None  # u8[M]
+
+
+@dataclass
+class FlushResult:
+    metrics: list[im.InterMetric] = field(default_factory=list)
+    forward: list[ForwardRow] = field(default_factory=list)
+    tally: dict[str, int] = field(default_factory=dict)
+
+
+def _percentile_suffix(p: float) -> str:
+    """Reference emits ``.50percentile`` for 0.5 (samplers.go:657);
+    sub-percent quantiles keep their digits (``.999percentile``
+    for 0.999) instead of truncating."""
+    scaled = p * 100
+    if abs(scaled - round(scaled)) < 1e-9:
+        return f"{int(round(scaled))}percentile"
+    return f"{str(scaled).replace('.', '')}percentile"
+
+
+class Flusher:
+    def __init__(self, is_local: bool,
+                 percentiles: tuple[float, ...] = DEFAULT_PERCENTILES,
+                 aggregates: tuple[str, ...] = DEFAULT_AGGREGATES,
+                 hostname: str = "", tags: tuple[str, ...] = ()):
+        self.is_local = is_local
+        self.percentiles = tuple(percentiles)
+        self.aggregates = tuple(aggregates)
+        self.hostname = hostname
+        self.common_tags = tuple(tags)
+
+    # ------------------------------------------------------------------
+
+    def flush(self, snap: Snapshot, now: int | None = None) -> FlushResult:
+        ts = int(now if now is not None else time.time())
+        res = FlushResult()
+        self._flush_counters(snap, ts, res)
+        self._flush_gauges(snap, ts, res)
+        self._flush_histos(snap, ts, res)
+        self._flush_sets(snap, ts, res)
+        res.tally["overflow"] = sum(snap.overflow.values())
+        return res
+
+    # ------------------------------------------------------------------
+
+    def _emit_local(self, meta: RowMeta) -> bool:
+        return meta.scope != dsd.SCOPE_GLOBAL or not self.is_local
+
+    def _forwardable(self, meta: RowMeta, always: bool) -> bool:
+        if not self.is_local or meta.scope == dsd.SCOPE_LOCAL:
+            return False
+        return always or meta.scope == dsd.SCOPE_GLOBAL
+
+    def _mk(self, name: str, ts: int, value: float, meta: RowMeta,
+            mtype: str) -> im.InterMetric:
+        return im.InterMetric(name=name, timestamp=ts, value=value,
+                              tags=meta.tags + self.common_tags,
+                              type=mtype, hostname=self.hostname)
+
+    def _flush_counters(self, snap: Snapshot, ts: int,
+                        res: FlushResult) -> None:
+        if not snap.counter_meta:
+            return
+        vals = np.asarray(snap.counters)
+        for row, meta in enumerate(snap.counter_meta):
+            if not snap.counter_touched[row]:
+                continue
+            v = float(vals[row])
+            if self._forwardable(meta, always=False):
+                res.forward.append(ForwardRow(meta, "counter", value=v))
+            elif self._emit_local(meta):
+                res.metrics.append(
+                    self._mk(meta.name, ts, v, meta, im.COUNTER))
+        res.tally["counters"] = int(snap.counter_touched.sum())
+
+    def _flush_gauges(self, snap: Snapshot, ts: int,
+                      res: FlushResult) -> None:
+        if not snap.gauge_meta:
+            return
+        vals = np.asarray(snap.gauges)
+        for row, meta in enumerate(snap.gauge_meta):
+            if not snap.gauge_touched[row]:
+                continue
+            v = float(vals[row])
+            if self._forwardable(meta, always=False):
+                res.forward.append(ForwardRow(meta, "gauge", value=v))
+            elif self._emit_local(meta):
+                res.metrics.append(
+                    self._mk(meta.name, ts, v, meta, im.GAUGE))
+        res.tally["gauges"] = int(snap.gauge_touched.sum())
+
+    def _flush_histos(self, snap: Snapshot, ts: int,
+                      res: FlushResult) -> None:
+        if not snap.histo_meta:
+            return
+        stats = np.asarray(snap.histo_stats)
+        mins = jnp.asarray(stats[:, segment.STAT_MIN])
+        maxs = jnp.asarray(stats[:, segment.STAT_MAX])
+        emit_pcts = not self.is_local
+        all_pcts = tuple(self.percentiles) + (
+            (0.5,) if "median" in self.aggregates else ())
+        # Quantiles are only needed when someone will emit them — on
+        # global nodes, for the median aggregate, or for local-scope
+        # histos on local nodes.  Skip the kernel + readback otherwise.
+        any_local_scope = any(
+            snap.histo_touched[r] and m.scope == dsd.SCOPE_LOCAL
+            for r, m in enumerate(snap.histo_meta))
+        need_q = bool(all_pcts) and (
+            emit_pcts or "median" in self.aggregates or any_local_scope)
+        qvals = None
+        if need_q:
+            qvals = np.asarray(tdigest.quantile(
+                snap.histo_means, snap.histo_weights,
+                jnp.asarray(np.asarray(all_pcts, np.float32)),
+                mins, maxs))
+        means_np = weights_np = None
+
+        for row, meta in enumerate(snap.histo_meta):
+            if not snap.histo_touched[row]:
+                continue
+            st = stats[row]
+            weight = float(st[segment.STAT_WEIGHT])
+            forward = self._forwardable(meta, always=True)
+            if forward:
+                if means_np is None:
+                    means_np = np.asarray(snap.histo_means)
+                    weights_np = np.asarray(snap.histo_weights)
+                res.forward.append(ForwardRow(
+                    meta, "histo", stats=st.copy(),
+                    means=means_np[row].copy(),
+                    weights=weights_np[row].copy()))
+            # mixed-scope histos emit local aggregates even while their
+            # digest forwards; global-only histos emit nothing locally
+            if meta.scope == dsd.SCOPE_GLOBAL and self.is_local:
+                continue
+            self._emit_histo_row(res, meta, ts, st, weight, qvals, row,
+                                 all_pcts,
+                                 with_percentiles=emit_pcts or
+                                 meta.scope == dsd.SCOPE_LOCAL)
+        res.tally["histograms"] = int(snap.histo_touched.sum())
+
+    def _emit_histo_row(self, res, meta, ts, st, weight, qvals, row,
+                        all_pcts, with_percentiles):
+        agg = set(self.aggregates)
+        out = res.metrics
+        if "max" in agg:
+            out.append(self._mk(f"{meta.name}.max", ts,
+                                float(st[segment.STAT_MAX]), meta,
+                                im.GAUGE))
+        if "min" in agg:
+            out.append(self._mk(f"{meta.name}.min", ts,
+                                float(st[segment.STAT_MIN]), meta,
+                                im.GAUGE))
+        if "sum" in agg and float(st[segment.STAT_SUM]) != 0:
+            out.append(self._mk(f"{meta.name}.sum", ts,
+                                float(st[segment.STAT_SUM]), meta,
+                                im.GAUGE))
+        if "avg" in agg and weight != 0 and float(st[segment.STAT_SUM]) != 0:
+            out.append(self._mk(
+                f"{meta.name}.avg", ts,
+                float(st[segment.STAT_SUM]) / weight, meta, im.GAUGE))
+        if "count" in agg and weight != 0:
+            out.append(self._mk(f"{meta.name}.count", ts, weight, meta,
+                                im.COUNTER))
+        if "hmean" in agg and weight != 0 and \
+                float(st[segment.STAT_RSUM]) != 0:
+            out.append(self._mk(
+                f"{meta.name}.hmean", ts,
+                weight / float(st[segment.STAT_RSUM]), meta, im.GAUGE))
+        if "median" in agg and qvals is not None:
+            out.append(self._mk(f"{meta.name}.median", ts,
+                                float(qvals[row, len(all_pcts) - 1]),
+                                meta, im.GAUGE))
+        if with_percentiles and qvals is not None:
+            for pi, p in enumerate(self.percentiles):
+                out.append(self._mk(
+                    f"{meta.name}.{_percentile_suffix(p)}", ts,
+                    float(qvals[row, pi]), meta, im.GAUGE))
+
+    def _flush_sets(self, snap: Snapshot, ts: int,
+                    res: FlushResult) -> None:
+        if not snap.set_meta:
+            return
+        regs_np = None
+        ests = None
+        for row, meta in enumerate(snap.set_meta):
+            if not snap.set_touched[row]:
+                continue
+            if self._forwardable(meta, always=True):
+                if regs_np is None:
+                    regs_np = np.asarray(snap.hll_regs)
+                res.forward.append(ForwardRow(meta, "set",
+                                              regs=regs_np[row].copy()))
+            elif self._emit_local(meta):
+                if ests is None:
+                    ests = np.asarray(hll.estimate(snap.hll_regs))
+                res.metrics.append(self._mk(
+                    meta.name, ts, float(round(ests[row])), meta,
+                    im.GAUGE))
+        res.tally["sets"] = int(snap.set_touched.sum())

@@ -1,0 +1,385 @@
+"""Device-resident metric tables: the TPU replacement for worker maps.
+
+The reference shards series across N worker goroutines, each owning Go
+maps of pointer-y sampler structs (worker.go:60-84 ``WorkerMetrics``,
+:108 ``Upsert``).  Here ALL series of a metric class live in one
+fixed-capacity columnar table in device memory, addressed by a dense row
+id that the host allocates per MetricKey:
+
+  class     state                                   update kernel
+  counter   f32[R]                                  segment add
+  gauge     f32[R]                                  last-write select
+  histo     f32[R,5] stats + f32[R,C] digest planes segment + t-digest merge
+  set       u8[R,16384] HLL registers               scatter-max
+
+Ingest appends to host-side columnar staging buffers; ``device_step``
+flushes staging to the device as a handful of jitted scatter/merge calls
+(padded to power-of-two bucket lengths to bound compile count).  At the
+flush boundary ``swap()`` hands the current device arrays to the flusher
+and re-seeds fresh state — the moral equivalent of the reference's
+worker mutex swap (worker.go:498 ``Flush``), except nothing blocks:
+JAX's async dispatch lets readback of the old interval overlap ingestion
+into the new one.
+
+Row allocation is persistent across intervals (hot series keep their
+row); stale keys are compacted out at swap time when occupancy crosses a
+threshold.  Status checks are host-side (low volume, message-carrying),
+matching their modest role in the reference (samplers/samplers.go:307).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veneur_tpu.ops import hll, segment, tdigest
+from veneur_tpu.protocol import dogstatsd as dsd
+from veneur_tpu.utils import hashing
+
+# jitted, state-donating update steps
+_counter_step = jax.jit(segment.counter_update, donate_argnums=0)
+_gauge_step = jax.jit(segment.gauge_update, donate_argnums=0)
+_histo_stats_step = jax.jit(segment.histo_stats_update, donate_argnums=0)
+_hll_step = jax.jit(hll.insert, donate_argnums=0)
+
+_MIN_BUCKET = 256
+
+
+def _bucket_len(n: int) -> int:
+    b = _MIN_BUCKET
+    while b < n:
+        b *= 2
+    return b
+
+
+def _pad_np(arr: np.ndarray, length: int, fill) -> np.ndarray:
+    out = np.full(length, fill, arr.dtype)
+    out[:len(arr)] = arr
+    return out
+
+
+@dataclass
+class TableConfig:
+    counter_rows: int = 4096
+    gauge_rows: int = 4096
+    histo_rows: int = 4096
+    set_rows: int = 512
+    compression: float = 100.0
+    histo_slots: int = 512  # max samples per row per merge call
+    compact_threshold: float = 0.75
+
+
+@dataclass
+class RowMeta:
+    name: str
+    tags: tuple[str, ...]
+    scope: str
+    type: str
+    last_gen: int = 0
+
+
+class _ClassIndex:
+    """Host-side MetricKey -> row allocation for one metric class."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self.rows: dict[tuple, int] = {}
+        self.meta: list[RowMeta] = []
+        self.touched = np.zeros(capacity, dtype=bool)
+        self.overflow = 0
+
+    def lookup(self, sample_key: tuple, name: str,
+               tags: tuple[str, ...], scope: str, mtype: str,
+               gen: int) -> int | None:
+        row = self.rows.get(sample_key)
+        if row is None:
+            if len(self.meta) >= self.capacity:
+                self.overflow += 1
+                return None
+            row = len(self.meta)
+            self.rows[sample_key] = row
+            self.meta.append(RowMeta(name, tags, scope, mtype, gen))
+        m = self.meta[row]
+        m.last_gen = gen
+        self.touched[row] = True
+        return row
+
+    def occupancy(self) -> int:
+        return len(self.meta)
+
+    def compact(self, keep_gen: int) -> None:
+        """Drop keys untouched since ``keep_gen``; renumber survivors.
+        Only legal at a swap boundary (device state is fresh zeros)."""
+        new_rows: dict[tuple, int] = {}
+        new_meta: list[RowMeta] = []
+        for key, row in self.rows.items():
+            m = self.meta[row]
+            if m.last_gen >= keep_gen:
+                new_rows[key] = len(new_meta)
+                new_meta.append(m)
+        self.rows = new_rows
+        self.meta = new_meta
+        self.touched = np.zeros(self.capacity, dtype=bool)
+
+    def reset_interval(self) -> None:
+        self.touched = np.zeros(self.capacity, dtype=bool)
+
+
+class _Staging:
+    """Columnar append buffers for one class."""
+
+    def __init__(self):
+        self.rows: list[np.ndarray] = []
+        self.values: list[np.ndarray] = []
+        self.weights: list[np.ndarray] = []
+
+    def append(self, rows, values, weights=None):
+        self.rows.append(np.asarray(rows, np.int32))
+        self.values.append(np.asarray(values, np.float32))
+        if weights is not None:
+            self.weights.append(np.asarray(weights, np.float32))
+
+    def take(self):
+        if not self.rows:
+            return None
+        rows = np.concatenate(self.rows)
+        vals = np.concatenate(self.values)
+        wts = np.concatenate(self.weights) if self.weights else None
+        self.rows, self.values, self.weights = [], [], []
+        return rows, vals, wts
+
+    def __len__(self):
+        return sum(len(r) for r in self.rows)
+
+
+@dataclass
+class Snapshot:
+    """Everything the flusher needs from one interval, per class:
+    device arrays (still async; readback happens in the flusher) plus
+    row metadata."""
+    gen: int
+    counters: Any
+    counter_meta: list[RowMeta]
+    counter_touched: np.ndarray
+    gauges: Any
+    gauge_meta: list[RowMeta]
+    gauge_touched: np.ndarray
+    histo_stats: Any
+    histo_means: Any
+    histo_weights: Any
+    histo_meta: list[RowMeta]
+    histo_touched: np.ndarray
+    hll_regs: Any
+    set_meta: list[RowMeta]
+    set_touched: np.ndarray
+    overflow: dict[str, int] = field(default_factory=dict)
+
+
+class MetricTable:
+    def __init__(self, config: TableConfig | None = None):
+        self.config = config or TableConfig()
+        c = self.config
+        self.gen = 0
+        self.capacity = tdigest.capacity_for(c.compression)
+
+        self.counter_idx = _ClassIndex(c.counter_rows)
+        self.gauge_idx = _ClassIndex(c.gauge_rows)
+        self.histo_idx = _ClassIndex(c.histo_rows)
+        self.set_idx = _ClassIndex(c.set_rows)
+
+        self._counter_stage = _Staging()
+        self._gauge_stage = _Staging()
+        self._histo_stage = _Staging()
+        self._set_rows: list[int] = []
+        self._set_members: list[bytes] = []
+
+        self.status: dict[tuple, tuple[float, str, tuple[str, ...]]] = {}
+
+        self._init_state()
+
+    def _init_state(self):
+        c = self.config
+        self.counters = segment.empty_counter_state(c.counter_rows)
+        self.gauges = segment.empty_gauge_state(c.gauge_rows)
+        self.histo_stats = segment.empty_histo_stats(c.histo_rows)
+        self.histo_means, self.histo_weights = tdigest.empty_state(
+            c.histo_rows, self.capacity)
+        self.hll_regs = hll.empty_state(c.set_rows)
+
+    # ------------------------------------------------------------------
+    # ingest
+
+    def ingest(self, s: dsd.Sample) -> bool:
+        """Slow-path single-sample ingest (tests / low-volume paths).
+        Returns False on row-table overflow (sample dropped+counted)."""
+        key = (s.name, s.type, s.tags, s.scope)
+        weight = 1.0 / s.sample_rate
+        if s.type == dsd.COUNTER:
+            row = self.counter_idx.lookup(key, s.name, s.tags, s.scope,
+                                          s.type, self.gen)
+            if row is None:
+                return False
+            self._counter_stage.append([row], [s.value], [weight])
+        elif s.type == dsd.GAUGE:
+            row = self.gauge_idx.lookup(key, s.name, s.tags, s.scope,
+                                        s.type, self.gen)
+            if row is None:
+                return False
+            self._gauge_stage.append([row], [s.value])
+        elif s.type in (dsd.TIMER, dsd.HISTOGRAM):
+            row = self.histo_idx.lookup(key, s.name, s.tags, s.scope,
+                                        s.type, self.gen)
+            if row is None:
+                return False
+            self._histo_stage.append([row], [s.value], [weight])
+        elif s.type == dsd.SET:
+            row = self.set_idx.lookup(key, s.name, s.tags, s.scope,
+                                      s.type, self.gen)
+            if row is None:
+                return False
+            self._set_rows.append(row)
+            member = s.value if isinstance(s.value, bytes) else str(
+                s.value).encode()
+            self._set_members.append(member)
+        elif s.type == dsd.STATUS:
+            self.status[key] = (float(s.value), s.message, s.tags)
+        else:
+            raise ValueError(f"unknown metric type {s.type}")
+        return True
+
+    def ingest_many(self, samples) -> int:
+        dropped = 0
+        for s in samples:
+            if not self.ingest(s):
+                dropped += 1
+        return dropped
+
+    def staged(self) -> int:
+        return (len(self._counter_stage) + len(self._gauge_stage) +
+                len(self._histo_stage) + len(self._set_rows))
+
+    # ------------------------------------------------------------------
+    # device step
+
+    def device_step(self) -> None:
+        """Push all staged samples to the device as batched updates."""
+        c = self.config
+        batch = self._counter_stage.take()
+        if batch is not None:
+            rows, vals, wts = batch
+            b = _bucket_len(len(rows))
+            self.counters = _counter_step(
+                self.counters,
+                jnp.asarray(_pad_np(rows, b, c.counter_rows)),
+                jnp.asarray(_pad_np(vals, b, 0.0)),
+                jnp.asarray(_pad_np(wts, b, 0.0)))
+
+        batch = self._gauge_stage.take()
+        if batch is not None:
+            rows, vals, _ = batch
+            b = _bucket_len(len(rows))
+            self.gauges = _gauge_step(
+                self.gauges,
+                jnp.asarray(_pad_np(rows, b, c.gauge_rows)),
+                jnp.asarray(_pad_np(vals, b, 0.0)))
+
+        batch = self._histo_stage.take()
+        if batch is not None:
+            self._histo_device_step(*batch)
+
+        if self._set_rows:
+            rows = np.asarray(self._set_rows, np.int32)
+            idx, rank = hashing.hash_members(self._set_members)
+            self._set_rows, self._set_members = [], []
+            b = _bucket_len(len(rows))
+            self.hll_regs = _hll_step(
+                self.hll_regs,
+                jnp.asarray(_pad_np(rows, b, c.set_rows)),
+                jnp.asarray(_pad_np(idx.astype(np.int32), b, 0)),
+                jnp.asarray(_pad_np(rank.astype(np.int32), b, 0)))
+
+    def _histo_device_step(self, rows: np.ndarray, vals: np.ndarray,
+                           wts: np.ndarray) -> None:
+        """Histo ingest: local stats scatter + t-digest merge.  The
+        digest merge densifies at most ``histo_slots`` samples per row
+        per call, so heavy rows are split across multiple calls by
+        within-row rank (vectorized on host)."""
+        c = self.config
+        b = _bucket_len(len(rows))
+        self.histo_stats = _histo_stats_step(
+            self.histo_stats,
+            jnp.asarray(_pad_np(rows, b, c.histo_rows)),
+            jnp.asarray(_pad_np(vals, b, 0.0)),
+            jnp.asarray(_pad_np(wts, b, 0.0)))
+
+        # within-row rank -> chunk id
+        order = np.argsort(rows, kind="stable")
+        sorted_rows = rows[order]
+        first = np.ones(len(rows), dtype=bool)
+        first[1:] = sorted_rows[1:] != sorted_rows[:-1]
+        start = np.maximum.accumulate(
+            np.where(first, np.arange(len(rows)), 0))
+        rank = np.arange(len(rows)) - start
+        chunk_of = rank // c.histo_slots
+        n_chunks = int(chunk_of.max()) + 1 if len(rows) else 0
+        for ci in range(n_chunks):
+            sel = order[chunk_of == ci]
+            b = _bucket_len(len(sel))
+            self.histo_means, self.histo_weights = tdigest.add_samples(
+                self.histo_means, self.histo_weights,
+                jnp.asarray(_pad_np(rows[sel], b, c.histo_rows)),
+                jnp.asarray(_pad_np(vals[sel], b, 0.0)),
+                jnp.asarray(_pad_np(wts[sel], b, 0.0)),
+                slots=min(c.histo_slots, b),
+                compression=c.compression)
+
+    # ------------------------------------------------------------------
+    # flush boundary
+
+    def swap(self) -> Snapshot:
+        """End the interval: push remaining staging, hand the device
+        arrays to the caller, re-seed fresh state, maybe compact."""
+        self.device_step()
+        snap = Snapshot(
+            gen=self.gen,
+            counters=self.counters,
+            counter_meta=list(self.counter_idx.meta),
+            counter_touched=self.counter_idx.touched.copy(),
+            gauges=self.gauges,
+            gauge_meta=list(self.gauge_idx.meta),
+            gauge_touched=self.gauge_idx.touched.copy(),
+            histo_stats=self.histo_stats,
+            histo_means=self.histo_means,
+            histo_weights=self.histo_weights,
+            histo_meta=list(self.histo_idx.meta),
+            histo_touched=self.histo_idx.touched.copy(),
+            hll_regs=self.hll_regs,
+            set_meta=list(self.set_idx.meta),
+            set_touched=self.set_idx.touched.copy(),
+            overflow={
+                "counter": self.counter_idx.overflow,
+                "gauge": self.gauge_idx.overflow,
+                "histo": self.histo_idx.overflow,
+                "set": self.set_idx.overflow,
+            },
+        )
+        self._init_state()
+        self.gen += 1
+        for idx in (self.counter_idx, self.gauge_idx, self.histo_idx,
+                    self.set_idx):
+            idx.overflow = 0
+            if idx.occupancy() > idx.capacity * self.config.compact_threshold:
+                idx.compact(keep_gen=self.gen - 1)
+            else:
+                idx.reset_interval()
+        return snap
+
+    def take_status(self):
+        out = self.status
+        self.status = {}
+        return out
