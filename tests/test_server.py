@@ -1,0 +1,217 @@
+"""Server-level integration tests, all in-process: real UDP/TCP sockets
+on port 0, capture sinks, and a local -> global forward chain over real
+loopback HTTP — the same topology-without-a-cluster strategy as the
+reference's setupVeneurServer (server_test.go:134) and forwardFixture
+(forward_test.go:18).
+"""
+
+import socket
+import time
+
+import pytest
+
+from veneur_tpu.core.config import read_config
+from veneur_tpu.core.server import Server
+from veneur_tpu.sinks.simple import CaptureSink
+
+
+@pytest.fixture
+def make_server():
+    servers = []
+
+    def _make(**overrides):
+        data = {"statsd_listen_addresses": ["udp://127.0.0.1:0"],
+                "interval": "50ms",
+                "hostname": "test-host",
+                **overrides}
+        cfg = read_config(data=data)
+        cap = CaptureSink()
+        s = Server(cfg, extra_sinks=[cap])
+        s.start()
+        servers.append(s)
+        return s, cap
+
+    yield _make
+    for s in servers:
+        s.shutdown()
+
+
+def _send_udp(server: Server, *lines: bytes):
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.sendto(b"\n".join(lines),
+                ("127.0.0.1", server.statsd_ports[0]))
+    sock.close()
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_udp_ingest_to_sink(make_server):
+    server, cap = make_server()
+    _send_udp(server, b"hits:3|c", b"hits:4|c", b"temp:7|g")
+    assert _wait(lambda: server.stats["packets_received"] >= 1)
+    server.flush_once()
+    m = {x.name: x for x in cap.metrics}
+    assert m["hits"].value == 7.0
+    assert m["temp"].value == 7.0
+    assert server.stats["metrics_processed"] == 3
+
+
+def test_malformed_counted_not_fatal(make_server):
+    server, cap = make_server()
+    _send_udp(server, b"garbage", b"ok:1|c")
+    assert _wait(lambda: server.stats["metrics_processed"] >= 1)
+    assert server.stats["packet_errors"] >= 1
+    server.flush_once()
+    assert any(x.name == "ok" for x in cap.metrics)
+
+
+def test_oversize_packet_rejected(make_server):
+    server, _ = make_server(metric_max_length=64)
+    server.handle_packet(b"x" * 100)
+    assert server.stats["packet_errors"] == 1
+
+
+def test_flush_ticker_runs(make_server):
+    server, cap = make_server()
+    _send_udp(server, b"tick:1|c")
+    assert _wait(lambda: bool(cap.metrics), timeout=5.0)
+
+
+def test_tcp_ingest(make_server):
+    server, cap = make_server(
+        statsd_listen_addresses=["tcp://127.0.0.1:0"])
+    with socket.create_connection(
+            ("127.0.0.1", server.statsd_ports[0])) as s:
+        s.sendall(b"tcp.hits:5|c\ntcp.hits:6|c\n")
+        time.sleep(0.1)
+    assert _wait(lambda: server.stats["metrics_processed"] >= 2)
+    server.flush_once()
+    m = {x.name: x.value for x in cap.metrics}
+    assert m["tcp.hits"] == 11.0
+
+
+def test_http_healthcheck_and_version(make_server):
+    import urllib.request
+    server, _ = make_server(http_address="127.0.0.1:0")
+    base = f"http://127.0.0.1:{server.http_port}"
+    assert urllib.request.urlopen(base + "/healthcheck").read() == b"ok"
+    assert urllib.request.urlopen(base + "/version").read()
+
+
+def test_events_reach_sink(make_server):
+    server, cap = make_server()
+    _send_udp(server, b"_e{5,5}:hello|world|#env:t")
+    assert _wait(lambda: bool(server.events))
+    server.flush_once()
+    assert any(getattr(o, "title", "") == "hello" for o in cap.other)
+
+
+def test_forward_chain_local_to_global(make_server):
+    """local veneur -> (real loopback HTTP /import) -> global veneur,
+    the forwardFixture topology (forward_test.go:18-60).  Long interval
+    so the manual flush_once calls drive the chain deterministically."""
+    glob, gcap = make_server(http_address="127.0.0.1:0",
+                             percentiles=[0.5, 0.99],
+                             aggregates=["min", "max", "count"],
+                             interval="10s")
+    local, lcap = make_server(
+        forward_address=f"http://127.0.0.1:{glob.http_port}",
+        interval="10s")
+
+    # timers forward their digests; global counters forward totals
+    for v in range(100):
+        _send_udp(local, f"fwd.lat:{v}|ms".encode())
+    _send_udp(local, b"fwd.hits:9|c|#veneurglobalonly")
+    assert _wait(lambda: local.stats["metrics_processed"] >= 101)
+
+    local.flush_once()
+    assert _wait(lambda: glob.stats["imports_received"] >= 2)
+    glob.flush_once()
+
+    gm = {x.name: x for x in gcap.metrics}
+    assert gm["fwd.hits"].value == 9.0
+    assert gm["fwd.lat.count"].value == pytest.approx(100)
+    assert gm["fwd.lat.50percentile"].value == pytest.approx(49.5,
+                                                             abs=2.0)
+    assert gm["fwd.lat.99percentile"].value == pytest.approx(99,
+                                                             abs=2.0)
+    assert gm["fwd.lat.min"].value == 0.0
+    assert gm["fwd.lat.max"].value == 99.0
+    # the local node emitted aggregates but no percentiles, and did not
+    # emit the global-only counter
+    lm = {x.name for x in lcap.metrics}
+    assert "fwd.lat.count" in lm
+    assert not any("percentile" in n for n in lm)
+    assert "fwd.hits" not in lm
+
+
+def test_forward_sets_merge_cardinality(make_server):
+    glob, gcap = make_server(http_address="127.0.0.1:0",
+                             interval="10s")
+    l1, _ = make_server(
+        forward_address=f"http://127.0.0.1:{glob.http_port}",
+        interval="10s")
+    l2, _ = make_server(
+        forward_address=f"http://127.0.0.1:{glob.http_port}",
+        interval="10s")
+    for i in range(300):
+        _send_udp(l1, f"uniq:u{i}|s".encode())
+        _send_udp(l2, f"uniq:u{i + 150}|s".encode())  # 150 overlap
+    assert _wait(lambda: l1.stats["metrics_processed"] >= 300 and
+                 l2.stats["metrics_processed"] >= 300)
+    l1.flush_once()
+    l2.flush_once()
+    assert _wait(lambda: glob.stats["imports_received"] >= 2)
+    glob.flush_once()
+    gm = {x.name: x for x in gcap.metrics}
+    assert gm["uniq"].value == pytest.approx(450, rel=0.05)
+
+
+def test_service_check_status_flush(make_server):
+    server, cap = make_server()
+    _send_udp(server, b"_sc|db.up|2|m:down hard")
+    assert _wait(lambda: server.stats["metrics_processed"] >= 1)
+    server.flush_once()
+    m = [x for x in cap.metrics if x.name == "db.up"]
+    assert m and m[0].value == 2.0 and m[0].message == "down hard"
+    assert m[0].type == "status"
+
+
+def test_malformed_import_item_does_not_wedge_table(make_server):
+    """A bad import item (wrong shapes) is dropped per-item; later
+    imports and flushes keep working."""
+    import base64
+    import json
+    import urllib.request
+    import zlib
+    glob, gcap = make_server(http_address="127.0.0.1:0", interval="10s")
+    bad = [
+        {"kind": "histo", "name": "bad", "tags": [], "scope": "",
+         "type": "timer", "stats": [1, 2, 3],  # wrong width
+         "means": base64.b64encode(b"\x00" * 8).decode(),
+         "weights": base64.b64encode(b"\x00" * 4).decode()},
+        {"kind": "set", "name": "badset", "tags": [], "scope": "",
+         "regs": base64.b64encode(zlib.compress(b"\x01" * 7)).decode()},
+        {"kind": "counter", "name": "good", "tags": [], "value": 5.0},
+    ]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{glob.http_port}/import",
+        data=json.dumps(bad).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    resp = json.loads(urllib.request.urlopen(req).read())
+    assert resp["accepted"] == 1
+    glob.flush_once()  # must not raise
+    assert any(x.name == "good" and x.value == 5.0
+               for x in gcap.metrics)
+    # table still functional afterwards
+    _send_udp(glob, b"after:1|c")
+    assert _wait(lambda: glob.stats["metrics_processed"] >= 1)
+    glob.flush_once()
+    assert any(x.name == "after" for x in gcap.metrics)

@@ -1,0 +1,129 @@
+"""veneur-emit equivalent: CLI metric emitter and load generator
+(reference cmd/veneur-emit: statsd UDP/TCP modes, -command timing
+wrapper).
+
+Examples:
+  python -m veneur_tpu.cli.emit -hostport udp://127.0.0.1:8126 \
+      -name daemontools.service.starts -count 1 -tag svc:foo
+  python -m veneur_tpu.cli.emit -hostport udp://127.0.0.1:8126 \
+      -name cmd.duration -command sleep 0.2
+  python -m veneur_tpu.cli.emit -hostport udp://127.0.0.1:8126 \
+      -bench-count 1000000 -bench-names 1000   # load generator
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import socket
+import subprocess
+import sys
+import time
+
+from veneur_tpu.protocol.addr import parse_addr
+
+
+def _open(hostport: str):
+    scheme, host, port, path = parse_addr(hostport)
+    if scheme == "udp":
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect((host, port))
+        return s, True
+    if scheme == "tcp":
+        s = socket.create_connection((host, port))
+        return s, False
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+    s.connect(path)
+    return s, True
+
+
+def _send(sock, datagram: bool, payload: bytes):
+    if datagram:
+        sock.send(payload)
+    else:
+        sock.sendall(payload + b"\n")
+
+
+def build_line(name: str, value, mtype: str, tags: list[str],
+               rate: float = 1.0) -> bytes:
+    parts = [f"{name}:{value}|{mtype}"]
+    if rate != 1.0:
+        parts.append(f"@{rate}")
+    if tags:
+        parts.append("#" + ",".join(tags))
+    return "|".join(parts).encode()
+
+
+def run_bench(sock, datagram: bool, count: int, names: int,
+              mtype: str, tags: list[str], batch: int = 25) -> float:
+    """Blast ``count`` samples over ``names`` metric names; returns
+    seconds elapsed (the role of the BASELINE load-generator configs)."""
+    start = time.perf_counter()
+    lines = []
+    for i in range(count):
+        lines.append(build_line(f"bench.metric.{i % names}",
+                                round(random.random() * 100, 3), mtype,
+                                tags))
+        if len(lines) >= batch:
+            _send(sock, datagram, b"\n".join(lines))
+            lines = []
+    if lines:
+        _send(sock, datagram, b"\n".join(lines))
+    return time.perf_counter() - start
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="veneur-emit")
+    ap.add_argument("-hostport", required=True)
+    ap.add_argument("-name")
+    ap.add_argument("-count", type=float)
+    ap.add_argument("-gauge", type=float)
+    ap.add_argument("-timing", type=float)
+    ap.add_argument("-set")
+    ap.add_argument("-tag", action="append", default=[])
+    ap.add_argument("-rate", type=float, default=1.0)
+    ap.add_argument("-command", nargs=argparse.REMAINDER,
+                    help="run command, emit wall time as timer")
+    ap.add_argument("-bench-count", type=int)
+    ap.add_argument("-bench-names", type=int, default=1000)
+    ap.add_argument("-bench-type", default="c")
+    args = ap.parse_args(argv)
+
+    sock, datagram = _open(args.hostport)
+
+    if args.bench_count:
+        elapsed = run_bench(sock, datagram, args.bench_count,
+                            args.bench_names, args.bench_type, args.tag)
+        print(f"{args.bench_count} samples in {elapsed:.3f}s "
+              f"({args.bench_count / elapsed:,.0f}/s)")
+        return 0
+
+    if args.command:
+        t0 = time.perf_counter()
+        rc = subprocess.call(args.command)
+        ms = (time.perf_counter() - t0) * 1000.0
+        _send(sock, datagram,
+              build_line(args.name or "command.duration", round(ms, 3),
+                         "ms", args.tag))
+        return rc
+
+    if args.name is None:
+        print("need -name (or -command/-bench-count)", file=sys.stderr)
+        return 1
+    if args.count is not None:
+        _send(sock, datagram, build_line(args.name, args.count, "c",
+                                         args.tag, args.rate))
+    if args.gauge is not None:
+        _send(sock, datagram, build_line(args.name, args.gauge, "g",
+                                         args.tag))
+    if args.timing is not None:
+        _send(sock, datagram, build_line(args.name, args.timing, "ms",
+                                         args.tag, args.rate))
+    if args.set is not None:
+        _send(sock, datagram, build_line(args.name, args.set, "s",
+                                         args.tag))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,62 @@
+"""veneur-tpu server binary (reference cmd/veneur/main.go:25).
+
+Usage: python -m veneur_tpu.cli.main -f config.yaml
+       python -m veneur_tpu.cli.main -f config.yaml --validate-config
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+from veneur_tpu.core.config import read_config
+from veneur_tpu.core.server import Server
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="veneur-tpu")
+    ap.add_argument("-f", dest="config", required=True,
+                    help="path to config YAML")
+    ap.add_argument("--validate-config", action="store_true",
+                    help="parse + validate config, then exit")
+    ap.add_argument("--validate-config-strict", action="store_true",
+                    help="like --validate-config, but unknown keys fail")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    try:
+        cfg = read_config(args.config,
+                          strict=args.validate_config_strict)
+    except (ValueError, OSError) as e:
+        print(f"config error: {e}", file=sys.stderr)
+        return 1
+    if args.validate_config or args.validate_config_strict:
+        print("config ok")
+        return 0
+
+    server = Server(cfg)
+    server.start()
+    stop = threading.Event()
+
+    def _sig(*_):
+        stop.set()
+
+    signal.signal(signal.SIGINT, _sig)
+    signal.signal(signal.SIGTERM, _sig)
+    logging.getLogger("veneur_tpu").info(
+        "serving: statsd=%s http=%s role=%s interval=%ss",
+        cfg.statsd_listen_addresses, cfg.http_address,
+        "local" if cfg.is_local() else "global", cfg.interval_seconds())
+    stop.wait()
+    server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

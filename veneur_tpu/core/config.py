@@ -1,0 +1,198 @@
+"""YAML configuration with environment overrides and validation.
+
+Mirrors the reference's config system (config.go struct of ~130 YAML
+keys; config_parse.go:102 ``ReadConfig``): a single YAML file, semi-
+strict parsing (unknown keys warn, ``strict`` mode fails), ``VENEUR_*``
+environment-variable overrides (config_parse.go:144 envconfig), and
+defaults applied afterwards (config_parse.go:153, defaults at :14-24).
+
+TPU-specific sizing knobs live under ``tpu_*`` keys (table row
+capacities, digest compression, merge slot width) — these have no
+reference equivalent because Go maps grow unboundedly; device tables
+are fixed-capacity with compaction.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+from dataclasses import dataclass, field, fields
+
+log = logging.getLogger("veneur_tpu.config")
+
+try:
+    import yaml
+except ImportError:  # pragma: no cover
+    yaml = None
+
+_DURATION_RE = re.compile(r"^\s*([\d.]+)\s*(ms|s|m|h|us)?\s*$")
+_DURATION_UNITS = {"us": 1e-6, "ms": 1e-3, "s": 1.0, "m": 60.0,
+                   "h": 3600.0, None: 1.0}
+
+
+def parse_duration(text: str | float | int) -> float:
+    """'10s' / '50ms' / 10 -> seconds (reference durations are Go
+    duration strings)."""
+    if isinstance(text, (int, float)):
+        return float(text)
+    m = _DURATION_RE.match(text)
+    if not m:
+        raise ValueError(f"bad duration: {text!r}")
+    return float(m.group(1)) * _DURATION_UNITS[m.group(2)]
+
+
+@dataclass
+class Config:
+    # lifecycle / identity
+    hostname: str = ""
+    tags: list[str] = field(default_factory=list)
+    interval: str = "10s"
+    flush_watchdog_missed_flushes: int = 0
+    synchronize_with_interval: bool = False
+
+    # listeners (reference networking.go; url-style addresses,
+    # protocol/addr.go:18)
+    statsd_listen_addresses: list[str] = field(default_factory=list)
+    ssf_listen_addresses: list[str] = field(default_factory=list)
+    grpc_listen_addresses: list[str] = field(default_factory=list)
+    http_address: str = ""
+    num_readers: int = 1
+    metric_max_length: int = 4096
+    trace_max_length_bytes: int = 16 * 1024 * 1024
+    read_buffer_size_bytes: int = 2 * 1048576
+
+    # aggregation
+    percentiles: list[float] = field(default_factory=lambda: [0.5, 0.75,
+                                                              0.99])
+    aggregates: list[str] = field(default_factory=lambda: ["min", "max",
+                                                           "count"])
+    count_unique_timeseries: bool = False
+
+    # forwarding / tiering
+    forward_address: str = ""
+    forward_use_grpc: bool = False
+
+    # sinks
+    debug_flushed_metrics: bool = False
+    blackhole_sink: bool = False
+    datadog_api_key: str = ""
+    datadog_api_hostname: str = "https://app.datadoghq.com"
+    datadog_flush_max_per_body: int = 25000
+    prometheus_repeater_address: str = ""
+    prometheus_network_type: str = "tcp"
+    flush_file: str = ""  # localfile plugin
+    aws_s3_bucket: str = ""
+    aws_region: str = ""
+    kafka_broker: str = ""
+
+    # tls
+    tls_key: str = ""
+    tls_certificate: str = ""
+    tls_authority_certificate: str = ""
+
+    # observability
+    enable_profiling: bool = False
+    sentry_dsn: str = ""
+    stats_address: str = ""
+
+    # tpu table sizing (no reference equivalent; see module docstring)
+    tpu_counter_rows: int = 16384
+    tpu_gauge_rows: int = 16384
+    tpu_histo_rows: int = 16384
+    tpu_set_rows: int = 1024
+    tpu_compression: float = 100.0
+    tpu_histo_slots: int = 512
+
+    def interval_seconds(self) -> float:
+        return parse_duration(self.interval)
+
+    def is_local(self) -> bool:
+        """A node with a forward address is a 'local' tier instance
+        (reference server.go:1609 IsLocal)."""
+        return bool(self.forward_address)
+
+    def validate(self) -> list[str]:
+        problems = []
+        try:
+            if self.interval_seconds() <= 0:
+                problems.append("interval must be positive")
+        except ValueError as e:
+            problems.append(str(e))
+        for p in self.percentiles:
+            if not (0.0 < p < 1.0):
+                problems.append(f"percentile out of range: {p}")
+        known_aggs = {"min", "max", "median", "avg", "count", "sum",
+                      "hmean"}
+        for a in self.aggregates:
+            if a not in known_aggs:
+                problems.append(f"unknown aggregate: {a}")
+        if self.metric_max_length <= 0:
+            problems.append("metric_max_length must be positive")
+        for n in ("tpu_counter_rows", "tpu_gauge_rows", "tpu_histo_rows",
+                  "tpu_set_rows"):
+            if getattr(self, n) <= 0:
+                problems.append(f"{n} must be positive")
+        return problems
+
+
+_FIELD_TYPES = {f.name: f.type for f in fields(Config)}
+
+
+def _coerce(name: str, raw: str):
+    """Coerce an environment-variable string to the field's type."""
+    current = getattr(Config(), name)
+    if isinstance(current, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(current, int):
+        return int(raw)
+    if isinstance(current, float):
+        return float(raw)
+    if isinstance(current, list):
+        items = [x.strip() for x in raw.split(",") if x.strip()]
+        if current and isinstance(current[0], float):
+            return [float(x) for x in items]
+        return items
+    return raw
+
+
+def read_config(path: str | None = None, data: dict | None = None,
+                strict: bool = False, env: dict | None = None) -> Config:
+    """Load config: YAML file -> env overrides -> defaults/validation.
+
+    ``strict`` mirrors -validate-config-strict (cmd/veneur/main.go:17):
+    unknown keys become errors instead of warnings.
+    """
+    raw: dict = {}
+    if path is not None:
+        if yaml is None:
+            raise RuntimeError("pyyaml unavailable")
+        with open(path) as f:
+            raw = yaml.safe_load(f) or {}
+    if data:
+        raw.update(data)
+
+    cfg = Config()
+    unknown = []
+    for key, value in raw.items():
+        if key in _FIELD_TYPES:
+            if value is not None:
+                setattr(cfg, key, value)
+        else:
+            unknown.append(key)
+    if unknown:
+        msg = f"unknown config keys: {sorted(unknown)}"
+        if strict:
+            raise ValueError(msg)
+        log.warning(msg)
+
+    env = os.environ if env is None else env
+    for name in _FIELD_TYPES:
+        env_key = "VENEUR_" + name.upper()
+        if env_key in env:
+            setattr(cfg, name, _coerce(name, env[env_key]))
+
+    problems = cfg.validate()
+    if problems:
+        raise ValueError("; ".join(problems))
+    return cfg

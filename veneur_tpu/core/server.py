@@ -1,0 +1,429 @@
+"""Server lifecycle: listeners, flush ticker, sinks, forwarding, HTTP.
+
+The role of reference server.go (``NewFromConfig`` :299, ``Start``
+:886, ``Serve`` :1478, ``Shutdown`` :1593) and networking.go: construct
+every layer from config, run ingest listeners, tick the flush clock,
+and tear down cleanly.
+
+Concurrency model: the Go original runs one goroutine per worker shard;
+here the device table IS the aggregation worker, so threads exist only
+at the edges — reader threads parse datagrams and append to columnar
+staging under a short lock, a flush thread swaps the table every
+interval, and sink flushes fan out to a thread pool.  The flush
+watchdog mirrors reference server.go:1031 FlushWatchdog: if too many
+intervals elapse with no flush, crash loudly so a supervisor restarts
+the process.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import os
+import socket
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+from veneur_tpu import __version__
+from veneur_tpu.core import metrics as im
+from veneur_tpu.core.config import Config
+from veneur_tpu.core.flusher import Flusher, FlushResult
+from veneur_tpu.core.table import MetricTable, TableConfig
+from veneur_tpu.forward import http_import
+from veneur_tpu.protocol import dogstatsd as dsd
+from veneur_tpu.protocol.addr import parse_addr
+from veneur_tpu.sinks import base as sinks_base
+from veneur_tpu.sinks.datadog import DatadogMetricSink
+from veneur_tpu.sinks.prometheus import PrometheusRepeaterSink
+from veneur_tpu.sinks.simple import (BlackholeSink, DebugSink,
+                                     LocalFilePlugin, S3ArchivePlugin)
+
+log = logging.getLogger("veneur_tpu.server")
+
+
+class Server:
+    def __init__(self, config: Config, extra_sinks: list | None = None,
+                 extra_plugins: list | None = None):
+        self.config = config
+        self.interval = config.interval_seconds()
+        self.is_local = config.is_local()
+        self.table = MetricTable(TableConfig(
+            counter_rows=config.tpu_counter_rows,
+            gauge_rows=config.tpu_gauge_rows,
+            histo_rows=config.tpu_histo_rows,
+            set_rows=config.tpu_set_rows,
+            compression=config.tpu_compression,
+            histo_slots=config.tpu_histo_slots))
+        self.lock = threading.Lock()
+        self.flusher = Flusher(
+            is_local=self.is_local,
+            percentiles=tuple(config.percentiles),
+            aggregates=tuple(config.aggregates),
+            hostname=config.hostname or socket.gethostname(),
+            tags=tuple(config.tags))
+
+        self.metric_sinks: list = list(extra_sinks or [])
+        self.plugins: list = list(extra_plugins or [])
+        self._build_sinks()
+
+        self.events: list[dsd.Event] = []
+        self.checks: list[dsd.ServiceCheck] = []
+        self.stats: dict[str, int] = {
+            "packets_received": 0, "packet_errors": 0,
+            "metrics_processed": 0, "metrics_dropped": 0,
+            "imports_received": 0, "flushes": 0,
+        }
+
+        self._shutdown = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._sockets: list[socket.socket] = []
+        self._httpd: http.server.ThreadingHTTPServer | None = None
+        self._pool = ThreadPoolExecutor(max_workers=8)
+        self.last_flush = time.monotonic()
+        self.http_port: int | None = None
+        self.statsd_ports: list[int] = []
+
+    # ------------------------------------------------------------------
+    # construction
+
+    def _build_sinks(self) -> None:
+        c = self.config
+        if c.blackhole_sink:
+            self.metric_sinks.append(BlackholeSink())
+        if c.debug_flushed_metrics:
+            self.metric_sinks.append(DebugSink())
+        if c.datadog_api_key:
+            self.metric_sinks.append(DatadogMetricSink(
+                c.datadog_api_key, c.datadog_api_hostname,
+                self.interval, hostname=c.hostname,
+                flush_max_per_body=c.datadog_flush_max_per_body))
+        if c.prometheus_repeater_address:
+            self.metric_sinks.append(PrometheusRepeaterSink(
+                c.prometheus_repeater_address, c.prometheus_network_type))
+        if c.flush_file:
+            self.plugins.append(LocalFilePlugin(c.flush_file,
+                                                c.hostname))
+        if c.aws_s3_bucket:
+            self.plugins.append(S3ArchivePlugin(
+                c.aws_s3_bucket, spool_dir="s3_spool",
+                hostname=c.hostname))
+
+    # ------------------------------------------------------------------
+    # ingest
+
+    def handle_packet(self, data: bytes) -> None:
+        """Parse one datagram (possibly multi-line) into the table
+        (reference server.go:1253 processMetricPacket -> :1103
+        HandleMetricPacket)."""
+        if len(data) > self.config.metric_max_length:
+            self.stats["packet_errors"] += 1
+            return
+        self.stats["packets_received"] += 1
+        for line in dsd.split_packet(data):
+            try:
+                parsed = dsd.parse_line(line)
+            except dsd.ParseError:
+                self.stats["packet_errors"] += 1
+                continue
+            self.ingest_parsed(parsed)
+
+    def ingest_parsed(self, parsed) -> None:
+        if isinstance(parsed, dsd.Sample):
+            with self.lock:
+                ok = self.table.ingest(parsed)
+            self.stats["metrics_processed"] += 1
+            if not ok:
+                self.stats["metrics_dropped"] += 1
+        elif isinstance(parsed, dsd.Event):
+            with self.lock:
+                self.events.append(parsed)
+        elif isinstance(parsed, dsd.ServiceCheck):
+            sample = dsd.Sample(
+                name=parsed.name, type=dsd.STATUS,
+                value=float(parsed.status), tags=parsed.tags,
+                message=parsed.message)
+            with self.lock:
+                self.table.ingest(sample)
+                self.checks.append(parsed)
+            self.stats["metrics_processed"] += 1
+
+    # ------------------------------------------------------------------
+    # listeners
+
+    def start(self) -> None:
+        for addr in self.config.statsd_listen_addresses:
+            self._start_statsd(addr)
+        if self.config.http_address:
+            self._start_http(self.config.http_address)
+        t = threading.Thread(target=self._flush_loop, daemon=True,
+                             name="flush")
+        t.start()
+        self._threads.append(t)
+        if self.config.flush_watchdog_missed_flushes > 0:
+            t = threading.Thread(target=self._watchdog, daemon=True,
+                                 name="watchdog")
+            t.start()
+            self._threads.append(t)
+        for s in self.metric_sinks:
+            s.start()
+
+    def _start_statsd(self, addr: str) -> None:
+        scheme, host, port, path = parse_addr(addr)
+        if scheme == "udp":
+            n = max(1, self.config.num_readers)
+            for i in range(n):
+                sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                if n > 1:
+                    sock.setsockopt(socket.SOL_SOCKET,
+                                    socket.SO_REUSEPORT, 1)
+                sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                                self.config.read_buffer_size_bytes)
+                sock.bind((host, port))
+                port = sock.getsockname()[1]  # resolve port 0 once
+                self._sockets.append(sock)
+                t = threading.Thread(target=self._udp_reader,
+                                     args=(sock,), daemon=True,
+                                     name=f"udp-reader-{i}")
+                t.start()
+                self._threads.append(t)
+            self.statsd_ports.append(port)
+        elif scheme == "tcp":
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind((host, port))
+            sock.listen(128)
+            self._sockets.append(sock)
+            self.statsd_ports.append(sock.getsockname()[1])
+            t = threading.Thread(target=self._tcp_acceptor,
+                                 args=(sock,), daemon=True,
+                                 name="tcp-acceptor")
+            t.start()
+            self._threads.append(t)
+        elif scheme == "unix":
+            if os.path.exists(path):
+                os.unlink(path)
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+            sock.bind(path)
+            self._sockets.append(sock)
+            t = threading.Thread(target=self._udp_reader,
+                                 args=(sock,), daemon=True,
+                                 name="unixgram-reader")
+            t.start()
+            self._threads.append(t)
+        else:
+            raise ValueError(f"unsupported statsd address {addr!r}")
+
+    def _udp_reader(self, sock: socket.socket) -> None:
+        """Blocking datagram read loop (reference server.go:1240
+        ReadMetricSocket)."""
+        bufsize = self.config.metric_max_length + 1
+        while not self._shutdown.is_set():
+            try:
+                data = sock.recv(bufsize)
+            except OSError:
+                return
+            if data:
+                self.handle_packet(data)
+
+    def _tcp_acceptor(self, sock: socket.socket) -> None:
+        while not self._shutdown.is_set():
+            try:
+                conn, _ = sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._tcp_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+
+    def _tcp_conn(self, conn: socket.socket) -> None:
+        """Line-delimited statsd over TCP with idle timeout (reference
+        server.go:1374 handleTCPGoroutine, 10min timeout :80)."""
+        conn.settimeout(600)
+        buf = b""
+        try:
+            while not self._shutdown.is_set():
+                chunk = conn.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if line:
+                        self.handle_packet(line)
+                if len(buf) > self.config.metric_max_length:
+                    self.stats["packet_errors"] += 1
+                    buf = b""
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    # ------------------------------------------------------------------
+    # http api
+
+    def _start_http(self, address: str) -> None:
+        host, _, port = address.rpartition(":")
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _ok(self, body: bytes = b"ok",
+                    ctype: str = "text/plain"):
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthcheck":
+                    self._ok()
+                elif self.path == "/version":
+                    self._ok(__version__.encode())
+                elif self.path == "/builddate":
+                    self._ok(b"dev")
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                if self.path == "/import":
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(length)
+                    try:
+                        items = http_import.decode_body(
+                            body,
+                            self.headers.get("Content-Encoding", ""))
+                        with server.lock:
+                            acc, dropped = http_import.apply_import(
+                                server.table, items)
+                        server.stats["imports_received"] += acc
+                        server.stats["metrics_dropped"] += dropped
+                        self._ok(json.dumps({"accepted": acc}).encode(),
+                                 "application/json")
+                    except (ValueError, KeyError) as e:
+                        self.send_error(400, str(e))
+                else:
+                    self.send_error(404)
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            (host or "127.0.0.1", int(port)), Handler)
+        self.http_port = self._httpd.server_port
+        t = threading.Thread(target=self._httpd.serve_forever,
+                             daemon=True, name="http")
+        t.start()
+        self._threads.append(t)
+
+    # ------------------------------------------------------------------
+    # flush
+
+    def _flush_loop(self) -> None:
+        next_tick = time.monotonic() + self.interval
+        if self.config.synchronize_with_interval:
+            now = time.time()
+            next_tick = time.monotonic() + (
+                self.interval - now % self.interval)
+        while not self._shutdown.wait(
+                max(0.0, next_tick - time.monotonic())):
+            next_tick += self.interval
+            try:
+                self.flush_once()
+            except Exception:
+                log.exception("flush failed")
+
+    def flush_once(self) -> FlushResult:
+        """One flush: swap table state, read out, emit to sinks, forward
+        (reference flusher.go:28 Flush)."""
+        if self._shutdown.is_set():
+            return FlushResult()
+        with self.lock:
+            snap = self.table.swap()
+            events = self.events
+            checks = self.checks
+            self.events, self.checks = [], []
+            status = self.table.take_status()
+        res = self.flusher.flush(snap)
+        self.last_flush = time.monotonic()
+        self.stats["flushes"] += 1
+
+        ts = int(time.time())
+        for (name, _, tags, _), (val, msg, stags) in (
+                (k, v) for k, v in status.items()):
+            res.metrics.append(im.InterMetric(
+                name=name, timestamp=ts, value=val, tags=stags,
+                type=im.STATUS, message=msg))
+
+        futures = []
+        for sink in self.metric_sinks:
+            batch = sinks_base.route(res.metrics, sink.name, sink
+                                     if isinstance(sink,
+                                                   sinks_base.SinkBase)
+                                     else None)
+            futures.append(self._pool.submit(self._safe_sink_flush,
+                                             sink, batch,
+                                             events + checks))
+        for plugin in self.plugins:
+            futures.append(self._pool.submit(
+                plugin.flush, list(res.metrics),
+                self.flusher.hostname))
+        if self.is_local and res.forward:
+            futures.append(self._pool.submit(self._forward,
+                                             res.forward))
+        for f in futures:
+            f.result(timeout=max(self.interval, 10.0))
+        return res
+
+    @staticmethod
+    def _safe_sink_flush(sink, batch, other) -> None:
+        try:
+            sink.flush(batch)
+            if other:
+                sink.flush_other_samples(other)
+        except Exception:
+            log.exception("sink %s flush failed", sink.name)
+
+    def _forward(self, rows) -> None:
+        """POST mergeable state upstream (reference flusher.go:363
+        flushForward; errors dropped-and-counted, never retried)."""
+        body, headers = http_import.encode_rows(rows)
+        url = self.config.forward_address.rstrip("/") + "/import"
+        if not url.startswith("http"):
+            url = "http://" + url
+        req = urllib.request.Request(url, data=body, headers=headers,
+                                     method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=10.0) as r:
+                r.read()
+        except OSError as e:
+            self.stats["metrics_dropped"] += len(rows)
+            log.warning("forward failed: %s", e)
+
+    # ------------------------------------------------------------------
+
+    def _watchdog(self) -> None:
+        """Crash if flushes stop happening (reference server.go:1031
+        FlushWatchdog: deliberate crash-and-restart)."""
+        allowed = self.config.flush_watchdog_missed_flushes
+        while not self._shutdown.wait(self.interval):
+            missed = (time.monotonic() - self.last_flush) / self.interval
+            if missed > allowed:
+                log.critical(
+                    "flush watchdog: %.1f intervals without a flush "
+                    "(allowed %d) — exiting for supervisor restart",
+                    missed, allowed)
+                os._exit(2)
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        for s in self._sockets:
+            try:
+                s.close()
+            except OSError:
+                pass
+        if self._httpd:
+            self._httpd.shutdown()
+        self._pool.shutdown(wait=False)

@@ -46,12 +46,17 @@ _counter_step = jax.jit(segment.counter_update, donate_argnums=0)
 _gauge_step = jax.jit(segment.gauge_update, donate_argnums=0)
 _histo_stats_step = jax.jit(segment.histo_stats_update, donate_argnums=0)
 _hll_step = jax.jit(hll.insert, donate_argnums=0)
+# global-tier merge steps (forwarded partial state; duplicates within a
+# batch reduce correctly because every column is an associative scatter)
+_histo_stats_merge = jax.jit(segment.merge_histo_stats, donate_argnums=0)
+_hll_merge_rows = jax.jit(hll.merge_rows, donate_argnums=0)
 
 _MIN_BUCKET = 256
+_MIN_BUCKET_WIDE = 8  # for batches whose rows are whole planes
 
 
-def _bucket_len(n: int) -> int:
-    b = _MIN_BUCKET
+def _bucket_len(n: int, wide: bool = False) -> int:
+    b = _MIN_BUCKET_WIDE if wide else _MIN_BUCKET
     while b < n:
         b *= 2
     return b
@@ -198,6 +203,17 @@ class MetricTable:
         self._set_rows: list[int] = []
         self._set_members: list[bytes] = []
 
+        # global-tier import staging (merge of forwarded state; the
+        # receive half of reference worker.go:438 ImportMetricGRPC).
+        # Imported centroids merge into digests ONLY — their aggregate
+        # stats arrive separately via the forwarded stat row, so pushing
+        # them through the raw-sample path would double-count.
+        self._digest_stage = _Staging()
+        self._stats_import_rows: list[int] = []
+        self._stats_import_vals: list[np.ndarray] = []
+        self._set_import_rows: list[int] = []
+        self._set_import_regs: list[np.ndarray] = []
+
         self.status: dict[tuple, tuple[float, str, tuple[str, ...]]] = {}
 
         self._init_state()
@@ -261,7 +277,86 @@ class MetricTable:
 
     def staged(self) -> int:
         return (len(self._counter_stage) + len(self._gauge_stage) +
-                len(self._histo_stage) + len(self._set_rows))
+                len(self._histo_stage) + len(self._digest_stage) +
+                len(self._set_rows) +
+                len(self._stats_import_rows) + len(self._set_import_rows))
+
+    # ------------------------------------------------------------------
+    # global-tier import (merge of forwarded mergeable state)
+
+    def import_counter(self, name: str, tags: tuple[str, ...],
+                       value: float) -> bool:
+        """Merge a forwarded counter total (+=; reference
+        samplers/samplers.go:208).  Imported counters/gauges are forced
+        global scope (reference worker.go:445-447)."""
+        key = (name, dsd.COUNTER, tags, dsd.SCOPE_GLOBAL)
+        row = self.counter_idx.lookup(key, name, tags, dsd.SCOPE_GLOBAL,
+                                      dsd.COUNTER, self.gen)
+        if row is None:
+            return False
+        self._counter_stage.append([row], [value], [1.0])
+        return True
+
+    def import_gauge(self, name: str, tags: tuple[str, ...],
+                     value: float) -> bool:
+        key = (name, dsd.GAUGE, tags, dsd.SCOPE_GLOBAL)
+        row = self.gauge_idx.lookup(key, name, tags, dsd.SCOPE_GLOBAL,
+                                    dsd.GAUGE, self.gen)
+        if row is None:
+            return False
+        self._gauge_stage.append([row], [value])
+        return True
+
+    def import_histo(self, name: str, mtype: str, tags: tuple[str, ...],
+                     stats: np.ndarray, means: np.ndarray,
+                     weights: np.ndarray,
+                     scope: str = dsd.SCOPE_DEFAULT) -> bool:
+        """Merge a forwarded digest: centroids re-enter as weighted
+        samples through the normal merge kernel (a centroid IS a
+        weighted sample); the 5-column stat row merges by scatter.
+
+        Shapes are validated BEFORE anything is staged: a malformed
+        item staged with the wrong width would make the next
+        device_step raise with the bad entry still queued, wedging the
+        whole table until restart."""
+        stats = np.asarray(stats, np.float32)
+        means = np.asarray(means, np.float32)
+        weights = np.asarray(weights, np.float32)
+        if stats.shape != (segment.HISTO_STAT_COLS,):
+            raise ValueError(f"bad stats shape {stats.shape}")
+        if means.shape != weights.shape or means.ndim != 1:
+            raise ValueError(
+                f"centroid shape mismatch {means.shape}/{weights.shape}")
+        key = (name, mtype, tags, scope)
+        row = self.histo_idx.lookup(key, name, tags, scope, mtype,
+                                    self.gen)
+        if row is None:
+            return False
+        self._stats_import_rows.append(row)
+        self._stats_import_vals.append(stats)
+        live = weights > 0
+        if live.any():
+            self._digest_stage.append(
+                np.full(int(live.sum()), row, np.int32),
+                means[live], weights[live])
+        return True
+
+    def import_set(self, name: str, tags: tuple[str, ...],
+                   regs: np.ndarray,
+                   scope: str = dsd.SCOPE_DEFAULT) -> bool:
+        """Merge a forwarded HLL register plane (union by max).  Shape
+        validated before staging (see import_histo)."""
+        regs = np.asarray(regs, np.uint8)
+        if regs.shape != (hll.M,):
+            raise ValueError(f"bad register plane shape {regs.shape}")
+        key = (name, dsd.SET, tags, scope)
+        row = self.set_idx.lookup(key, name, tags, scope, dsd.SET,
+                                  self.gen)
+        if row is None:
+            return False
+        self._set_import_rows.append(row)
+        self._set_import_regs.append(regs)
+        return True
 
     # ------------------------------------------------------------------
     # device step
@@ -290,7 +385,11 @@ class MetricTable:
 
         batch = self._histo_stage.take()
         if batch is not None:
-            self._histo_device_step(*batch)
+            self._histo_device_step(*batch, with_stats=True)
+
+        batch = self._digest_stage.take()
+        if batch is not None:
+            self._histo_device_step(*batch, with_stats=False)
 
         if self._set_rows:
             rows = np.asarray(self._set_rows, np.int32)
@@ -303,19 +402,51 @@ class MetricTable:
                 jnp.asarray(_pad_np(idx.astype(np.int32), b, 0)),
                 jnp.asarray(_pad_np(rank.astype(np.int32), b, 0)))
 
+        if self._stats_import_rows:
+            rows = np.asarray(self._stats_import_rows, np.int32)
+            vals = np.stack(self._stats_import_vals)
+            self._stats_import_rows, self._stats_import_vals = [], []
+            # padding row ids are out of bounds -> dropped by the
+            # scatter, so padding row contents never participate
+            b = _bucket_len(len(rows), wide=True)
+            padded = np.zeros((b, vals.shape[1]), np.float32)
+            padded[:len(vals)] = vals
+            self.histo_stats = _histo_stats_merge(
+                self.histo_stats,
+                jnp.asarray(_pad_np(rows, b, c.histo_rows)),
+                jnp.asarray(padded))
+
+        if self._set_import_rows:
+            rows = np.asarray(self._set_import_rows, np.int32)
+            regs = np.stack(self._set_import_regs)
+            self._set_import_rows, self._set_import_regs = [], []
+            # wide rows (16 KiB each): small bucket floor, padding a
+            # 256-row plane for one import would cost 4 MiB of
+            # host->device bandwidth per flush
+            b = _bucket_len(len(rows), wide=True)
+            padded = np.zeros((b, regs.shape[1]), np.uint8)
+            padded[:len(regs)] = regs
+            self.hll_regs = _hll_merge_rows(
+                self.hll_regs,
+                jnp.asarray(_pad_np(rows, b, c.set_rows)),
+                jnp.asarray(padded))
+
     def _histo_device_step(self, rows: np.ndarray, vals: np.ndarray,
-                           wts: np.ndarray) -> None:
+                           wts: np.ndarray,
+                           with_stats: bool = True) -> None:
         """Histo ingest: local stats scatter + t-digest merge.  The
         digest merge densifies at most ``histo_slots`` samples per row
         per call, so heavy rows are split across multiple calls by
-        within-row rank (vectorized on host)."""
+        within-row rank (vectorized on host).  ``with_stats=False`` for
+        imported centroids, whose stats arrive via the stat-row path."""
         c = self.config
         b = _bucket_len(len(rows))
-        self.histo_stats = _histo_stats_step(
-            self.histo_stats,
-            jnp.asarray(_pad_np(rows, b, c.histo_rows)),
-            jnp.asarray(_pad_np(vals, b, 0.0)),
-            jnp.asarray(_pad_np(wts, b, 0.0)))
+        if with_stats:
+            self.histo_stats = _histo_stats_step(
+                self.histo_stats,
+                jnp.asarray(_pad_np(rows, b, c.histo_rows)),
+                jnp.asarray(_pad_np(vals, b, 0.0)),
+                jnp.asarray(_pad_np(wts, b, 0.0)))
 
         # within-row rank -> chunk id
         order = np.argsort(rows, kind="stable")

@@ -1,0 +1,122 @@
+"""HTTP /import forwarding codec: local tier -> global tier.
+
+Plays the role of the reference's HTTP+JSON forward path
+(flusher.go:363 ``flushForward`` -> handlers_global.go:60
+``handleImport``), carrying mergeable per-series state.  The reference
+encodes sampler state as Go gob inside JSONMetric.Value
+(samplers/samplers.go:678); gob is a Go-specific format, so this
+framework uses an explicit JSON schema with base64 payloads instead:
+
+    {"name", "type", "tags": [...], "scope",
+     "value":        <float>            (counter/gauge)
+     "stats":        [w,min,max,sum,rsum]  (histo)
+     "means"/"weights": <b64 f32 LE>        (histo centroids)
+     "regs":         <b64 u8, zlib>         (set HLL registers)}
+
+Bodies are JSON arrays, optionally zlib-deflated (the reference accepts
+deflate on /import, handlers_global.go:141).  The gRPC forward path
+(forward/grpc_forward.py) is the higher-throughput equivalent of the
+reference's forwardrpc service.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import zlib
+
+import numpy as np
+
+log = logging.getLogger("veneur_tpu.forward")
+
+from veneur_tpu.core.flusher import ForwardRow
+from veneur_tpu.core.table import MetricTable
+from veneur_tpu.protocol import dogstatsd as dsd
+
+
+def _b64(arr: np.ndarray) -> str:
+    return base64.b64encode(arr.tobytes()).decode()
+
+
+def _unb64(text: str, dtype) -> np.ndarray:
+    return np.frombuffer(base64.b64decode(text), dtype=dtype)
+
+
+def encode_rows(rows: list[ForwardRow], deflate: bool = True) -> tuple[
+        bytes, dict[str, str]]:
+    """ForwardRows -> (body, headers) for POST /import."""
+    items = []
+    for r in rows:
+        item: dict = {"name": r.meta.name, "type": r.meta.type,
+                      "tags": list(r.meta.tags), "scope": r.meta.scope,
+                      "kind": r.kind}
+        if r.kind in ("counter", "gauge"):
+            item["value"] = r.value
+        elif r.kind == "histo":
+            item["stats"] = [float(x) for x in r.stats]
+            item["means"] = _b64(np.asarray(r.means, np.float32))
+            item["weights"] = _b64(np.asarray(r.weights, np.float32))
+        elif r.kind == "set":
+            item["regs"] = base64.b64encode(
+                zlib.compress(np.asarray(r.regs, np.uint8).tobytes())
+            ).decode()
+        items.append(item)
+    body = json.dumps(items).encode()
+    headers = {"Content-Type": "application/json"}
+    if deflate:
+        body = zlib.compress(body)
+        headers["Content-Encoding"] = "deflate"
+    return body, headers
+
+
+def decode_body(body: bytes, content_encoding: str = "") -> list[dict]:
+    if content_encoding == "deflate":
+        body = zlib.decompress(body)
+    items = json.loads(body)
+    if not isinstance(items, list):
+        raise ValueError("import body must be a JSON array")
+    return items
+
+
+def apply_import(table: MetricTable, items: list[dict]) -> tuple[int, int]:
+    """Merge decoded import items into a (global) table.  Returns
+    (accepted, dropped).  The receiving half of reference
+    http.go:63 ImportMetrics / worker.go:438 ImportMetricGRPC."""
+    accepted = dropped = 0
+    for it in items:
+        # per-item isolation: one malformed item is dropped-and-counted
+        # without aborting the rest of the batch (the reference drops
+        # and counts bad imports the same way)
+        try:
+            tags = tuple(it.get("tags", ()))
+            kind = it.get("kind") or it.get("type")
+            name = it["name"]
+            ok = False
+            if kind == "counter":
+                ok = table.import_counter(name, tags, float(it["value"]))
+            elif kind == "gauge":
+                ok = table.import_gauge(name, tags, float(it["value"]))
+            elif kind == "histo":
+                means = _unb64(it["means"], np.float32)
+                weights = _unb64(it["weights"], np.float32)
+                ok = table.import_histo(
+                    name, it.get("type", dsd.HISTOGRAM), tags,
+                    np.asarray(it["stats"], np.float32), means, weights,
+                    scope=it.get("scope", dsd.SCOPE_DEFAULT))
+            elif kind == "set":
+                regs = np.frombuffer(
+                    zlib.decompress(base64.b64decode(it["regs"])),
+                    np.uint8)
+                ok = table.import_set(
+                    name, tags, regs,
+                    scope=it.get("scope", dsd.SCOPE_DEFAULT))
+            else:
+                raise ValueError(f"unknown import kind {kind!r}")
+        except (ValueError, KeyError, TypeError, zlib.error) as e:
+            log.warning("dropping malformed import item: %s", e)
+            dropped += 1
+            continue
+        accepted += int(ok)
+        dropped += int(not ok)
+    return accepted, dropped

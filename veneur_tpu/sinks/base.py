@@ -1,0 +1,89 @@
+"""Sink and plugin contracts (reference sinks/sinks.go:32 MetricSink,
+:85 SpanSink; plugins/plugins.go:16 Plugin).
+
+Sinks are host-side and run post-readback, concurrently, at flush
+(reference flusher.go:106-132).  Metric routing honours per-metric
+``veneursinkonly:<name>`` whitelists (InterMetric.acceptable_for) and
+per-sink excluded tags (reference server.go:1642-1668 SetExcludedTags).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterable, Protocol, runtime_checkable
+
+from veneur_tpu.core.metrics import InterMetric
+
+log = logging.getLogger("veneur_tpu.sinks")
+
+
+@runtime_checkable
+class MetricSink(Protocol):
+    name: str
+
+    def start(self) -> None: ...
+
+    def flush(self, metrics: list[InterMetric]) -> None: ...
+
+    def flush_other_samples(self, samples: list) -> None:
+        """Events / service checks (reference
+        MetricSink.FlushOtherSamples)."""
+
+
+@runtime_checkable
+class SpanSink(Protocol):
+    name: str
+
+    def start(self) -> None: ...
+
+    def ingest(self, span) -> None: ...
+
+    def flush(self) -> None: ...
+
+
+@runtime_checkable
+class Plugin(Protocol):
+    name: str
+
+    def flush(self, metrics: list[InterMetric], hostname: str) -> None: ...
+
+
+class SinkBase:
+    """Convenience base with excluded-tag stripping."""
+
+    name = "base"
+
+    def __init__(self):
+        self.excluded_tags: frozenset[str] = frozenset()
+
+    def set_excluded_tags(self, tags: Iterable[str]) -> None:
+        self.excluded_tags = frozenset(tags)
+
+    def strip_tags(self, m: InterMetric) -> InterMetric:
+        if not self.excluded_tags:
+            return m
+        kept = tuple(t for t in m.tags
+                     if t.split(":", 1)[0] not in self.excluded_tags)
+        if kept == m.tags:
+            return m
+        return InterMetric(name=m.name, timestamp=m.timestamp,
+                           value=m.value, tags=kept, type=m.type,
+                           message=m.message, hostname=m.hostname)
+
+    def start(self) -> None:
+        pass
+
+    def flush_other_samples(self, samples: list) -> None:
+        pass
+
+
+def route(metrics: list[InterMetric], sink_name: str,
+          sink: SinkBase | None = None) -> list[InterMetric]:
+    """Filter a flush batch for one sink: whitelist routing + excluded
+    tags (reference sinks.IsAcceptableMetric, sinks/sinks.go:51)."""
+    out = []
+    for m in metrics:
+        if not m.acceptable_for(sink_name):
+            continue
+        out.append(sink.strip_tags(m) if sink is not None else m)
+    return out

@@ -1,0 +1,55 @@
+"""Prometheus "statsd repeater" sink.
+
+The reference's prometheus sink does NOT expose a scrape endpoint — it
+re-emits flushed metrics as statsd lines to a repeater address
+(sinks/prometheus/prometheus.go, "StatsdRepeater", config keys
+``prometheus_repeater_address`` / ``prometheus_network_type``).  Same
+behavior here: each InterMetric becomes ``name:value|type|#tags`` sent
+over UDP or TCP.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+
+from veneur_tpu.core.metrics import COUNTER, InterMetric
+from veneur_tpu.sinks.base import SinkBase
+
+log = logging.getLogger("veneur_tpu.sinks.prometheus")
+
+
+class PrometheusRepeaterSink(SinkBase):
+    name = "prometheus"
+
+    def __init__(self, repeater_address: str, network_type: str = "tcp"):
+        super().__init__()
+        host, _, port = repeater_address.rpartition(":")
+        self.addr = (host or "127.0.0.1", int(port))
+        if network_type not in ("tcp", "udp"):
+            raise ValueError(f"bad network type {network_type}")
+        self.network_type = network_type
+
+    @staticmethod
+    def _line(m: InterMetric) -> bytes:
+        token = "c" if m.type == COUNTER else "g"
+        tags = f"|#{','.join(m.tags)}" if m.tags else ""
+        return f"{m.name}:{m.value}|{token}{tags}\n".encode()
+
+    def flush(self, metrics: list[InterMetric]) -> None:
+        if not metrics:
+            return
+        payload = b"".join(self._line(m) for m in metrics)
+        try:
+            if self.network_type == "udp":
+                s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                # stay under typical MTU per datagram
+                for m in metrics:
+                    s.sendto(self._line(m), self.addr)
+                s.close()
+            else:
+                with socket.create_connection(self.addr,
+                                              timeout=5.0) as s:
+                    s.sendall(payload)
+        except OSError as e:
+            log.warning("prometheus repeater flush failed: %s", e)

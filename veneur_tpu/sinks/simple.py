@@ -1,0 +1,123 @@
+"""Simple sinks: blackhole, debug-log, in-memory capture, and the
+localfile / s3-archive plugins.
+
+- blackhole: test/no-op (reference sinks/blackhole/blackhole.go:12)
+- debug: logs every flushed metric (reference sinks/debug, enabled by
+  ``debug_flushed_metrics``)
+- capture: test helper holding flushed batches (the role the reference's
+  channel-capture sinks play in server_test.go)
+- localfile plugin: appends flush batches as TSV
+  (reference plugins/localfile/localfile.go:32)
+- s3 plugin: TSV-gz archive per flush (reference plugins/s3/s3.go:35);
+  without AWS credentials/SDK in this environment it is a gated stub
+  that writes the same artifact to a local spool directory.
+"""
+
+from __future__ import annotations
+
+import gzip
+import logging
+import os
+import time
+
+from veneur_tpu.core.metrics import InterMetric
+from veneur_tpu.sinks.base import SinkBase
+
+log = logging.getLogger("veneur_tpu.sinks")
+
+
+class BlackholeSink(SinkBase):
+    name = "blackhole"
+
+    def flush(self, metrics: list[InterMetric]) -> None:
+        pass
+
+    def ingest(self, span) -> None:
+        pass
+
+
+class DebugSink(SinkBase):
+    name = "debug"
+
+    def flush(self, metrics: list[InterMetric]) -> None:
+        for m in metrics:
+            log.info("flushed metric %s=%s type=%s tags=%s", m.name,
+                     m.value, m.type, ",".join(m.tags))
+
+    def flush_other_samples(self, samples: list) -> None:
+        for s in samples:
+            log.info("flushed sample %r", s)
+
+
+class CaptureSink(SinkBase):
+    """Test sink: records everything (mirror of the reference's test
+    capture sinks, server_test.go:134-170 fixture)."""
+    name = "capture"
+
+    def __init__(self):
+        super().__init__()
+        self.batches: list[list[InterMetric]] = []
+        self.other: list = []
+        self.spans: list = []
+
+    def flush(self, metrics: list[InterMetric]) -> None:
+        self.batches.append(list(metrics))
+
+    def flush_other_samples(self, samples: list) -> None:
+        self.other.extend(samples)
+
+    def ingest(self, span) -> None:
+        self.spans.append(span)
+
+    @property
+    def metrics(self) -> list[InterMetric]:
+        return [m for b in self.batches for m in b]
+
+
+def _tsv_rows(metrics: list[InterMetric], hostname: str) -> str:
+    """TSV layout follows the reference's CSV encoder fields
+    (plugins/s3/csv.go): name, tags, type, hostname, timestamp,
+    value, partition date."""
+    rows = []
+    for m in metrics:
+        dt = time.strftime("%Y-%m-%d", time.gmtime(m.timestamp))
+        rows.append("\t".join([
+            m.name, ",".join(m.tags), m.type, hostname,
+            str(m.timestamp), repr(m.value), dt]))
+    return "\n".join(rows) + ("\n" if rows else "")
+
+
+class LocalFilePlugin:
+    """Append each flush as TSV to one file (reference
+    plugins/localfile)."""
+    name = "localfile"
+
+    def __init__(self, path: str, hostname: str = ""):
+        self.path = path
+        self.hostname = hostname
+
+    def flush(self, metrics: list[InterMetric],
+              hostname: str = "") -> None:
+        with open(self.path, "a") as f:
+            f.write(_tsv_rows(metrics, hostname or self.hostname))
+
+
+class S3ArchivePlugin:
+    """One gzipped TSV object per flush (reference plugins/s3).  With no
+    AWS SDK in the image, objects spool to ``spool_dir`` with the same
+    key layout (<hostname>/<ts>.tsv.gz) for an external shipper."""
+    name = "s3"
+
+    def __init__(self, bucket: str, spool_dir: str, hostname: str = ""):
+        self.bucket = bucket
+        self.spool_dir = spool_dir
+        self.hostname = hostname
+
+    def flush(self, metrics: list[InterMetric],
+              hostname: str = "") -> None:
+        host = hostname or self.hostname or "unknown"
+        os.makedirs(os.path.join(self.spool_dir, host), exist_ok=True)
+        key = os.path.join(self.spool_dir, host,
+                           f"{int(time.time() * 1e9)}.tsv.gz")
+        with gzip.open(key, "wt") as f:
+            f.write(_tsv_rows(metrics, host))
