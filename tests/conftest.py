@@ -19,6 +19,11 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+# The dev image's sitecustomize force-registers the TPU platform with an
+# explicit ``jax.config.update("jax_platforms", ...)`` at interpreter
+# start, which overrides the env var above — override it back.
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 

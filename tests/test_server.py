@@ -128,28 +128,43 @@ def test_forward_chain_local_to_global(make_server):
     # timers forward their digests; global counters forward totals
     for v in range(100):
         _send_udp(local, f"fwd.lat:{v}|ms".encode())
+        _send_udp(local, f"fwd.glat:{v}|ms|#veneurglobalonly".encode())
     _send_udp(local, b"fwd.hits:9|c|#veneurglobalonly")
-    assert _wait(lambda: local.stats["metrics_processed"] >= 101)
+    assert _wait(lambda: local.stats["metrics_processed"] >= 201)
 
     local.flush_once()
-    assert _wait(lambda: glob.stats["imports_received"] >= 2)
+    assert _wait(lambda: glob.stats["imports_received"] >= 3)
     glob.flush_once()
 
     gm = {x.name: x for x in gcap.metrics}
     assert gm["fwd.hits"].value == 9.0
-    assert gm["fwd.lat.count"].value == pytest.approx(100)
     assert gm["fwd.lat.50percentile"].value == pytest.approx(49.5,
                                                              abs=2.0)
     assert gm["fwd.lat.99percentile"].value == pytest.approx(99,
                                                              abs=2.0)
-    assert gm["fwd.lat.min"].value == 0.0
-    assert gm["fwd.lat.max"].value == 99.0
+    # mixed-scope forwarded histos emit percentiles ONLY at the global —
+    # the local tier already emitted the aggregates, and re-emitting
+    # .count upstream would make downstream count-sums double (reference
+    # flusher.go:61-67, samplers.go:530 Local* gates)
+    assert "fwd.lat.count" not in gm
+    assert "fwd.lat.min" not in gm
+    assert "fwd.lat.max" not in gm
+    # global-only histos never emit at the local tier, so the global
+    # emits their aggregates from merged state (samplers.go:511
+    # global=true path) alongside percentiles
+    assert gm["fwd.glat.count"].value == pytest.approx(100)
+    assert gm["fwd.glat.min"].value == 0.0
+    assert gm["fwd.glat.max"].value == 99.0
+    assert gm["fwd.glat.50percentile"].value == pytest.approx(49.5,
+                                                              abs=2.0)
     # the local node emitted aggregates but no percentiles, and did not
-    # emit the global-only counter
+    # emit the global-only metrics
     lm = {x.name for x in lcap.metrics}
     assert "fwd.lat.count" in lm
+    assert "fwd.lat.min" in lm and "fwd.lat.max" in lm
     assert not any("percentile" in n for n in lm)
     assert "fwd.hits" not in lm
+    assert not any(n.startswith("fwd.glat") for n in lm)
 
 
 def test_forward_sets_merge_cardinality(make_server):

@@ -103,6 +103,10 @@ class Config:
     tpu_set_rows: int = 1024
     tpu_compression: float = 100.0
     tpu_histo_slots: int = 512
+    # staged-sample threshold that triggers a mid-interval device step,
+    # bounding host staging memory and smoothing device work instead of
+    # landing the whole interval's batch at the flush boundary
+    tpu_stage_flush_samples: int = 65536
 
     def interval_seconds(self) -> float:
         return parse_duration(self.interval)

@@ -147,9 +147,30 @@ class Flusher:
                       res: FlushResult) -> None:
         if not snap.histo_meta:
             return
+        # Two stat planes: ``stats`` holds aggregates of raw samples
+        # ingested by THIS node ("Local*" in the reference,
+        # samplers/samplers.go:484); ``imp`` holds merged forwarded stat
+        # rows.  Aggregates for mixed-scope rows come only from the
+        # local plane (reference gates on LocalWeight/LocalMin/LocalMax,
+        # samplers.go:530-621 — emitting them from merged state would
+        # double-count against the local tier's own emission); rows
+        # flushed with global=true use the combined plane, the analogue
+        # of reading min/max/sum off the merged digest itself.
         stats = np.asarray(snap.histo_stats)
-        mins = jnp.asarray(stats[:, segment.STAT_MIN])
-        maxs = jnp.asarray(stats[:, segment.STAT_MAX])
+        imp = np.asarray(snap.histo_import_stats)
+        comb = np.empty_like(stats)
+        comb[:, segment.STAT_WEIGHT] = (stats[:, segment.STAT_WEIGHT] +
+                                        imp[:, segment.STAT_WEIGHT])
+        comb[:, segment.STAT_MIN] = np.minimum(stats[:, segment.STAT_MIN],
+                                               imp[:, segment.STAT_MIN])
+        comb[:, segment.STAT_MAX] = np.maximum(stats[:, segment.STAT_MAX],
+                                               imp[:, segment.STAT_MAX])
+        comb[:, segment.STAT_SUM] = (stats[:, segment.STAT_SUM] +
+                                     imp[:, segment.STAT_SUM])
+        comb[:, segment.STAT_RSUM] = (stats[:, segment.STAT_RSUM] +
+                                      imp[:, segment.STAT_RSUM])
+        mins = jnp.asarray(comb[:, segment.STAT_MIN])
+        maxs = jnp.asarray(comb[:, segment.STAT_MAX])
         emit_pcts = not self.is_local
         all_pcts = tuple(self.percentiles) + (
             (0.5,) if "median" in self.aggregates else ())
@@ -173,7 +194,6 @@ class Flusher:
             if not snap.histo_touched[row]:
                 continue
             st = stats[row]
-            weight = float(st[segment.STAT_WEIGHT])
             forward = self._forwardable(meta, always=True)
             if forward:
                 if means_np is None:
@@ -187,40 +207,53 @@ class Flusher:
             # digest forwards; global-only histos emit nothing locally
             if meta.scope == dsd.SCOPE_GLOBAL and self.is_local:
                 continue
-            self._emit_histo_row(res, meta, ts, st, weight, qvals, row,
-                                 all_pcts,
+            # the reference's ``global`` flag (samplers.go:511 Flush):
+            # true only for global-scope rows flushed on a global node
+            global_mode = (meta.scope == dsd.SCOPE_GLOBAL and
+                           not self.is_local)
+            self._emit_histo_row(res, meta, ts,
+                                 comb[row] if global_mode else st,
+                                 qvals, row, all_pcts,
                                  with_percentiles=emit_pcts or
-                                 meta.scope == dsd.SCOPE_LOCAL)
+                                 meta.scope == dsd.SCOPE_LOCAL,
+                                 global_mode=global_mode)
         res.tally["histograms"] = int(snap.histo_touched.sum())
 
-    def _emit_histo_row(self, res, meta, ts, st, weight, qvals, row,
-                        all_pcts, with_percentiles):
+    def _emit_histo_row(self, res, meta, ts, st, qvals, row,
+                        all_pcts, with_percentiles, global_mode=False):
         agg = set(self.aggregates)
         out = res.metrics
-        if "max" in agg:
-            out.append(self._mk(f"{meta.name}.max", ts,
-                                float(st[segment.STAT_MAX]), meta,
+        weight = float(st[segment.STAT_WEIGHT])
+        st_min = float(st[segment.STAT_MIN])
+        st_max = float(st[segment.STAT_MAX])
+        st_sum = float(st[segment.STAT_SUM])
+        st_rsum = float(st[segment.STAT_RSUM])
+        # sparse-emission gates (samplers.go:530-660): each aggregate is
+        # emitted from local values only when locally sampled, or
+        # unconditionally in global mode (merged state).  min/max use
+        # the untouched sentinels as the reference uses +/-Inf.
+        sampled = weight != 0
+        if "max" in agg and (global_mode or
+                             st_max != float(segment.STAT_MAX_EMPTY)):
+            out.append(self._mk(f"{meta.name}.max", ts, st_max, meta,
                                 im.GAUGE))
-        if "min" in agg:
-            out.append(self._mk(f"{meta.name}.min", ts,
-                                float(st[segment.STAT_MIN]), meta,
+        if "min" in agg and (global_mode or
+                             st_min != float(segment.STAT_MIN_EMPTY)):
+            out.append(self._mk(f"{meta.name}.min", ts, st_min, meta,
                                 im.GAUGE))
-        if "sum" in agg and float(st[segment.STAT_SUM]) != 0:
-            out.append(self._mk(f"{meta.name}.sum", ts,
-                                float(st[segment.STAT_SUM]), meta,
+        if "sum" in agg and (global_mode or st_sum != 0):
+            out.append(self._mk(f"{meta.name}.sum", ts, st_sum, meta,
                                 im.GAUGE))
-        if "avg" in agg and weight != 0 and float(st[segment.STAT_SUM]) != 0:
+        if "avg" in agg and weight != 0 and (global_mode or st_sum != 0):
             out.append(self._mk(
-                f"{meta.name}.avg", ts,
-                float(st[segment.STAT_SUM]) / weight, meta, im.GAUGE))
-        if "count" in agg and weight != 0:
+                f"{meta.name}.avg", ts, st_sum / weight, meta, im.GAUGE))
+        if "count" in agg and (global_mode or sampled):
             out.append(self._mk(f"{meta.name}.count", ts, weight, meta,
                                 im.COUNTER))
-        if "hmean" in agg and weight != 0 and \
-                float(st[segment.STAT_RSUM]) != 0:
+        if "hmean" in agg and weight != 0 and st_rsum != 0:
             out.append(self._mk(
-                f"{meta.name}.hmean", ts,
-                weight / float(st[segment.STAT_RSUM]), meta, im.GAUGE))
+                f"{meta.name}.hmean", ts, weight / st_rsum, meta,
+                im.GAUGE))
         if "median" in agg and qvals is not None:
             out.append(self._mk(f"{meta.name}.median", ts,
                                 float(qvals[row, len(all_pcts) - 1]),

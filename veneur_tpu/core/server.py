@@ -71,6 +71,10 @@ class Server:
 
         self.events: list[dsd.Event] = []
         self.checks: list[dsd.ServiceCheck] = []
+        # stats increments come from every reader/HTTP thread; dict
+        # read-modify-write is not atomic, so guard with a dedicated
+        # lock (cheaper than widening self.lock's critical sections)
+        self._stats_lock = threading.Lock()
         self.stats: dict[str, int] = {
             "packets_received": 0, "packet_errors": 0,
             "metrics_processed": 0, "metrics_dropped": 0,
@@ -114,29 +118,46 @@ class Server:
     # ------------------------------------------------------------------
     # ingest
 
+    def bump(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self.stats[key] = self.stats.get(key, 0) + n
+
     def handle_packet(self, data: bytes) -> None:
         """Parse one datagram (possibly multi-line) into the table
         (reference server.go:1253 processMetricPacket -> :1103
         HandleMetricPacket)."""
         if len(data) > self.config.metric_max_length:
-            self.stats["packet_errors"] += 1
+            self.bump("packet_errors")
             return
-        self.stats["packets_received"] += 1
+        self.bump("packets_received")
+        errors = processed = dropped = 0
         for line in dsd.split_packet(data):
             try:
                 parsed = dsd.parse_line(line)
             except dsd.ParseError:
-                self.stats["packet_errors"] += 1
+                errors += 1
                 continue
-            self.ingest_parsed(parsed)
+            p, d = self.ingest_parsed(parsed, bump=False)
+            processed += p
+            dropped += d
+        # one stats-lock round per packet, not per line
+        if errors:
+            self.bump("packet_errors", errors)
+        if processed:
+            self.bump("metrics_processed", processed)
+        if dropped:
+            self.bump("metrics_dropped", dropped)
 
-    def ingest_parsed(self, parsed) -> None:
+    def ingest_parsed(self, parsed, bump: bool = True) -> tuple[int, int]:
+        """Ingest one parsed object; returns (processed, dropped) so
+        batch callers can tally stats once per batch."""
+        processed = dropped = 0
         if isinstance(parsed, dsd.Sample):
             with self.lock:
                 ok = self.table.ingest(parsed)
-            self.stats["metrics_processed"] += 1
-            if not ok:
-                self.stats["metrics_dropped"] += 1
+                self._maybe_device_step_locked()
+            processed = 1
+            dropped = 0 if ok else 1
         elif isinstance(parsed, dsd.Event):
             with self.lock:
                 self.events.append(parsed)
@@ -148,7 +169,19 @@ class Server:
             with self.lock:
                 self.table.ingest(sample)
                 self.checks.append(parsed)
-            self.stats["metrics_processed"] += 1
+            processed = 1
+        if bump:
+            if processed:
+                self.bump("metrics_processed", processed)
+            if dropped:
+                self.bump("metrics_dropped", dropped)
+        return processed, dropped
+
+    def _maybe_device_step_locked(self) -> None:
+        """Mid-interval device step once enough samples are staged
+        (bounds host staging memory; caller holds self.lock)."""
+        if self.table.staged() >= self.config.tpu_stage_flush_samples:
+            self.table.device_step()
 
     # ------------------------------------------------------------------
     # listeners
@@ -254,7 +287,7 @@ class Server:
                     if line:
                         self.handle_packet(line)
                 if len(buf) > self.config.metric_max_length:
-                    self.stats["packet_errors"] += 1
+                    self.bump("packet_errors")
                     buf = b""
         except OSError:
             pass
@@ -301,8 +334,9 @@ class Server:
                         with server.lock:
                             acc, dropped = http_import.apply_import(
                                 server.table, items)
-                        server.stats["imports_received"] += acc
-                        server.stats["metrics_dropped"] += dropped
+                            server._maybe_device_step_locked()
+                        server.bump("imports_received", acc)
+                        server.bump("metrics_dropped", dropped)
                         self._ok(json.dumps({"accepted": acc}).encode(),
                                  "application/json")
                     except (ValueError, KeyError) as e:
@@ -348,7 +382,7 @@ class Server:
             status = self.table.take_status()
         res = self.flusher.flush(snap)
         self.last_flush = time.monotonic()
-        self.stats["flushes"] += 1
+        self.bump("flushes")
 
         ts = int(time.time())
         for (name, _, tags, _), (val, msg, stags) in (
@@ -399,7 +433,7 @@ class Server:
             with urllib.request.urlopen(req, timeout=10.0) as r:
                 r.read()
         except OSError as e:
-            self.stats["metrics_dropped"] += len(rows)
+            self.bump("metrics_dropped", len(rows))
             log.warning("forward failed: %s", e)
 
     # ------------------------------------------------------------------

@@ -174,7 +174,8 @@ class Snapshot:
     gauges: Any
     gauge_meta: list[RowMeta]
     gauge_touched: np.ndarray
-    histo_stats: Any
+    histo_stats: Any  # raw-sample ("local") stats plane
+    histo_import_stats: Any  # forwarded-stat-row merges only
     histo_means: Any
     histo_weights: Any
     histo_meta: list[RowMeta]
@@ -207,7 +208,13 @@ class MetricTable:
         # receive half of reference worker.go:438 ImportMetricGRPC).
         # Imported centroids merge into digests ONLY — their aggregate
         # stats arrive separately via the forwarded stat row, so pushing
-        # them through the raw-sample path would double-count.
+        # them through the raw-sample path would double-count.  Imported
+        # stat rows land in a SEPARATE plane (histo_import_stats) from
+        # raw-sample stats: the reference only emits histogram
+        # aggregates from locally-sampled values or (for global-scope
+        # rows) from fully-merged state (samplers/samplers.go:530
+        # LocalMax/LocalWeight gates), so the flusher must be able to
+        # tell the two apart or downstream count-sums double.
         self._digest_stage = _Staging()
         self._stats_import_rows: list[int] = []
         self._stats_import_vals: list[np.ndarray] = []
@@ -215,6 +222,10 @@ class MetricTable:
         self._set_import_regs: list[np.ndarray] = []
 
         self.status: dict[tuple, tuple[float, str, tuple[str, ...]]] = {}
+        # O(1) staged-sample counter (``staged()`` must be callable per
+        # sample to drive threshold-triggered device steps without
+        # walking the staging lists)
+        self._staged_n = 0
 
         self._init_state()
 
@@ -223,6 +234,7 @@ class MetricTable:
         self.counters = segment.empty_counter_state(c.counter_rows)
         self.gauges = segment.empty_gauge_state(c.gauge_rows)
         self.histo_stats = segment.empty_histo_stats(c.histo_rows)
+        self.histo_import_stats = segment.empty_histo_stats(c.histo_rows)
         self.histo_means, self.histo_weights = tdigest.empty_state(
             c.histo_rows, self.capacity)
         self.hll_regs = hll.empty_state(c.set_rows)
@@ -241,18 +253,21 @@ class MetricTable:
             if row is None:
                 return False
             self._counter_stage.append([row], [s.value], [weight])
+            self._staged_n += 1
         elif s.type == dsd.GAUGE:
             row = self.gauge_idx.lookup(key, s.name, s.tags, s.scope,
                                         s.type, self.gen)
             if row is None:
                 return False
             self._gauge_stage.append([row], [s.value])
+            self._staged_n += 1
         elif s.type in (dsd.TIMER, dsd.HISTOGRAM):
             row = self.histo_idx.lookup(key, s.name, s.tags, s.scope,
                                         s.type, self.gen)
             if row is None:
                 return False
             self._histo_stage.append([row], [s.value], [weight])
+            self._staged_n += 1
         elif s.type == dsd.SET:
             row = self.set_idx.lookup(key, s.name, s.tags, s.scope,
                                       s.type, self.gen)
@@ -262,6 +277,7 @@ class MetricTable:
             member = s.value if isinstance(s.value, bytes) else str(
                 s.value).encode()
             self._set_members.append(member)
+            self._staged_n += 1
         elif s.type == dsd.STATUS:
             self.status[key] = (float(s.value), s.message, s.tags)
         else:
@@ -276,10 +292,7 @@ class MetricTable:
         return dropped
 
     def staged(self) -> int:
-        return (len(self._counter_stage) + len(self._gauge_stage) +
-                len(self._histo_stage) + len(self._digest_stage) +
-                len(self._set_rows) +
-                len(self._stats_import_rows) + len(self._set_import_rows))
+        return self._staged_n
 
     # ------------------------------------------------------------------
     # global-tier import (merge of forwarded mergeable state)
@@ -295,6 +308,7 @@ class MetricTable:
         if row is None:
             return False
         self._counter_stage.append([row], [value], [1.0])
+        self._staged_n += 1
         return True
 
     def import_gauge(self, name: str, tags: tuple[str, ...],
@@ -305,6 +319,7 @@ class MetricTable:
         if row is None:
             return False
         self._gauge_stage.append([row], [value])
+        self._staged_n += 1
         return True
 
     def import_histo(self, name: str, mtype: str, tags: tuple[str, ...],
@@ -334,11 +349,16 @@ class MetricTable:
             return False
         self._stats_import_rows.append(row)
         self._stats_import_vals.append(stats)
+        self._staged_n += 1
         live = weights > 0
         if live.any():
+            n_live = int(live.sum())
             self._digest_stage.append(
-                np.full(int(live.sum()), row, np.int32),
+                np.full(n_live, row, np.int32),
                 means[live], weights[live])
+            # count every staged centroid, not 1 per import — the
+            # staging-memory bound rides on this counter
+            self._staged_n += n_live
         return True
 
     def import_set(self, name: str, tags: tuple[str, ...],
@@ -356,6 +376,7 @@ class MetricTable:
             return False
         self._set_import_rows.append(row)
         self._set_import_regs.append(regs)
+        self._staged_n += 1
         return True
 
     # ------------------------------------------------------------------
@@ -364,6 +385,7 @@ class MetricTable:
     def device_step(self) -> None:
         """Push all staged samples to the device as batched updates."""
         c = self.config
+        self._staged_n = 0
         batch = self._counter_stage.take()
         if batch is not None:
             rows, vals, wts = batch
@@ -411,8 +433,8 @@ class MetricTable:
             b = _bucket_len(len(rows), wide=True)
             padded = np.zeros((b, vals.shape[1]), np.float32)
             padded[:len(vals)] = vals
-            self.histo_stats = _histo_stats_merge(
-                self.histo_stats,
+            self.histo_import_stats = _histo_stats_merge(
+                self.histo_import_stats,
                 jnp.asarray(_pad_np(rows, b, c.histo_rows)),
                 jnp.asarray(padded))
 
@@ -485,6 +507,7 @@ class MetricTable:
             gauge_meta=list(self.gauge_idx.meta),
             gauge_touched=self.gauge_idx.touched.copy(),
             histo_stats=self.histo_stats,
+            histo_import_stats=self.histo_import_stats,
             histo_means=self.histo_means,
             histo_weights=self.histo_weights,
             histo_meta=list(self.histo_idx.meta),

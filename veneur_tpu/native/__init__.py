@@ -1,0 +1,76 @@
+"""Native (C++) helpers for the host-side hot path.
+
+The reference gets its ingest throughput from Go's compiled parser and
+per-worker goroutines; the analogous native tier here is a small C++
+shared library driving the columnar batch parser (``dsd_parse.cpp``),
+compiled on first import with the system g++ and loaded via ctypes.
+If no toolchain is available the callers fall back to the pure-Python
+per-line parser (slower, same behavior).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+log = logging.getLogger("veneur_tpu.native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "dsd_parse.cpp")
+_BUILD_DIR = os.path.join(_DIR, "_build")
+_SO = os.path.join(_BUILD_DIR, "dsd_parse.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    tmp = _SO + f".tmp.{os.getpid()}"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           "-o", tmp, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True,
+                       timeout=120)
+    except (OSError, subprocess.SubprocessError) as e:
+        log.warning("native parser build failed (%s); "
+                    "falling back to pure-Python parsing", e)
+        return False
+    os.replace(tmp, _SO)  # atomic: racing processes both succeed
+    return True
+
+
+def load() -> ctypes.CDLL | None:
+    """Load (building if needed) the native library, or None."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        fresh = (os.path.exists(_SO) and
+                 os.path.getmtime(_SO) >= os.path.getmtime(_SRC))
+        if not fresh and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as e:
+            log.warning("native parser load failed: %s", e)
+            return None
+        i64, u64p, u8p, f32p, f64p, i32p, i64p = (
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64))
+        lib.vtpu_parse_batch.restype = i64
+        lib.vtpu_parse_batch.argtypes = [
+            u8p, i64, u64p, u8p, f64p, u64p, f32p, u8p, i64p, i32p, i64]
+        lib.vtpu_hash_members.restype = None
+        lib.vtpu_hash_members.argtypes = [u8p, i64p, i64p, i64, u64p]
+        _lib = lib
+        return _lib

@@ -1,0 +1,317 @@
+// Columnar DogStatsD batch parser — the native data-loader for the TPU
+// ingest path.
+//
+// Role: the hot loop of the reference's ingest
+// (server.go:1240 ReadMetricSocket -> samplers/parser.go:298
+// ParseMetric), re-imagined as a batch transform: one contiguous buffer
+// of newline-separated metric lines in, struct-of-arrays out
+// (identity hash, type, value, weight, scope, name/line offsets).  The
+// Python side maps identity hashes to table rows with a vectorized
+// open-addressing table and ships whole columns to the device; only
+// never-seen-before series (and events/service checks/errors) take the
+// per-line Python slow path.
+//
+// Identity hash: fnv1a-64 over name, type code, SORTED tag bytes and
+// scope — the same identity triple as the reference's MetricKey
+// (samplers/parser.go:73) — finalized with murmur3 fmix64.  Set member
+// hashing matches veneur_tpu.utils.hashing.hash64 (fnv1a-64 + fmix64)
+// bit-for-bit so HLL register positions agree between paths.
+//
+// Build: g++ -O3 -shared -fPIC (see veneur_tpu/protocol/columnar.py).
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <cmath>
+
+namespace {
+
+constexpr uint64_t kFnvOffset = 14695981039346656037ULL;
+constexpr uint64_t kFnvPrime = 1099511628211ULL;
+
+inline uint64_t fnv1a64(uint64_t h, const uint8_t* p, int64_t n) {
+  for (int64_t i = 0; i < n; i++) h = (h ^ p[i]) * kFnvPrime;
+  return h;
+}
+
+inline uint64_t fmix64(uint64_t h) {
+  h ^= h >> 33;
+  h *= 0xFF51AFD7ED558CCDULL;
+  h ^= h >> 33;
+  h *= 0xC4CEB9FE1A85EC53ULL;
+  h ^= h >> 33;
+  return h;
+}
+
+// Fast float parse over a byte slice.  Handles [+-]digits[.digits] with
+// an exact digit accumulator; falls back to strtod for exponents and
+// other rarities.  Returns false on malformed.
+bool parse_value(const uint8_t* p, int64_t n, double* out) {
+  if (n <= 0 || n > 64) return false;
+  int64_t i = 0;
+  bool neg = false;
+  if (p[0] == '-') { neg = true; i = 1; }
+  else if (p[0] == '+') { i = 1; }
+  if (i >= n) return false;
+  uint64_t ipart = 0;
+  int idig = 0;
+  while (i < n && p[i] >= '0' && p[i] <= '9') {
+    if (idig < 18) { ipart = ipart * 10 + (p[i] - '0'); idig++; }
+    else goto slow;  // precision overflow: use strtod
+    i++;
+  }
+  if (i == n) {
+    if (idig == 0) return false;
+    *out = neg ? -(double)ipart : (double)ipart;
+    return true;
+  }
+  if (p[i] == '.') {
+    i++;
+    {
+      uint64_t fpart = 0;
+      int fdig = 0;
+      while (i < n && p[i] >= '0' && p[i] <= '9') {
+        if (fdig < 18) { fpart = fpart * 10 + (p[i] - '0'); fdig++; }
+        i++;
+      }
+      if (i != n || (idig == 0 && fdig == 0)) {
+        if (i < n) goto slow;
+        return false;
+      }
+      static const double kPow10[19] = {
+          1e0, 1e1, 1e2, 1e3, 1e4, 1e5, 1e6, 1e7, 1e8, 1e9, 1e10,
+          1e11, 1e12, 1e13, 1e14, 1e15, 1e16, 1e17, 1e18};
+      double v = (double)ipart + (double)fpart / kPow10[fdig];
+      *out = neg ? -v : v;
+      return true;
+    }
+  }
+slow: {
+    char tmp[65];
+    memcpy(tmp, p, n);
+    tmp[n] = 0;
+    char* end = nullptr;
+    double v = strtod(tmp, &end);
+    if (end != tmp + n) return false;
+    if (!std::isfinite(v)) return false;
+    *out = v;
+    return true;
+  }
+}
+
+struct Slice { const uint8_t* p; int64_t n; };
+
+inline int cmp_slice(const Slice& a, const Slice& b) {
+  int64_t n = a.n < b.n ? a.n : b.n;
+  int c = memcmp(a.p, b.p, (size_t)n);
+  if (c) return c;
+  return a.n < b.n ? -1 : (a.n > b.n ? 1 : 0);
+}
+
+constexpr int kMaxTags = 64;
+
+}  // namespace
+
+extern "C" {
+
+// Type codes shared with protocol/columnar.py
+enum : uint8_t {
+  T_COUNTER = 0, T_GAUGE = 1, T_TIMER = 2, T_HISTOGRAM = 3, T_SET = 4,
+  T_EVENT = 250, T_SERVICE_CHECK = 251, T_ERROR = 255,
+};
+
+// Parse newline-separated DogStatsD lines from buf[0:len].
+// All output arrays must have capacity >= the number of lines.
+// Returns the number of lines written.
+int64_t vtpu_parse_batch(
+    const uint8_t* buf, int64_t len,
+    uint64_t* key_hash, uint8_t* type_code, double* value,
+    uint64_t* member_hash, float* weight, uint8_t* scope,
+    int64_t* line_off, int32_t* line_len, int64_t max_lines) {
+  int64_t out = 0;
+  int64_t pos = 0;
+  while (pos < len && out < max_lines) {
+    int64_t eol = pos;
+    while (eol < len && buf[eol] != '\n') eol++;
+    const uint8_t* line = buf + pos;
+    int64_t n = eol - pos;
+    int64_t start = pos;
+    pos = eol + 1;
+    if (n == 0) continue;
+
+    line_off[out] = start;
+    line_len[out] = (int32_t)n;
+    key_hash[out] = 0;
+    value[out] = 0;
+    member_hash[out] = 0;
+    weight[out] = 1.0f;
+    scope[out] = 0;
+
+    // events / service checks -> slow path
+    if (n >= 3 && line[0] == '_') {
+      if (n >= 3 && line[1] == 'e' && line[2] == '{') {
+        type_code[out++] = T_EVENT;
+        continue;
+      }
+      if (n >= 4 && line[1] == 's' && line[2] == 'c' && line[3] == '|') {
+        type_code[out++] = T_SERVICE_CHECK;
+        continue;
+      }
+    }
+
+    // name:value|type[|@rate][|#tags]
+    int64_t colon = -1;
+    for (int64_t i = 0; i < n; i++) {
+      if (line[i] == ':') { colon = i; break; }
+    }
+    if (colon <= 0) { type_code[out++] = T_ERROR; continue; }
+    int64_t pipe1 = -1;
+    for (int64_t i = colon + 1; i < n; i++) {
+      if (line[i] == '|') { pipe1 = i; break; }
+    }
+    if (pipe1 < 0 || pipe1 == colon + 1) {
+      type_code[out++] = T_ERROR;
+      continue;
+    }
+    int64_t type_end = pipe1 + 1;
+    while (type_end < n && line[type_end] != '|') type_end++;
+    int64_t tlen = type_end - (pipe1 + 1);
+    uint8_t tc;
+    uint8_t t0 = tlen >= 1 ? line[pipe1 + 1] : 0;
+    if (tlen == 1) {
+      switch (t0) {
+        case 'c': tc = T_COUNTER; break;
+        case 'g': tc = T_GAUGE; break;
+        case 'm': tc = T_TIMER; break;
+        case 'h': tc = T_HISTOGRAM; break;
+        case 'd': tc = T_HISTOGRAM; break;
+        case 's': tc = T_SET; break;
+        default: type_code[out++] = T_ERROR; continue;
+      }
+    } else if (tlen == 2 && t0 == 'm' && line[pipe1 + 2] == 's') {
+      tc = T_TIMER;
+    } else {
+      type_code[out++] = T_ERROR;
+      continue;
+    }
+
+    // optional sections
+    double rate = 1.0;
+    Slice tags[kMaxTags];
+    int ntags = 0;
+    uint8_t sc = 0;
+    bool bad = false;
+    bool too_many_tags = false;
+    int64_t sec = type_end;
+    while (sec < n) {
+      // sec points at '|'
+      int64_t s0 = sec + 1;
+      int64_t s1 = s0;
+      while (s1 < n && line[s1] != '|') s1++;
+      if (s0 >= n) { bad = true; break; }
+      if (line[s0] == '@') {
+        if (!parse_value(line + s0 + 1, s1 - s0 - 1, &rate) ||
+            !(rate > 0.0 && rate <= 1.0)) {
+          bad = true;
+          break;
+        }
+      } else if (line[s0] == '#') {
+        int64_t t = s0 + 1;
+        while (t <= s1) {
+          int64_t e = t;
+          while (e < s1 && line[e] != ',') e++;
+          int64_t L = e - t;
+          if (L > 0) {
+            // scope magic tags: prefix match as the reference does
+            // (parser.go:397-407)
+            if (L >= 15 &&
+                memcmp(line + t, "veneurlocalonly", 15) == 0) {
+              sc = 1;
+            } else if (L >= 16 &&
+                       memcmp(line + t, "veneurglobalonly", 16) == 0) {
+              sc = 2;
+            } else if (ntags < kMaxTags) {
+              tags[ntags].p = line + t;
+              tags[ntags].n = L;
+              ntags++;
+            } else {
+              too_many_tags = true;
+            }
+          }
+          t = e + 1;
+        }
+      } else {
+        bad = true;
+        break;
+      }
+      sec = s1;
+    }
+    if (bad || too_many_tags) {
+      // too_many_tags falls back to the (unbounded) Python parser so
+      // behavior matches, just slower
+      type_code[out++] = T_ERROR;
+      continue;
+    }
+    if (tc == T_GAUGE && rate != 1.0) {
+      type_code[out++] = T_ERROR;
+      continue;
+    }
+
+    int64_t vlen = pipe1 - (colon + 1);
+    if (tc == T_SET) {
+      member_hash[out] =
+          fmix64(fnv1a64(kFnvOffset, line + colon + 1, vlen));
+    } else {
+      double v;
+      if (!parse_value(line + colon + 1, vlen, &v) ||
+          !std::isfinite(v)) {
+        type_code[out++] = T_ERROR;
+        continue;
+      }
+      value[out] = v;
+    }
+    weight[out] = (float)(1.0 / rate);
+    scope[out] = sc;
+
+    // identity hash over name \0 type \0 sorted-tags \0 scope —
+    // insertion sort on slices (tag lists are tiny)
+    for (int i = 1; i < ntags; i++) {
+      Slice key = tags[i];
+      int j = i - 1;
+      while (j >= 0 && cmp_slice(tags[j], key) > 0) {
+        tags[j + 1] = tags[j];
+        j--;
+      }
+      tags[j + 1] = key;
+    }
+    uint64_t h = fnv1a64(kFnvOffset, line, colon);  // name
+    uint8_t sep = 0;
+    h = fnv1a64(h, &sep, 1);
+    h = fnv1a64(h, &tc, 1);
+    h = fnv1a64(h, &sep, 1);
+    for (int i = 0; i < ntags; i++) {
+      if (i) {
+        uint8_t comma = ',';
+        h = fnv1a64(h, &comma, 1);
+      }
+      h = fnv1a64(h, tags[i].p, tags[i].n);
+    }
+    h = fnv1a64(h, &sep, 1);
+    h = fnv1a64(h, &sc, 1);
+    key_hash[out] = fmix64(h);
+    type_code[out] = tc;
+    out++;
+  }
+  return out;
+}
+
+// Vectorized member hasher for HLL set values arriving via the slow
+// path — must match hash64 in utils/hashing.py.
+void vtpu_hash_members(const uint8_t* buf, const int64_t* offs,
+                       const int64_t* lens, int64_t n, uint64_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    out[i] = fmix64(fnv1a64(kFnvOffset, buf + offs[i], lens[i]));
+  }
+}
+
+}  // extern "C"

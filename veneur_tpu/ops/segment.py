@@ -35,6 +35,13 @@ STAT_WEIGHT, STAT_MIN, STAT_MAX, STAT_SUM, STAT_RSUM = range(HISTO_STAT_COLS)
 
 _F32_MAX = jnp.float32(jnp.finfo(jnp.float32).max)
 
+# Untouched-row sentinels for the min/max columns — the role of the
+# reference's math.Inf(+1)/math.Inf(-1) initialisation
+# (samplers/samplers.go:504-506), kept inf-free so NaN-propagation rules
+# never bite in fused reductions.
+STAT_MIN_EMPTY = float(jnp.finfo(jnp.float32).max)
+STAT_MAX_EMPTY = -float(jnp.finfo(jnp.float32).max)
+
 
 def counter_update(state: Array, row_ids: Array, values: Array,
                    weights: Array) -> Array:

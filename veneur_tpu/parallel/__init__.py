@@ -1,0 +1,7 @@
+"""Multi-chip parallelism: sharded global-tier aggregation over a
+``jax.sharding.Mesh`` with flush-time collective merges (see
+``sharded`` for the design)."""
+
+from veneur_tpu.parallel.sharded import (  # noqa: F401
+    SHARD, SERIES, ShardedAggregator, ShardedConfig, empty_state,
+    make_merge_step, make_mesh, make_update_step, readout)

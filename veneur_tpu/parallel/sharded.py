@@ -1,0 +1,425 @@
+"""Multi-chip sharded aggregation: the global tier over a device mesh.
+
+The reference scales the global tier by running importsrv gRPC fan-in
+into N worker goroutines (importsrv/server.go:102-133) and merging
+forwarded sketches per worker (worker.go:438 ``ImportMetricGRPC``); a
+proxy consistent-hashes series across global *processes*
+(proxysrv/server.go:190).  On a TPU slice both levels collapse into one
+SPMD program over a 2D ``jax.sharding.Mesh``:
+
+  axis ``shard``   — ingest parallelism.  Each device along this axis
+                     accumulates PARTIAL state for every series from its
+                     own slice of the sample stream (the moral
+                     equivalent of one importsrv worker / one local
+                     veneur's worth of state).  Merging partials is
+                     exactly the CRDT merge the reference does at
+                     import time — but here it happens once per flush
+                     as ICI collectives instead of per-RPC.
+  axis ``series``  — table-row parallelism.  The row dimension of every
+                     state plane is partitioned, so series-cardinality
+                     scales with devices (the reference's fnv1a%N worker
+                     sharding, server.go:1152, as a sharding
+                     annotation).
+
+State planes (leading axis = shard, rows sharded over series):
+
+  counters      f32[S, R]        merge: psum over shard
+  gauges        f32[S, R]        merge: value at pmax arrival ticket
+  gauge_ticket  i32[S, R]
+  histo_stats   f32[S, R, 5]     merge: psum / pmin / pmax per column
+  histo_means   f32[S, R, C]     merge: all_gather slots + one k-scale
+  histo_weights f32[S, R, C]            re-cluster (ops.tdigest)
+  hll           u8[S, R, M]      merge: pmax over shard (register max)
+
+The update step and the merge step are each one ``shard_map``-ped jitted
+function; everything between flushes is pure per-device work with zero
+communication, and the flush-time collectives ride ICI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from veneur_tpu.ops import hll as hll_ops
+from veneur_tpu.ops import tdigest
+from veneur_tpu.ops.segment import (HISTO_STAT_COLS, STAT_MAX, STAT_MIN,
+                                    STAT_MAX_EMPTY, STAT_MIN_EMPTY,
+                                    STAT_RSUM, STAT_SUM, STAT_WEIGHT)
+
+SHARD = "shard"
+SERIES = "series"
+
+
+def make_mesh(devices=None, n_shard: int | None = None) -> Mesh:
+    """Build the 2D (shard, series) mesh over the given devices.
+
+    Default split: series axis gets 2 when the device count is even and
+    >2 (row-space sharding is the cheaper axis to under-provision —
+    partial-state merge cost grows with ``shard``), else 1.
+    """
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    n = devs.size
+    if n_shard is None:
+        n_series = 2 if n % 2 == 0 and n > 2 else 1
+        n_shard = n // n_series
+    else:
+        if n % n_shard:
+            raise ValueError(f"{n} devices not divisible by {n_shard}")
+        n_series = n // n_shard
+    return Mesh(devs.reshape(n_shard, n_series), (SHARD, SERIES))
+
+
+@dataclass(frozen=True)
+class ShardedConfig:
+    rows: int = 1024          # total table rows per class (global)
+    set_rows: int = 64
+    compression: float = 100.0
+    slots: int = 64           # densify slots per update call
+    batch: int = 1024         # per-shard samples per update call
+
+    def capacity(self) -> int:
+        return tdigest.capacity_for(self.compression)
+
+
+def _specs(mesh: Mesh):
+    """(state spec pytree, batch spec) for shard_map."""
+    st = P(SHARD, SERIES)
+    return {
+        "counters": st, "gauges": st, "gauge_ticket": st,
+        "histo_stats": P(SHARD, SERIES, None),
+        "histo_means": P(SHARD, SERIES, None),
+        "histo_weights": P(SHARD, SERIES, None),
+        "hll": P(SHARD, SERIES, None),
+    }
+
+
+def empty_state(mesh: Mesh, cfg: ShardedConfig) -> dict:
+    """Allocate the sharded state pytree on the mesh."""
+    s = mesh.shape[SHARD]
+    r, rs = cfg.rows, cfg.set_rows
+    cap = cfg.capacity()
+    specs = _specs(mesh)
+
+    def dev(name, arr):
+        return jax.device_put(arr, NamedSharding(mesh, specs[name]))
+
+    stats = np.zeros((s, r, HISTO_STAT_COLS), np.float32)
+    stats[:, :, STAT_MIN] = STAT_MIN_EMPTY
+    stats[:, :, STAT_MAX] = STAT_MAX_EMPTY
+    return {
+        "counters": dev("counters", np.zeros((s, r), np.float32)),
+        "gauges": dev("gauges", np.zeros((s, r), np.float32)),
+        "gauge_ticket": dev("gauge_ticket",
+                            np.full((s, r), -1, np.int32)),
+        "histo_stats": dev("histo_stats", stats),
+        "histo_means": dev("histo_means",
+                           np.zeros((s, r, cap), np.float32)),
+        "histo_weights": dev("histo_weights",
+                             np.zeros((s, r, cap), np.float32)),
+        "hll": dev("hll", np.zeros((s, rs, hll_ops.M), np.uint8)),
+    }
+
+
+def batch_specs():
+    """Batch arrays are [S, N]: split over shard, replicated over
+    series (each series-device sees the full batch and keeps only the
+    row ids that fall in its block)."""
+    b = P(SHARD, None)
+    return {k: b for k in (
+        "counter_rows", "counter_vals", "counter_wts",
+        "gauge_rows", "gauge_vals", "gauge_ticket",
+        "histo_rows", "histo_vals", "histo_wts",
+        "set_rows", "set_idx", "set_rank")}
+
+
+def _localize(rows, n_local, axis):
+    """Global row ids -> block-local ids; out-of-block -> n_local
+    (the drop sentinel).  Negative ids must NOT reach the scatter
+    (JAX would wrap them to the end of the block)."""
+    offset = jax.lax.axis_index(axis) * n_local
+    local = rows - offset
+    in_block = (local >= 0) & (local < n_local)
+    return jnp.where(in_block, local, n_local)
+
+
+def make_update_step(mesh: Mesh, cfg: ShardedConfig):
+    """Jitted donated SPMD ingest step: state, batch -> state.
+
+    Pure per-device work — no collectives; communication happens only
+    in the flush-time merge.
+    """
+    state_specs = _specs(mesh)
+    n_series = mesh.shape[SERIES]
+    r_local = cfg.rows // n_series
+    rs_local = cfg.set_rows // n_series
+    if cfg.rows % n_series or cfg.set_rows % n_series:
+        raise ValueError("rows must divide by the series axis size")
+
+    def step(state, batch):
+        # every local plane has leading shard dim 1 — squeeze it
+        cnt = state["counters"][0]
+        g = state["gauges"][0]
+        gt = state["gauge_ticket"][0]
+        hs = state["histo_stats"][0]
+        hm = state["histo_means"][0]
+        hw = state["histo_weights"][0]
+        regs = state["hll"][0]
+
+        crow = _localize(batch["counter_rows"][0], r_local, SERIES)
+        cnt = cnt.at[crow].add(
+            batch["counter_vals"][0] * batch["counter_wts"][0],
+            mode="drop")
+
+        # gauge last-write-wins with a global arrival ticket: scatter
+        # max of ticket, then adopt the batch value wherever its ticket
+        # won (ticket uniqueness is the host's contract)
+        grow = _localize(batch["gauge_rows"][0], r_local, SERIES)
+        new_t = gt.at[grow].max(batch["gauge_ticket"][0], mode="drop")
+        won = jnp.zeros_like(g).at[grow].max(
+            jnp.where(
+                batch["gauge_ticket"][0] ==
+                new_t[jnp.clip(grow, 0, r_local - 1)],
+                batch["gauge_vals"][0], -jnp.inf),
+            mode="drop")
+        changed = new_t > gt
+        g = jnp.where(changed, won, g)
+        gt = new_t
+
+        hrow = _localize(batch["histo_rows"][0], r_local, SERIES)
+        hv = batch["histo_vals"][0]
+        hwt = batch["histo_wts"][0]
+        incoming = jnp.stack([
+            hwt, jnp.where(hwt > 0, hv, STAT_MIN_EMPTY),
+            jnp.where(hwt > 0, hv, STAT_MAX_EMPTY), hv * hwt,
+            jnp.where(hv != 0, hwt / hv, 0.0)], axis=1)
+        hs = jnp.stack([
+            hs[:, STAT_WEIGHT].at[hrow].add(incoming[:, STAT_WEIGHT],
+                                            mode="drop"),
+            hs[:, STAT_MIN].at[hrow].min(incoming[:, STAT_MIN],
+                                         mode="drop"),
+            hs[:, STAT_MAX].at[hrow].max(incoming[:, STAT_MAX],
+                                         mode="drop"),
+            hs[:, STAT_SUM].at[hrow].add(incoming[:, STAT_SUM],
+                                         mode="drop"),
+            hs[:, STAT_RSUM].at[hrow].add(incoming[:, STAT_RSUM],
+                                          mode="drop"),
+        ], axis=1)
+
+        dense_v, dense_w = tdigest.densify(hrow, hv, hwt, r_local,
+                                           cfg.slots)
+        hm, hw = tdigest._merge_impl(hm, hw, dense_v, dense_w,
+                                     compression=cfg.compression)
+
+        srow = _localize(batch["set_rows"][0], rs_local, SERIES)
+        regs = regs.at[srow, batch["set_idx"][0]].max(
+            batch["set_rank"][0].astype(regs.dtype), mode="drop")
+
+        return {
+            "counters": cnt[None], "gauges": g[None],
+            "gauge_ticket": gt[None], "histo_stats": hs[None],
+            "histo_means": hm[None], "histo_weights": hw[None],
+            "hll": regs[None],
+        }
+
+    mapped = shard_map(step, mesh=mesh,
+                       in_specs=(state_specs, batch_specs()),
+                       out_specs=state_specs, check_rep=False)
+    return jax.jit(mapped, donate_argnums=0)
+
+
+def make_merge_step(mesh: Mesh, cfg: ShardedConfig):
+    """Jitted SPMD flush merge: partial per-shard state -> one merged
+    table, via ICI collectives.
+
+    counter psum / gauge ticket-pmax / stat psum+pmin+pmax / t-digest
+    all_gather+re-cluster / HLL register pmax — the device-side
+    equivalent of the reference's import-merge semantics
+    (samplers.go:208 Counter.Merge, :423 Set.Merge, :726 Histo.Merge).
+    """
+    state_specs = _specs(mesh)
+    merged_specs = {
+        "counters": P(SERIES), "gauges": P(SERIES),
+        "histo_stats": P(SERIES, None),
+        "histo_means": P(SERIES, None),
+        "histo_weights": P(SERIES, None),
+        "hll": P(SERIES, None),
+    }
+
+    def merge(state):
+        cnt = jax.lax.psum(state["counters"][0], SHARD)
+
+        ticket = state["gauge_ticket"][0]
+        best = jax.lax.pmax(ticket, SHARD)
+        gv = jax.lax.pmax(
+            jnp.where((ticket == best) & (best >= 0),
+                      state["gauges"][0], -jnp.inf), SHARD)
+        gauges = jnp.where(best >= 0, gv, 0.0)
+
+        hs = state["histo_stats"][0]
+        stats = jnp.stack([
+            jax.lax.psum(hs[:, STAT_WEIGHT], SHARD),
+            jax.lax.pmin(hs[:, STAT_MIN], SHARD),
+            jax.lax.pmax(hs[:, STAT_MAX], SHARD),
+            jax.lax.psum(hs[:, STAT_SUM], SHARD),
+            jax.lax.psum(hs[:, STAT_RSUM], SHARD),
+        ], axis=1)
+
+        # digest union: gather every shard's centroid slots along the
+        # slot axis, then one batched re-cluster into fresh planes
+        gm = jax.lax.all_gather(state["histo_means"][0], SHARD,
+                                axis=1, tiled=True)
+        gw = jax.lax.all_gather(state["histo_weights"][0], SHARD,
+                                axis=1, tiled=True)
+        zm = jnp.zeros_like(state["histo_means"][0])
+        zw = jnp.zeros_like(state["histo_weights"][0])
+        mm, mw = tdigest._merge_impl(zm, zw, gm, gw,
+                                     compression=cfg.compression)
+
+        regs = jax.lax.pmax(state["hll"][0], SHARD)
+
+        return {"counters": cnt, "gauges": gauges, "histo_stats": stats,
+                "histo_means": mm, "histo_weights": mw, "hll": regs}
+
+    mapped = shard_map(merge, mesh=mesh, in_specs=(state_specs,),
+                       out_specs=merged_specs, check_rep=False)
+    return jax.jit(mapped)
+
+
+def readout(merged: dict, qs: np.ndarray) -> dict:
+    """Flush readout over the merged table: per-row quantiles and HLL
+    estimates (row-parallel over the series sharding — XLA keeps the
+    row partitioning without any reshard)."""
+    quant = tdigest.quantile(
+        merged["histo_means"], merged["histo_weights"],
+        jnp.asarray(qs, jnp.float32),
+        merged["histo_stats"][:, STAT_MIN],
+        merged["histo_stats"][:, STAT_MAX])
+    est = hll_ops.estimate(merged["hll"])
+    return {"quantiles": quant, "hll_estimate": est}
+
+
+class ShardedAggregator:
+    """Host-side wrapper: per-shard columnar staging + one SPMD step.
+
+    The host routes each sample to a shard (round-robin or by packet
+    origin — any assignment is correct, the merge is a CRDT union) and
+    row ids are global.  This is the ingest surface the gRPC importsrv
+    listener feeds on a multi-chip global node.
+    """
+
+    def __init__(self, mesh: Mesh, cfg: ShardedConfig | None = None):
+        self.mesh = mesh
+        self.cfg = cfg or ShardedConfig()
+        self.n_shard = mesh.shape[SHARD]
+        self.state = empty_state(mesh, self.cfg)
+        self._update = make_update_step(mesh, self.cfg)
+        self._merge = make_merge_step(mesh, self.cfg)
+        self._ticket = 0
+        self._stage = [self._empty_stage() for _ in range(self.n_shard)]
+
+    @staticmethod
+    def _empty_stage():
+        return {k: [] for k in (
+            "counter_rows", "counter_vals", "counter_wts",
+            "gauge_rows", "gauge_vals", "gauge_ticket",
+            "histo_rows", "histo_vals", "histo_wts",
+            "set_rows", "set_idx", "set_rank")}
+
+    def next_ticket(self, n: int = 1) -> np.ndarray:
+        t = np.arange(self._ticket, self._ticket + n, dtype=np.int32)
+        self._ticket += n
+        return t
+
+    def stage(self, shard: int, **cols) -> None:
+        st = self._stage[shard % self.n_shard]
+        for k, v in cols.items():
+            st[k].append(np.asarray(v))
+
+    _DTYPES = {"counter_rows": np.int32, "counter_vals": np.float32,
+               "counter_wts": np.float32, "gauge_rows": np.int32,
+               "gauge_vals": np.float32, "gauge_ticket": np.int32,
+               "histo_rows": np.int32, "histo_vals": np.float32,
+               "histo_wts": np.float32, "set_rows": np.int32,
+               "set_idx": np.int32, "set_rank": np.int32}
+
+    def step(self) -> None:
+        """Push staged samples through SPMD updates.
+
+        Histo samples are chunked by within-row rank on the host so no
+        row exceeds ``cfg.slots`` samples per update call — ``densify``
+        drops beyond the slot width (the same contract the single-chip
+        table honors in ``_histo_device_step``).
+        """
+        n = self.cfg.batch
+        cols = {}
+        for key, dt in self._DTYPES.items():
+            planes = []
+            for st in self._stage:
+                col = (np.concatenate([np.asarray(a, dt).ravel()
+                                       for a in st[key]])
+                       if st[key] else np.zeros(0, dt))
+                if len(col) > n:
+                    raise ValueError(
+                        f"staged {key} overflow: {len(col)} > {n}; call "
+                        "step() more often or raise cfg.batch")
+                planes.append(col)
+            cols[key] = planes
+        self._stage = [self._empty_stage() for _ in range(self.n_shard)]
+
+        # within-row rank -> chunk id, per shard
+        chunk_of = []
+        n_chunks = 1
+        for rows in cols["histo_rows"]:
+            if len(rows) == 0:
+                chunk_of.append(np.zeros(0, np.int64))
+                continue
+            order = np.argsort(rows, kind="stable")
+            srows = rows[order]
+            first = np.ones(len(rows), bool)
+            first[1:] = srows[1:] != srows[:-1]
+            start = np.maximum.accumulate(
+                np.where(first, np.arange(len(rows)), 0))
+            rank = np.empty(len(rows), np.int64)
+            rank[order] = np.arange(len(rows)) - start
+            c = rank // self.cfg.slots
+            chunk_of.append(c)
+            n_chunks = max(n_chunks, int(c.max()) + 1)
+
+        for ci in range(n_chunks):
+            batch = {}
+            for key, dt in self._DTYPES.items():
+                fill = {"counter_rows": self.cfg.rows,
+                        "gauge_rows": self.cfg.rows,
+                        "histo_rows": self.cfg.rows,
+                        "set_rows": self.cfg.set_rows,
+                        "gauge_ticket": -1}.get(key, 0)
+                planes = []
+                for si in range(self.n_shard):
+                    col = cols[key][si]
+                    if key.startswith("histo"):
+                        col = col[chunk_of[si] == ci]
+                    elif ci > 0:
+                        col = col[:0]
+                    plane = np.full(n, fill, dt)
+                    plane[:len(col)] = col
+                    planes.append(plane)
+                batch[key] = np.stack(planes)
+            specs = batch_specs()
+            jbatch = {k: jax.device_put(
+                jnp.asarray(v), NamedSharding(self.mesh, specs[k]))
+                for k, v in batch.items()}
+            self.state = self._update(self.state, jbatch)
+
+    def flush(self, qs=(0.5, 0.9, 0.99)) -> dict:
+        """Merge partial shards with collectives and read out."""
+        merged = self._merge(self.state)
+        out = readout(merged, np.asarray(qs, np.float32))
+        merged.update(out)
+        return merged
