@@ -1,0 +1,242 @@
+"""Benchmark harness: BASELINE configs 0-3 on the attached device.
+
+Measures the aggregation pipeline the way the reference's benchmark
+suite does (worker ingest BenchmarkWork worker_test.go:506, flush
+server_test.go:1139, tdigest histo_test.go:181) — from raw DogStatsD
+datagram bytes through native columnar parse, table ingest, device
+update and flush readout.  Socket recv is excluded (kernel-bound, not
+framework-bound), matching the reference benchmarks which also inject
+post-socket.
+
+Methodology: each config runs the FULL pipeline (ingest + device +
+flush readout) once untimed to compile every kernel and allocate the
+series rows, swaps the interval, then times a steady-state interval —
+the per-interval cost of a long-running server, which is what
+samples/sec/chip means for a system whose series population persists.
+The cold first-interval cost is reported separately.
+
+Prints ONE JSON line:
+  {"metric", "value", "unit", "vs_baseline", "configs": {...}}
+
+vs_baseline is value / 10M — the BASELINE.json north-star target of
+10M samples/sec/chip (the reference's only published ingest number is
+60k packets/s, README.md:310).
+
+Usage: python bench.py [--quick]   (--quick: 10x smaller volumes)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+QUICK = "--quick" in sys.argv
+SCALE = 10 if QUICK else 1
+
+
+def _mk_table(**kw):
+    from veneur_tpu.core.table import MetricTable, TableConfig
+    return MetricTable(TableConfig(**kw))
+
+
+def _block(table):
+    import jax
+    for arr in (table.counters, table.gauges, table.histo_stats,
+                table.histo_means, table.hll_regs):
+        jax.block_until_ready(arr)
+
+
+def _interval(table, bufs, parser, flush):
+    """One flush interval: parse+ingest+device over all buffers, then
+    swap and run the flush readout.  Returns (samples, flush_out)."""
+    total = 0
+    for buf in bufs:
+        pb = parser.parse(buf)
+        p, _ = table.ingest_columns(pb)
+        total += p
+        table.device_step()
+    snap = table.swap()
+    out = flush(snap)
+    return total, out
+
+
+def _run_config(bufs, flush, **table_kw):
+    """cold interval (compiles + row allocation) then timed steady
+    interval on the same table."""
+    from veneur_tpu.protocol import columnar
+    parser = columnar.ColumnarParser()
+    table = _mk_table(**table_kw)
+    t0 = time.perf_counter()
+    _interval(table, bufs, parser, flush)
+    _block(table)
+    cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    total, out = _interval(table, bufs, parser, flush)
+    _block(table)
+    dt = time.perf_counter() - t0
+    return {"samples": total, "seconds": round(dt, 4),
+            "samples_per_sec": round(total / dt, 1),
+            "cold_interval_seconds": round(cold, 4)}, out
+
+
+def bench_counters() -> dict:
+    """Config 0: 1k names x 1M samples, counters only."""
+    n = 1_000_000 // SCALE
+    vals = np.random.default_rng(0).integers(1, 100, n)
+    lines = [f"svc.req.count.{i % 1000}:{vals[i]}|c".encode()
+             for i in range(n)]
+    chunk = 1 << 20
+    bufs = [b"\n".join(lines[i:i + chunk])
+            for i in range(0, n, chunk)]
+
+    def flush(snap):
+        return float(np.asarray(snap.counters).sum())
+
+    res, got = _run_config(bufs, flush)
+    want = float(vals.sum())
+    assert abs(got - want) < max(1.0, want * 1e-5), (got, want)
+    return res
+
+
+def bench_cardinality() -> dict:
+    """Config 1: counters+gauges at 100k tag cardinality."""
+    n = 2_000_000 // SCALE
+    card = 100_000
+    rng = np.random.default_rng(1)
+    keys = rng.integers(0, card, n)
+    lines = []
+    for i in range(n):
+        k = keys[i]
+        if i % 2 == 0:
+            lines.append(
+                f"api.hits:1|c|#route:r{k % 997},user:u{k}".encode())
+        else:
+            lines.append(
+                f"api.depth:{i % 50}|g|#route:r{k % 997},user:u{k}"
+                .encode())
+    chunk = 1 << 20
+    bufs = [b"\n".join(lines[i:i + chunk])
+            for i in range(0, n, chunk)]
+
+    def flush(snap):
+        return (int(snap.counter_touched.sum()) +
+                int(snap.gauge_touched.sum()),
+                sum(snap.overflow.values()))
+
+    rows = 1 << 18
+    res, (series, dropped) = _run_config(bufs, flush,
+                                         counter_rows=rows,
+                                         gauge_rows=rows)
+    res["series"] = series
+    res["dropped"] = dropped
+    return res
+
+
+def bench_timers() -> dict:
+    """Config 2: 10k series, 10M samples, p50/p90/p99 at flush +
+    accuracy vs exact."""
+    import jax.numpy as jnp
+    from veneur_tpu.ops import tdigest
+
+    n = 10_000_000 // SCALE
+    n_series = 10_000
+    rng = np.random.default_rng(2)
+    rows = rng.integers(0, n_series, n).astype(np.int32)
+    vals = rng.gamma(2.0, 30.0, n).astype(np.float32)
+    chunk = 1 << 20
+
+    def one_interval(table):
+        for i in range(0, n, chunk):
+            r = rows[i:i + chunk]
+            table._histo_device_step(r, vals[i:i + chunk],
+                                     np.ones(len(r), np.float32))
+        qs = jnp.asarray(np.asarray([0.5, 0.9, 0.99], np.float32))
+        stats = np.asarray(table.histo_stats)
+        quant = np.asarray(tdigest.quantile(
+            table.histo_means, table.histo_weights, qs,
+            jnp.asarray(stats[:, 1]), jnp.asarray(stats[:, 2])))
+        return quant
+
+    table = _mk_table(histo_rows=n_series, histo_slots=1024)
+    t0 = time.perf_counter()
+    one_interval(table)
+    _block(table)
+    cold = time.perf_counter() - t0
+    table.swap()
+    t0 = time.perf_counter()
+    quant = one_interval(table)
+    _block(table)
+    dt = time.perf_counter() - t0
+
+    errs = {0.5: [], 0.9: [], 0.99: []}
+    check = rng.choice(n_series, 200, replace=False)
+    for s in check:
+        sv = np.sort(vals[rows == s])
+        if len(sv) < 100:
+            continue
+        for qi, p in enumerate((0.5, 0.9, 0.99)):
+            exact = float(np.quantile(sv, p))
+            errs[p].append(abs(quant[s, qi] - exact) /
+                           max(abs(exact), 1e-9))
+    return {"samples": n, "seconds": round(dt, 4),
+            "samples_per_sec": round(n / dt, 1),
+            "cold_interval_seconds": round(cold, 4),
+            "p50_err_mean": float(np.mean(errs[0.5])),
+            "p90_err_mean": float(np.mean(errs[0.9])),
+            "p99_err_mean": float(np.mean(errs[0.99])),
+            "p99_err_max": float(np.max(errs[0.99]))}
+
+
+def bench_sets() -> dict:
+    """Config 3: 1k set series x 1M unique members, HLL at flush."""
+    from veneur_tpu.ops import hll
+    n = 1_000_000 // SCALE
+    per = n // 1000
+    lines = [f"uniq.{i % 1000}:m{i}|s".encode() for i in range(n)]
+    chunk = 1 << 20
+    bufs = [b"\n".join(lines[i:i + chunk])
+            for i in range(0, n, chunk)]
+
+    def flush(snap):
+        est = np.asarray(hll.estimate(snap.hll_regs))
+        live = snap.set_touched[:len(snap.set_meta)]
+        return est[:len(snap.set_meta)][live]
+
+    res, got = _run_config(bufs, flush, set_rows=1024)
+    err = np.abs(got - per) / per
+    res["uniques_per_series"] = per
+    res["hll_err_mean"] = float(err.mean())
+    res["hll_err_max"] = float(err.max())
+    return res
+
+
+def main() -> None:
+    t_start = time.time()
+    configs = {}
+    configs["0_counters_1k_names"] = bench_counters()
+    configs["1_cardinality_100k"] = bench_cardinality()
+    configs["2_timers_10k_series"] = bench_timers()
+    configs["3_sets_1m_uniques"] = bench_sets()
+
+    headline = configs["0_counters_1k_names"]["samples_per_sec"]
+    target = 10_000_000.0
+    out = {
+        "metric": "aggregation_samples_per_sec_chip",
+        "value": round(headline, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(headline / target, 4),
+        "quick": QUICK,
+        "wall_seconds": round(time.time() - t_start, 1),
+        "configs": {k: {kk: (round(vv, 6)
+                             if isinstance(vv, float) else vv)
+                        for kk, vv in v.items()}
+                    for k, v in configs.items()},
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,218 @@
+"""Columnar (native) parse path: agreement with the per-line reference
+parser, key-index behavior, and batch table ingest equivalence."""
+
+import numpy as np
+import pytest
+
+from veneur_tpu.core.table import MetricTable, TableConfig
+from veneur_tpu.protocol import columnar, dogstatsd as dsd
+from veneur_tpu.utils import hashing, intern
+
+pytestmark = pytest.mark.skipif(
+    not columnar.ColumnarParser().available,
+    reason="native parser unavailable (no C++ toolchain)")
+
+
+@pytest.fixture(scope="module")
+def parser():
+    return columnar.ColumnarParser()
+
+
+TYPE_CODES = {dsd.COUNTER: 0, dsd.GAUGE: 1, dsd.TIMER: 2,
+              dsd.HISTOGRAM: 3, dsd.SET: 4}
+SCOPE_CODES = {dsd.SCOPE_DEFAULT: 0, dsd.SCOPE_LOCAL: 1,
+               dsd.SCOPE_GLOBAL: 2}
+
+
+@pytest.mark.parametrize("line", [
+    b"hits:3|c",
+    b"hits:4.25|c|@0.5",
+    b"temp:-42.5|g",
+    b"lat:12.5|ms|#env:prod,svc:api",
+    b"lat:1|m",
+    b"dist:9|d",
+    b"h:0.001|h|#b:2,a:1,c:3",
+    b"g:1e3|c",
+    b"s:+5|c",
+    b"x:5|h|#veneurlocalonly",
+    b"x:5|h|#veneurglobalonly,env:x",
+    b"x:5|h|#veneurglobalonly:true",
+])
+def test_agreement_with_slow_parser(parser, line):
+    """Every accepted line must produce the same (type, value, rate,
+    tags-identity, scope) as protocol.dogstatsd."""
+    s = dsd.parse_metric(line)
+    pb = parser.parse(line)
+    assert pb.n == 1
+    assert int(pb.type_code[0]) == TYPE_CODES[s.type]
+    if s.type != dsd.SET:
+        assert pb.value[0] == pytest.approx(float(s.value), rel=1e-9)
+    assert pb.weight[0] == pytest.approx(1.0 / s.sample_rate, rel=1e-6)
+    assert int(pb.scope[0]) == SCOPE_CODES[s.scope]
+    expect = hashing.key_hash64(s.name, TYPE_CODES[s.type], s.tags,
+                                SCOPE_CODES[s.scope])
+    assert int(pb.key_hash[0]) == expect
+
+
+@pytest.mark.parametrize("line", [
+    b"garbage",
+    b"noval:|c",
+    b":5|c",
+    b"x:5|q",
+    b"x:abc|c",
+    b"x:5|c|@2.0",
+    b"x:5|c|@0",
+    b"x:5|g|@0.5",       # gauge with sample rate
+    b"x:nan|c",
+    b"x:inf|c",
+    b"x:5|c|unknown",
+])
+def test_rejects_match_slow_parser(parser, line):
+    """Lines the reference grammar rejects are flagged T_ERROR (and the
+    slow parser agrees they're bad)."""
+    with pytest.raises(dsd.ParseError):
+        dsd.parse_metric(line)
+    pb = parser.parse(line)
+    assert pb.n == 1
+    assert int(pb.type_code[0]) == columnar.CODE_ERROR
+
+
+def test_events_and_checks_marked_slow_path(parser):
+    pb = parser.parse(b"_e{5,5}:hello|world\n_sc|db.up|0")
+    assert list(pb.type_code) == [columnar.CODE_EVENT,
+                                  columnar.CODE_SERVICE_CHECK]
+
+
+def test_tag_order_insensitive_hash(parser):
+    a = parser.parse(b"m:1|c|#b:2,a:1").key_hash[0]
+    b = parser.parse(b"m:1|c|#a:1,b:2").key_hash[0]
+    assert int(a) == int(b)
+
+
+def test_set_member_hash_matches_host_hasher(parser):
+    pb = parser.parse(b"u:member-xyz|s")
+    assert int(pb.member_hash[0]) == int(
+        hashing.hash64([b"member-xyz"])[0])
+
+
+def test_timer_histogram_distinct_identity(parser):
+    t = parser.parse(b"m:1|ms").key_hash[0]
+    h = parser.parse(b"m:1|h").key_hash[0]
+    assert int(t) != int(h)
+
+
+def test_hash_index_roundtrip():
+    hi = intern.HashIndex(capacity=64)
+    keys = np.arange(1, 201, dtype=np.uint64) * np.uint64(
+        0x9E3779B97F4A7C15)
+    for i, k in enumerate(keys):
+        hi.insert(int(k), i)
+    got = hi.lookup(keys)
+    np.testing.assert_array_equal(got, np.arange(200))
+    missing = hi.lookup(np.asarray([12345], np.uint64))
+    assert missing[0] == intern.MISSING
+
+
+def test_hash_index_zero_key():
+    hi = intern.HashIndex()
+    hi.insert(0, 7)
+    assert hi.lookup(np.zeros(1, np.uint64))[0] == 7
+
+
+def _mk_batch(parser, lines):
+    return parser.parse(b"\n".join(lines))
+
+
+def test_ingest_columns_equals_slow_ingest(parser):
+    """Same sample stream through both paths -> identical flush."""
+    lines = []
+    rng = np.random.default_rng(5)
+    for i in range(500):
+        lines.append(f"c{i % 7}:{rng.integers(1, 9)}|c".encode())
+        lines.append(
+            f"t{i % 5}:{rng.normal(50, 10):.3f}|ms|#env:x".encode())
+        lines.append(f"g{i % 3}:{i}|g".encode())
+        lines.append(f"s{i % 2}:u{i % 60}|s".encode())
+
+    fast = MetricTable(TableConfig())
+    proc, drop = fast.ingest_columns(_mk_batch(parser, lines))
+    assert proc == len(lines) and drop == 0
+
+    slow = MetricTable(TableConfig())
+    for ln in lines:
+        assert slow.ingest(dsd.parse_metric(ln))
+
+    fsnap, ssnap = fast.swap(), slow.swap()
+    # counters/gauges agree per name
+    fvals = {m.name: float(np.asarray(fsnap.counters)[r])
+             for r, m in enumerate(fsnap.counter_meta)}
+    svals = {m.name: float(np.asarray(ssnap.counters)[r])
+             for r, m in enumerate(ssnap.counter_meta)}
+    assert fvals == pytest.approx(svals)
+    fg = {m.name: float(np.asarray(fsnap.gauges)[r])
+          for r, m in enumerate(fsnap.gauge_meta)}
+    sg = {m.name: float(np.asarray(ssnap.gauges)[r])
+          for r, m in enumerate(ssnap.gauge_meta)}
+    assert fg == pytest.approx(sg)
+    # histo stats agree per name
+    fh = {m.name: np.asarray(fsnap.histo_stats)[r]
+          for r, m in enumerate(fsnap.histo_meta)}
+    sh = {m.name: np.asarray(ssnap.histo_stats)[r]
+          for r, m in enumerate(ssnap.histo_meta)}
+    assert set(fh) == set(sh)
+    for k in fh:
+        np.testing.assert_allclose(fh[k], sh[k], rtol=1e-5)
+    # HLL registers identical (same member hashes -> same registers)
+    fregs = {m.name: np.asarray(fsnap.hll_regs)[r]
+             for r, m in enumerate(fsnap.set_meta)}
+    sregs = {m.name: np.asarray(ssnap.hll_regs)[r]
+             for r, m in enumerate(ssnap.set_meta)}
+    assert set(fregs) == set(sregs)
+    for k in fregs:
+        np.testing.assert_array_equal(fregs[k], sregs[k])
+
+
+def test_ingest_columns_overflow_counts(parser):
+    table = MetricTable(TableConfig(counter_rows=4))
+    lines = [f"c{i}:1|c".encode() for i in range(10)]
+    proc, drop = table.ingest_columns(_mk_batch(parser, lines))
+    assert proc == 10
+    assert drop == 6
+    assert table.counter_idx.overflow == 6
+    # repeated batch: dropped keys are remembered, still counted
+    proc, drop = table.ingest_columns(_mk_batch(parser, lines))
+    assert drop == 6
+
+
+def test_ingest_columns_scope_allocation(parser):
+    table = MetricTable(TableConfig())
+    table.ingest_columns(_mk_batch(
+        parser, [b"gx:1|h|#veneurglobalonly", b"lx:2|ms"]))
+    snap = table.swap()
+    scopes = {m.name: m.scope for m in snap.histo_meta}
+    assert scopes == {"gx": dsd.SCOPE_GLOBAL, "lx": dsd.SCOPE_DEFAULT}
+
+
+def test_key_index_survives_compaction(parser):
+    table = MetricTable(TableConfig(counter_rows=8,
+                                    compact_threshold=0.5))
+    table.ingest_columns(_mk_batch(
+        parser, [f"c{i}:1|c".encode() for i in range(6)]))
+    table.swap()  # occupancy 6/8 > 0.5 -> compacts, all rows stale? no:
+    # all were touched in gen 0, keep_gen = 0 -> all survive renumbered
+    table.ingest_columns(_mk_batch(parser, [b"c3:5|c"]))
+    snap = table.swap()
+    vals = {m.name: float(np.asarray(snap.counters)[r])
+            for r, m in enumerate(snap.counter_meta)
+            if snap.counter_touched[r]}
+    assert vals == {"c3": 5.0}
+
+
+def test_mixed_batch_with_errors_and_events(parser):
+    table = MetricTable(TableConfig())
+    pb = _mk_batch(parser, [b"ok:1|c", b"garbage", b"_sc|x|0",
+                            b"ok:2|c"])
+    proc, drop = table.ingest_columns(pb)
+    assert proc == 2 and drop == 0
+    snap = table.swap()
+    assert float(np.asarray(snap.counters)[0]) == 3.0

@@ -43,7 +43,18 @@ def build_digest(samples, weights=None, chunk=256, num_rows=1, row=0):
 
 
 def _check_quantiles(samples, means, wts, row=0, tol=0.01):
-    est = np.asarray(tdigest.quantile(means, wts, jnp.asarray(QS)))[row]
+    # the production flush always anchors tails with the tracked true
+    # min/max (core/flusher.py), as the Go digest itself does — its
+    # MergingDigestData carries min/max and Quantile interpolates to
+    # them (tdigest/merging_digest.go:302,360)
+    nrows = means.shape[0]
+    mins = np.full(nrows, np.nan, np.float32)
+    maxs = np.full(nrows, np.nan, np.float32)
+    mins[row] = np.min(samples)
+    maxs[row] = np.max(samples)
+    est = np.asarray(tdigest.quantile(means, wts, jnp.asarray(QS),
+                                      jnp.asarray(mins),
+                                      jnp.asarray(maxs)))[row]
     exact = np.quantile(samples, QS.astype(np.float64))
     scale = np.quantile(samples, 0.999) - np.quantile(samples, 0.001)
     for q, e, x in zip(QS, est, exact):

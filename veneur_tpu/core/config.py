@@ -58,6 +58,8 @@ class Config:
     grpc_listen_addresses: list[str] = field(default_factory=list)
     http_address: str = ""
     num_readers: int = 1
+    # datagrams a reader sweeps into one columnar parse batch
+    reader_batch_packets: int = 512
     metric_max_length: int = 4096
     trace_max_length_bytes: int = 16 * 1024 * 1024
     read_buffer_size_bytes: int = 2 * 1048576

@@ -38,8 +38,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from veneur_tpu.ops import hll, segment, tdigest
-from veneur_tpu.protocol import dogstatsd as dsd
-from veneur_tpu.utils import hashing
+from veneur_tpu.protocol import columnar, dogstatsd as dsd
+from veneur_tpu.utils import hashing, intern
 
 # jitted, state-donating update steps
 _counter_step = jax.jit(segment.counter_update, donate_argnums=0)
@@ -85,7 +85,10 @@ class RowMeta:
     tags: tuple[str, ...]
     scope: str
     type: str
-    last_gen: int = 0
+    # 64-bit series-identity hash (utils.hashing.key_hash64) when the
+    # row is known to the fast-path key index; 0 for rows only ever
+    # touched by the slow path
+    key_hash: int = 0
 
 
 class _ClassIndex:
@@ -96,23 +99,35 @@ class _ClassIndex:
         self.rows: dict[tuple, int] = {}
         self.meta: list[RowMeta] = []
         self.touched = np.zeros(capacity, dtype=bool)
+        self.last_gen = np.zeros(capacity, dtype=np.int64)
         self.overflow = 0
 
     def lookup(self, sample_key: tuple, name: str,
                tags: tuple[str, ...], scope: str, mtype: str,
-               gen: int) -> int | None:
+               gen: int, key_hash: int = 0,
+               count_overflow: bool = True) -> int | None:
         row = self.rows.get(sample_key)
         if row is None:
             if len(self.meta) >= self.capacity:
-                self.overflow += 1
+                # fast-path callers tally dropped samples themselves
+                # (overflow counts SAMPLES, not keys)
+                if count_overflow:
+                    self.overflow += 1
                 return None
             row = len(self.meta)
             self.rows[sample_key] = row
-            self.meta.append(RowMeta(name, tags, scope, mtype, gen))
-        m = self.meta[row]
-        m.last_gen = gen
+            self.meta.append(RowMeta(name, tags, scope, mtype,
+                                     key_hash))
+        elif key_hash and not self.meta[row].key_hash:
+            self.meta[row].key_hash = key_hash
+        self.last_gen[row] = gen
         self.touched[row] = True
         return row
+
+    def touch_rows(self, rows: np.ndarray, gen: int) -> None:
+        """Vectorized touch for fast-path batches."""
+        self.touched[rows] = True
+        self.last_gen[rows] = gen
 
     def occupancy(self) -> int:
         return len(self.meta)
@@ -122,13 +137,16 @@ class _ClassIndex:
         Only legal at a swap boundary (device state is fresh zeros)."""
         new_rows: dict[tuple, int] = {}
         new_meta: list[RowMeta] = []
+        new_gen = np.zeros(self.capacity, dtype=np.int64)
         for key, row in self.rows.items():
-            m = self.meta[row]
-            if m.last_gen >= keep_gen:
-                new_rows[key] = len(new_meta)
-                new_meta.append(m)
+            if self.last_gen[row] >= keep_gen:
+                new_row = len(new_meta)
+                new_rows[key] = new_row
+                new_gen[new_row] = self.last_gen[row]
+                new_meta.append(self.meta[row])
         self.rows = new_rows
         self.meta = new_meta
+        self.last_gen = new_gen
         self.touched = np.zeros(self.capacity, dtype=bool)
 
     def reset_interval(self) -> None:
@@ -203,6 +221,14 @@ class MetricTable:
         self._histo_stage = _Staging()
         self._set_rows: list[int] = []
         self._set_members: list[bytes] = []
+        # fast-path set staging: positions already hashed (columnar
+        # ingest hashes members natively; slow path stores raw bytes)
+        self._set_pos_rows: list[np.ndarray] = []
+        self._set_pos_idx: list[np.ndarray] = []
+        self._set_pos_rank: list[np.ndarray] = []
+        # fast-path series index: identity hash -> row (see
+        # utils.intern); rebuilt after compaction renumbers rows
+        self.key_index = intern.HashIndex()
 
         # global-tier import staging (merge of forwarded state; the
         # receive half of reference worker.go:438 ImportMetricGRPC).
@@ -290,6 +316,103 @@ class MetricTable:
             if not self.ingest(s):
                 dropped += 1
         return dropped
+
+    # ------------------------------------------------------------------
+    # columnar fast path
+
+    def _class_for_code(self, code: int) -> _ClassIndex:
+        if code == columnar.CODE_COUNTER:
+            return self.counter_idx
+        if code == columnar.CODE_GAUGE:
+            return self.gauge_idx
+        if code in (columnar.CODE_TIMER, columnar.CODE_HISTOGRAM):
+            return self.histo_idx
+        return self.set_idx
+
+    def _resolve_misses(self, pb: columnar.ParsedBatch,
+                        miss_lines: np.ndarray,
+                        miss_keys: np.ndarray) -> None:
+        """Allocate rows for never-seen series: slow-parse ONE
+        representative line per unique identity hash, allocate through
+        the authoritative dict index, and remember the mapping (or a
+        DROPPED marker on class overflow) in the key index."""
+        _, first = np.unique(miss_keys, return_index=True)
+        for fp in first:
+            i = int(miss_lines[fp])
+            k = int(miss_keys[fp])
+            try:
+                s = dsd.parse_metric(pb.line(i))
+            except dsd.ParseError:
+                self.key_index.insert(k, intern.DROPPED)
+                continue
+            cls = self._class_for_code(int(pb.type_code[i]))
+            row = cls.lookup((s.name, s.type, s.tags, s.scope), s.name,
+                             s.tags, s.scope, s.type, self.gen,
+                             key_hash=k, count_overflow=False)
+            self.key_index.insert(
+                k, row if row is not None else intern.DROPPED)
+
+    def ingest_columns(self, pb: columnar.ParsedBatch
+                       ) -> tuple[int, int]:
+        """Batch ingest of a parsed buffer's metric lines (type codes
+        0-4; events/service-checks/errors are the caller's per-line
+        business).  Returns (processed, dropped).  The whole batch is a
+        handful of numpy passes + list appends — no per-sample Python.
+        """
+        tc = pb.type_code
+        sel = np.nonzero(tc <= columnar.CODE_SET)[0]
+        if len(sel) == 0:
+            return 0, 0
+        keys = pb.key_hash[sel]
+        rows = self.key_index.lookup(keys)
+        miss = rows == intern.MISSING
+        if miss.any():
+            self._resolve_misses(pb, sel[miss], keys[miss])
+            rows = self.key_index.lookup(keys)
+        live = rows >= 0
+        dropped = int((~live).sum())
+        if dropped:
+            # count overflow per class (reference drops-and-counts)
+            for code in np.unique(tc[sel][~live]):
+                self._class_for_code(int(code)).overflow += int(
+                    ((tc[sel] == code) & ~live).sum())
+
+        codes = tc[sel]
+        vals = pb.value[sel]
+        wts = pb.weight[sel]
+
+        cmask = (codes == columnar.CODE_COUNTER) & live
+        if cmask.any():
+            r = rows[cmask]
+            # counter kernel multiplies value*weight on device
+            self._counter_stage.append(r, vals[cmask], wts[cmask])
+            self.counter_idx.touch_rows(r, self.gen)
+
+        gmask = (codes == columnar.CODE_GAUGE) & live
+        if gmask.any():
+            r = rows[gmask]
+            self._gauge_stage.append(r, vals[gmask])
+            self.gauge_idx.touch_rows(r, self.gen)
+
+        hmask = ((codes == columnar.CODE_TIMER) |
+                 (codes == columnar.CODE_HISTOGRAM)) & live
+        if hmask.any():
+            r = rows[hmask]
+            self._histo_stage.append(r, vals[hmask], wts[hmask])
+            self.histo_idx.touch_rows(r, self.gen)
+
+        smask = (codes == columnar.CODE_SET) & live
+        if smask.any():
+            r = rows[smask]
+            idx, rank = hashing.hll_position(pb.member_hash[sel][smask])
+            self._set_pos_rows.append(np.asarray(r, np.int32))
+            self._set_pos_idx.append(idx)
+            self._set_pos_rank.append(rank)
+            self.set_idx.touch_rows(r, self.gen)
+
+        processed = len(sel)
+        self._staged_n += processed - dropped
+        return processed, dropped
 
     def staged(self) -> int:
         return self._staged_n
@@ -413,16 +536,31 @@ class MetricTable:
         if batch is not None:
             self._histo_device_step(*batch, with_stats=False)
 
-        if self._set_rows:
-            rows = np.asarray(self._set_rows, np.int32)
-            idx, rank = hashing.hash_members(self._set_members)
-            self._set_rows, self._set_members = [], []
+        if self._set_rows or self._set_pos_rows:
+            parts_rows, parts_idx, parts_rank = ([], [], [])
+            if self._set_rows:
+                idx, rank = hashing.hash_members(self._set_members)
+                parts_rows.append(np.asarray(self._set_rows, np.int32))
+                parts_idx.append(idx.astype(np.int32))
+                parts_rank.append(rank.astype(np.int32))
+                self._set_rows, self._set_members = [], []
+            if self._set_pos_rows:
+                parts_rows.extend(self._set_pos_rows)
+                parts_idx.extend(np.asarray(a, np.int32)
+                                 for a in self._set_pos_idx)
+                parts_rank.extend(np.asarray(a, np.int32)
+                                  for a in self._set_pos_rank)
+                self._set_pos_rows, self._set_pos_idx, \
+                    self._set_pos_rank = [], [], []
+            rows = np.concatenate(parts_rows)
+            idx = np.concatenate(parts_idx)
+            rank = np.concatenate(parts_rank)
             b = _bucket_len(len(rows))
             self.hll_regs = _hll_step(
                 self.hll_regs,
                 jnp.asarray(_pad_np(rows, b, c.set_rows)),
-                jnp.asarray(_pad_np(idx.astype(np.int32), b, 0)),
-                jnp.asarray(_pad_np(rank.astype(np.int32), b, 0)))
+                jnp.asarray(_pad_np(idx, b, 0)),
+                jnp.asarray(_pad_np(rank, b, 0)))
 
         if self._stats_import_rows:
             rows = np.asarray(self._stats_import_rows, np.int32)
@@ -524,13 +662,25 @@ class MetricTable:
         )
         self._init_state()
         self.gen += 1
+        compacted = False
         for idx in (self.counter_idx, self.gauge_idx, self.histo_idx,
                     self.set_idx):
             idx.overflow = 0
             if idx.occupancy() > idx.capacity * self.config.compact_threshold:
                 idx.compact(keep_gen=self.gen - 1)
+                compacted = True
             else:
                 idx.reset_interval()
+        if compacted:
+            # compaction renumbered rows: rebuild the fast-path index
+            # from surviving metas (rows the fast path never saw have
+            # key_hash 0 and simply re-resolve on next sight)
+            self.key_index.clear()
+            for idx in (self.counter_idx, self.gauge_idx,
+                        self.histo_idx, self.set_idx):
+                for row, m in enumerate(idx.meta):
+                    if m.key_hash:
+                        self.key_index.insert(m.key_hash, row)
         return snap
 
     def take_status(self):

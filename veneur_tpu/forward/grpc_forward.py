@@ -1,0 +1,231 @@
+"""gRPC forward tier: wire-compatible ``forwardrpc.Forward`` client and
+import server.
+
+The reference's primary DCN comm backend: a local veneur forwards
+mergeable sampler state as protobuf ``MetricList`` batches
+(flusher.go:499 ``forwardGRPC``) to a global veneur's importsrv
+(importsrv/server.go:102 ``SendMetrics``), which merges them into
+worker state (worker.go:438 ``ImportMetricGRPC``).
+
+Here the same service — identical package/method path
+``/forwardrpc.Forward/SendMetrics`` and field numbers, so Go locals and
+proxies interoperate — feeds the device metric table: counters +=,
+gauge last-write, histogram centroids through the batched digest merge,
+HLL register unions.  Stubs are hand-wired generic gRPC handlers over
+protoc-generated messages (veneur_tpu/forward/gen), no grpc_tools
+needed.
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent import futures
+
+import numpy as np
+
+from veneur_tpu.core.flusher import ForwardRow
+from veneur_tpu.core.table import MetricTable
+from veneur_tpu.forward import hll_codec
+from veneur_tpu.forward.gen import forward_pb2, metric_pb2, tdigest_pb2
+from veneur_tpu.ops import segment
+from veneur_tpu.protocol import dogstatsd as dsd
+
+try:
+    import grpc
+except ImportError:  # pragma: no cover
+    grpc = None
+
+from google.protobuf import empty_pb2
+
+log = logging.getLogger("veneur_tpu.grpc")
+
+_METHOD = "/forwardrpc.Forward/SendMetrics"
+
+_TYPE_TO_PB = {dsd.COUNTER: metric_pb2.Counter,
+               dsd.GAUGE: metric_pb2.Gauge,
+               dsd.HISTOGRAM: metric_pb2.Histogram,
+               dsd.TIMER: metric_pb2.Timer,
+               dsd.SET: metric_pb2.Set}
+_PB_TO_TYPE = {v: k for k, v in _TYPE_TO_PB.items()}
+_SCOPE_TO_PB = {dsd.SCOPE_DEFAULT: metric_pb2.Mixed,
+                dsd.SCOPE_LOCAL: metric_pb2.Local,
+                dsd.SCOPE_GLOBAL: metric_pb2.Global}
+_PB_TO_SCOPE = {v: k for k, v in _SCOPE_TO_PB.items()}
+
+
+# ----------------------------------------------------------------------
+# ForwardRow <-> metricpb.Metric
+
+def row_to_metric(r: ForwardRow) -> metric_pb2.Metric:
+    """Encode one flush-produced forwardable row (the sending half of
+    worker.go:181 ForwardableMetrics -> metricpb)."""
+    m = metric_pb2.Metric(name=r.meta.name, tags=list(r.meta.tags),
+                          type=_TYPE_TO_PB[r.meta.type],
+                          scope=_SCOPE_TO_PB[r.meta.scope])
+    if r.kind == "counter":
+        # the reference wire type is int64 (metric.proto CounterValue)
+        m.counter.value = int(round(r.value))
+    elif r.kind == "gauge":
+        m.gauge.value = float(r.value)
+    elif r.kind == "histo":
+        d = m.histogram.t_digest
+        d.compression = 100.0
+        st = r.stats
+        d.min = float(st[segment.STAT_MIN])
+        d.max = float(st[segment.STAT_MAX])
+        d.reciprocalSum = float(st[segment.STAT_RSUM])
+        live = np.asarray(r.weights) > 0
+        means = np.asarray(r.means)[live]
+        weights = np.asarray(r.weights)[live]
+        for mean, w in zip(means, weights):
+            c = d.main_centroids.add()
+            c.mean = float(mean)
+            c.weight = float(w)
+    elif r.kind == "set":
+        m.set.hyper_log_log = hll_codec.encode_dense(r.regs)
+    else:
+        raise ValueError(f"unknown forward kind {r.kind}")
+    return m
+
+
+def rows_to_metric_list(rows: list[ForwardRow]) -> forward_pb2.MetricList:
+    return forward_pb2.MetricList(
+        metrics=[row_to_metric(r) for r in rows])
+
+
+def apply_metric(table: MetricTable, m: metric_pb2.Metric) -> bool:
+    """Merge one received metricpb.Metric into the table (the receive
+    half: worker.go:438 ImportMetricGRPC semantics)."""
+    mtype = _PB_TO_TYPE.get(m.type)
+    tags = tuple(m.tags)
+    scope = _PB_TO_SCOPE.get(m.scope, dsd.SCOPE_DEFAULT)
+    which = m.WhichOneof("value")
+    if which == "counter":
+        return table.import_counter(m.name, tags, float(m.counter.value))
+    if which == "gauge":
+        return table.import_gauge(m.name, tags, float(m.gauge.value))
+    if which == "histogram":
+        d = m.histogram.t_digest
+        means = np.asarray([c.mean for c in d.main_centroids],
+                           np.float32)
+        weights = np.asarray([c.weight for c in d.main_centroids],
+                             np.float32)
+        total_w = float(weights.sum())
+        # the Go digest's Sum() is sum(mean*weight)
+        # (merging_digest.go:349); min/max/reciprocalSum ride in the
+        # proto itself
+        total_sum = float((means * weights).sum())
+        stats = np.asarray(
+            [total_w,
+             d.min if total_w else segment.STAT_MIN_EMPTY,
+             d.max if total_w else segment.STAT_MAX_EMPTY,
+             total_sum, d.reciprocalSum], np.float32)
+        if mtype not in (dsd.HISTOGRAM, dsd.TIMER):
+            mtype = dsd.HISTOGRAM
+        return table.import_histo(m.name, mtype, tags, stats, means,
+                                  weights, scope=scope)
+    if which == "set":
+        regs = hll_codec.decode(bytes(m.set.hyper_log_log))
+        return table.import_set(m.name, tags, regs, scope=scope)
+    log.warning("import metric %s with empty value oneof", m.name)
+    return False
+
+
+def apply_metric_list(table: MetricTable,
+                      ml: forward_pb2.MetricList) -> tuple[int, int]:
+    """Returns (accepted, dropped).  Per-item isolation as on the HTTP
+    import path."""
+    accepted = dropped = 0
+    for m in ml.metrics:
+        try:
+            ok = apply_metric(table, m)
+        except (ValueError, KeyError, hll_codec.HLLCodecError) as e:
+            log.warning("dropping bad gRPC import item %s: %s",
+                        m.name, e)
+            dropped += 1
+            continue
+        accepted += int(ok)
+        dropped += int(not ok)
+    return accepted, dropped
+
+
+# ----------------------------------------------------------------------
+# server (importsrv equivalent)
+
+class ImportServer:
+    """gRPC listener merging forwarded MetricLists into a table.
+
+    The role of importsrv.Server (importsrv/server.go:44) — with the
+    worker fan-out replaced by the device table behind the server's
+    ingest lock.
+    """
+
+    def __init__(self, server, address: str = "127.0.0.1:0",
+                 credentials=None):
+        """``server`` is the core Server (provides .table/.lock/.bump);
+        ``address`` host:port, port 0 for ephemeral."""
+        if grpc is None:  # pragma: no cover
+            raise RuntimeError("grpcio unavailable")
+        self._core = server
+        self._grpc = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=8),
+            options=[("grpc.max_receive_message_length",
+                      64 * 1024 * 1024)])
+        handler = grpc.method_handlers_generic_handler(
+            "forwardrpc.Forward",
+            {"SendMetrics": grpc.unary_unary_rpc_method_handler(
+                self._send_metrics,
+                request_deserializer=forward_pb2.MetricList.FromString,
+                response_serializer=empty_pb2.Empty.SerializeToString)})
+        self._grpc.add_generic_rpc_handlers((handler,))
+        if credentials is not None:
+            self.port = self._grpc.add_secure_port(address, credentials)
+        else:
+            self.port = self._grpc.add_insecure_port(address)
+
+    def _send_metrics(self, request, context):
+        core = self._core
+        with core.lock:
+            acc, dropped = apply_metric_list(core.table, request)
+            core._maybe_device_step_locked()
+        core.bump("imports_received", acc)
+        if dropped:
+            core.bump("metrics_dropped", dropped)
+        return empty_pb2.Empty()
+
+    def start(self) -> None:
+        self._grpc.start()
+
+    def stop(self, grace: float = 0.5) -> None:
+        self._grpc.stop(grace)
+
+
+# ----------------------------------------------------------------------
+# client (forwardGRPC equivalent)
+
+class ForwardClient:
+    """Dial-once client for the Forward service (flusher.go:499
+    forwardGRPC: errors are dropped-and-counted, never retried within
+    a flush)."""
+
+    def __init__(self, target: str, timeout: float = 10.0,
+                 credentials=None):
+        if grpc is None:  # pragma: no cover
+            raise RuntimeError("grpcio unavailable")
+        target = target.removeprefix("http://")
+        if credentials is not None:
+            self._channel = grpc.secure_channel(target, credentials)
+        else:
+            self._channel = grpc.insecure_channel(target)
+        self._timeout = timeout
+        self._call = self._channel.unary_unary(
+            _METHOD,
+            request_serializer=forward_pb2.MetricList.SerializeToString,
+            response_deserializer=empty_pb2.Empty.FromString)
+
+    def send(self, rows: list[ForwardRow]) -> None:
+        """Raises grpc.RpcError on failure (caller drops-and-counts)."""
+        self._call(rows_to_metric_list(rows), timeout=self._timeout)
+
+    def close(self) -> None:
+        self._channel.close()

@@ -26,7 +26,7 @@ and yields the same size bound (<= delta/2 + 1 clusters for k1).  To
 absorb the slightly looser clustering and repeated re-merging, the
 internal scale uses a multiple of the configured compression; with the default
 compression=100 (reference samplers/samplers.go:502) the plane capacity
-``C=208`` holds the <= ~200 clusters of the internal scale and keeps the
+``C=312`` holds the <= ~300 clusters of the internal scale and keeps the
 slot axis lane-aligned.
 
 Digest-vs-digest merge (the global tier's Histo.Merge,
@@ -47,17 +47,18 @@ Array = jax.Array
 
 DEFAULT_COMPRESSION = 100.0
 # Plane capacity for the default compression (see module docstring).
-DEFAULT_CAPACITY = 208
+DEFAULT_CAPACITY = 312
 
 _EPS = 1e-30
 
 
 # Internal k-scale multiplier: the digest clusters on a scale of
-# _SCALE_MULT * compression, i.e. ~2x the centroid count of a greedy
+# _SCALE_MULT * compression, i.e. ~3x the centroid count of a greedy
 # merging digest at the configured compression.  Extra slots are cheap
-# in HBM and the batched sort is tiny; the payoff is ~2x finer tail
-# resolution, which is what the p99/p999 accuracy budget rides on.
-_SCALE_MULT = 4.0
+# in HBM and the batched sort is tiny; the payoff is ~3x finer tail
+# resolution, which is what the p99/p999 accuracy budget rides on
+# (p999 on heavy-tailed distributions needs the finer clusters).
+_SCALE_MULT = 6.0
 
 
 def capacity_for(compression: float) -> int:
@@ -190,15 +191,19 @@ def quantile(means: Array, weights: Array, qs: Array,
              maxs: Array | None = None) -> Array:
     """Estimate quantiles for every row -> f32[R, Q].
 
-    Standard t-digest interpolation over centroid weight midpoints
-    (the same scheme as reference tdigest/merging_digest.go:302): each
-    centroid i sits at cumulative position z_i = cum_{i-1} + w_i/2;
-    target position q*total interpolates linearly between adjacent
-    midpoints.  When per-row true ``mins``/``maxs`` (f32[R]) are given —
-    the Histo sampler tracks them anyway (samplers/samplers.go:484) —
-    the tail regions interpolate toward those anchors exactly as the
-    reference does, which is what keeps p999 tight.  Rows with no data
-    return NaN.
+    Implements the reference's interpolation scheme EXACTLY
+    (tdigest/merging_digest.go:302 ``Quantile`` + :360
+    ``centroidUpperBound``): each centroid is a uniform distribution
+    over value-space bounds given by the midpoints to its neighbors'
+    means, with the first lower bound = true min and the last upper
+    bound = true max.  The target weight q*total lands inside one
+    centroid; the estimate interpolates proportionally inside its
+    bounds.  Matching the scheme (not just the sketch) is what keeps
+    the "vs the Go t-digest" error at zero for identical centroids.
+
+    ``mins``/``maxs`` (f32[R]) are the per-row true extremes the Histo
+    sampler tracks anyway (samplers/samplers.go:484); without them the
+    extreme centroid means serve as the bounds.  Empty rows -> NaN.
     """
     if mins is None:
         mins = jnp.full((means.shape[0],), jnp.nan, jnp.float32)
@@ -207,90 +212,85 @@ def quantile(means: Array, weights: Array, qs: Array,
     return _quantile(means, weights, qs, mins, maxs)
 
 
-@jax.jit
-def _quantile(means: Array, weights: Array, qs: Array, mins: Array,
-              maxs: Array) -> Array:
-    key = jnp.where(weights > 0, means, jnp.inf)
-    _, m, w = jax.lax.sort((key, means, weights), dimension=-1,
-                           num_keys=1)
+def _bounds(m: Array, w: Array, mins: Array, maxs: Array):
+    """Sorted centroids + per-centroid value-space (lb, ub) per the
+    reference's centroidUpperBound; returns (m, w, cum, lb, ub,
+    nvalid, total)."""
+    key = jnp.where(w > 0, m, jnp.inf)
+    _, m, w = jax.lax.sort((key, m, w), dimension=-1, num_keys=1)
     cum = jnp.cumsum(w, axis=1)
     total = cum[:, -1:]
-    z = cum - 0.5 * w
-    z_masked = jnp.where(w > 0, z, jnp.inf)
-
     nvalid = jnp.sum(w > 0, axis=1)
     last = jnp.maximum(nvalid - 1, 0)[:, None]
 
-    t = qs[None, :] * total  # [R, Q]
-    # idx in [0, nvalid]: count of midpoints strictly below target
-    idx = jnp.sum(z_masked[:, None, :] < t[:, :, None], axis=-1)
-
-    lo = jnp.clip(idx - 1, 0, last)
-    hi = jnp.clip(idx, 0, last)
-    m_lo = jnp.take_along_axis(m, lo, axis=1)
-    m_hi = jnp.take_along_axis(m, hi, axis=1)
-    z_lo = jnp.take_along_axis(z, lo, axis=1)
-    z_hi = jnp.take_along_axis(z, hi, axis=1)
-
-    span = z_hi - z_lo
-    frac = jnp.where(span > 0, (t - z_lo) / jnp.maximum(span, _EPS), 0.0)
-    frac = jnp.clip(frac, 0.0, 1.0)
-    est = m_lo + frac * (m_hi - m_lo)
-
-    # Tail anchoring.  Below the first midpoint: interpolate min -> m_0
-    # over [0, z_0]; above the last midpoint: m_last -> max over
-    # [z_last, total].  Without anchors, clamp to the extreme means.
-    first_m = m[:, :1]
-    z_first = z[:, :1]
     last_m = jnp.take_along_axis(m, last, axis=1)
-    z_last = jnp.take_along_axis(z, last, axis=1)
+    first_m = m[:, :1]
+    lo_anchor = jnp.where(jnp.isnan(mins)[:, None], first_m,
+                          mins[:, None])
+    hi_anchor = jnp.where(jnp.isnan(maxs)[:, None], last_m,
+                          maxs[:, None])
 
-    lo_frac = jnp.clip(t / jnp.maximum(z_first, _EPS), 0.0, 1.0)
-    lo_est = jnp.where(jnp.isnan(mins)[:, None], first_m,
-                       mins[:, None] + lo_frac *
-                       (first_m - mins[:, None]))
-    est = jnp.where(idx == 0, lo_est, est)
+    slot = jnp.arange(m.shape[1])[None, :]
+    m_next = jnp.concatenate([m[:, 1:], m[:, -1:]], axis=1)
+    ub = jnp.where(slot >= last, hi_anchor, 0.5 * (m + m_next))
+    lb = jnp.concatenate([lo_anchor, ub[:, :-1]], axis=1)
+    return m, w, cum, lb, ub, nvalid, total
 
-    hi_span = total - z_last
-    hi_frac = jnp.clip((t - z_last) / jnp.maximum(hi_span, _EPS),
-                       0.0, 1.0)
-    hi_est = jnp.where(jnp.isnan(maxs)[:, None], last_m,
-                       last_m + hi_frac * (maxs[:, None] - last_m))
-    est = jnp.where(idx >= nvalid[:, None], hi_est, est)
+
+@jax.jit
+def _quantile(means: Array, weights: Array, qs: Array, mins: Array,
+              maxs: Array) -> Array:
+    m, w, cum, lb, ub, nvalid, total = _bounds(means, weights, mins,
+                                               maxs)
+    last = jnp.maximum(nvalid - 1, 0)[:, None]
+    t = qs[None, :] * total  # [R, Q]
+    # first centroid i with q <= cum_i  (strict-< count, as the
+    # reference's walk); empty slots mask to +inf so they never count
+    # below the target
+    cum_masked = jnp.where(w > 0, cum, jnp.inf)
+    idx = jnp.sum(cum_masked[:, None, :] < t[:, :, None], axis=-1)
+    idx = jnp.clip(idx, 0, last)
+    w_i = jnp.take_along_axis(w, idx, axis=1)
+    cum_before = jnp.take_along_axis(cum - w, idx, axis=1)
+    lb_i = jnp.take_along_axis(lb, idx, axis=1)
+    ub_i = jnp.take_along_axis(ub, idx, axis=1)
+    prop = jnp.clip((t - cum_before) / jnp.maximum(w_i, _EPS), 0.0, 1.0)
+    est = lb_i + prop * (ub_i - lb_i)
     return jnp.where((nvalid[:, None] > 0) & (total > 0), est, jnp.nan)
 
 
 @jax.jit
-def cdf(means: Array, weights: Array, xs: Array) -> Array:
-    """Fraction of weight below each value -> f32[R, X] (the inverse of
-    quantile; reference tdigest/merging_digest.go:266)."""
-    key = jnp.where(weights > 0, means, jnp.inf)
-    _, m, w = jax.lax.sort((key, means, weights), dimension=-1,
-                           num_keys=1)
-    cum = jnp.cumsum(w, axis=1)
-    total = cum[:, -1:]
-    z = cum - 0.5 * w
-    m_masked = jnp.where(w > 0, m, jnp.inf)
-    nvalid = jnp.sum(w > 0, axis=1)
-
+def cdf(means: Array, weights: Array, xs: Array,
+        mins: Array | None = None, maxs: Array | None = None) -> Array:
+    """Fraction of weight below each value -> f32[R, X], using the same
+    value-space uniform-centroid model as quantile (the inverse map;
+    reference tdigest/merging_digest.go:266 ``CDF``)."""
+    if mins is None:
+        mins = jnp.full((means.shape[0],), jnp.nan, jnp.float32)
+    if maxs is None:
+        maxs = jnp.full((means.shape[0],), jnp.nan, jnp.float32)
+    m, w, cum, lb, ub, nvalid, total = _bounds(means, weights, mins,
+                                               maxs)
+    last = jnp.maximum(nvalid - 1, 0)[:, None]
     x = xs[None, :]
-    idx = jnp.sum(m_masked[:, None, :] < x[:, :, None], axis=-1)
-    lo = jnp.clip(idx - 1, 0, jnp.maximum(nvalid - 1, 0)[:, None])
-    hi = jnp.clip(idx, 0, jnp.maximum(nvalid - 1, 0)[:, None])
-    m_lo = jnp.take_along_axis(m, lo, axis=1)
-    m_hi = jnp.take_along_axis(m, hi, axis=1)
-    z_lo = jnp.take_along_axis(z, lo, axis=1)
-    z_hi = jnp.take_along_axis(z, hi, axis=1)
-
-    span = m_hi - m_lo
-    frac = jnp.where(span > 0, (x - m_lo) / jnp.maximum(span, _EPS), 0.0)
-    frac = jnp.clip(frac, 0.0, 1.0)
-    pos = z_lo + frac * (z_hi - z_lo)
-    out = pos / jnp.maximum(total, _EPS)
-    out = jnp.where(idx == 0, 0.0, out)
-    last = nvalid[:, None]
-    out = jnp.where(idx >= last, 1.0, out)
-    # exact-boundary convention: below first mean -> 0, above last -> 1
+    # first centroid whose upper bound exceeds x
+    ub_masked = jnp.where(w > 0, ub, jnp.inf)
+    idx = jnp.sum(ub_masked[:, None, :] <= x[:, :, None], axis=-1)
+    idx = jnp.clip(idx, 0, last)
+    w_i = jnp.take_along_axis(w, idx, axis=1)
+    cum_before = jnp.take_along_axis(cum - w, idx, axis=1)
+    lb_i = jnp.take_along_axis(lb, idx, axis=1)
+    ub_i = jnp.take_along_axis(ub, idx, axis=1)
+    span = ub_i - lb_i
+    frac = jnp.clip(jnp.where(span > 0,
+                              (x - lb_i) / jnp.maximum(span, _EPS),
+                              1.0), 0.0, 1.0)
+    out = (cum_before + w_i * frac) / jnp.maximum(total, _EPS)
+    # outside the anchors: exact 0/1, as the reference returns
+    lo_anchor = lb[:, :1]
+    hi_anchor = jnp.take_along_axis(ub, last, axis=1)
+    out = jnp.where(x <= lo_anchor, 0.0, out)
+    out = jnp.where(x >= hi_anchor, 1.0, out)
     return jnp.where(nvalid[:, None] > 0, jnp.clip(out, 0.0, 1.0),
                      jnp.nan)
 

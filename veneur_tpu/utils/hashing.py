@@ -78,6 +78,32 @@ def fnv1a_64_scalar(data: bytes) -> bytes:
     return h.to_bytes(8, "little")
 
 
+def _fmix64(h: int) -> int:
+    """murmur3 fmix64 finalizer (scalar; mirrors the vectorized one in
+    hash64 and the native parser's fmix64 bit-for-bit)."""
+    h ^= h >> 33
+    h = (h * 0xFF51AFD7ED558CCD) & 0xFFFFFFFFFFFFFFFF
+    h ^= h >> 33
+    h = (h * 0xC4CEB9FE1A85EC53) & 0xFFFFFFFFFFFFFFFF
+    h ^= h >> 33
+    return h
+
+
+def key_hash64(name: str, type_code: int, tags: Sequence[str],
+               scope_code: int) -> int:
+    """64-bit series-identity hash over (name, type, sorted tags,
+    scope) — MUST stay bit-identical to the native parser's key hash
+    (veneur_tpu/native/dsd_parse.cpp) so slow-path row allocations and
+    fast-path lookups agree.  Tags are assumed already sorted."""
+    h = int(FNV1A_64_OFFSET)
+    prime = int(FNV1A_64_PRIME)
+    payload = (name.encode() + b"\x00" + bytes([type_code]) + b"\x00" +
+               ",".join(tags).encode() + b"\x00" + bytes([scope_code]))
+    for b in payload:
+        h = ((h ^ b) * prime) & 0xFFFFFFFFFFFFFFFF
+    return _fmix64(h)
+
+
 def hash64(members: Sequence[bytes]) -> np.ndarray:
     """Vectorized 64-bit hash of a batch of byte strings -> u64[N]."""
     if len(members) == 0:
