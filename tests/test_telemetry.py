@@ -1,0 +1,94 @@
+"""Self-telemetry: the documented veneur.* operator metrics flow
+through the framework's own pipeline (reference README.md:253-299
+catalogue; server.go:347 loopback channel client)."""
+
+import socket
+import time
+
+import pytest
+
+from veneur_tpu.core.config import read_config
+from veneur_tpu.core.server import Server
+from veneur_tpu.sinks.simple import CaptureSink
+
+
+def test_operator_metrics_emitted_via_loopback():
+    cap = CaptureSink()
+    server = Server(read_config(data={
+        "statsd_listen_addresses": [], "interval": "10s",
+        "hostname": "t"}), extra_sinks=[cap])
+    server.start()
+    try:
+        server.handle_packet(b"app.hits:3|c\napp.lat:5|ms")
+        server.handle_packet(b"not parseable !!")
+        server.flush_once()  # interval 1: emits app.*, injects veneur.*
+        server.flush_once()  # interval 2: flushes the veneur.* samples
+        names = {m.name for m in cap.metrics}
+        assert "veneur.worker.metrics_processed_total" in names
+        assert "veneur.packet.error_total" in names
+        assert "veneur.worker.metrics_flushed_total" in names
+        assert any(n.startswith("veneur.flush.total_duration_ns")
+                   for n in names)
+        assert any(n.startswith(
+            "veneur.sink.metric_flush_total_duration_ns")
+            for n in names)
+        assert "veneur.gc.number" in names
+        assert "veneur.mem.heap_alloc_bytes" in names
+        m = {x.name: x for x in cap.metrics}
+        assert m["veneur.worker.metrics_processed_total"].value == 2.0
+        assert m["veneur.packet.error_total"].value >= 1.0
+        # flushed-count tagged by metric type
+        flushed = [x for x in cap.metrics
+                   if x.name == "veneur.worker.metrics_flushed_total"]
+        tag_types = {t for x in flushed for t in x.tags
+                     if t.startswith("metric_type:")}
+        assert "metric_type:counters" in tag_types
+        assert "metric_type:histograms" in tag_types
+    finally:
+        server.shutdown()
+
+
+def test_stats_address_emits_dogstatsd():
+    """With stats_address set, telemetry leaves the process as
+    DogStatsD datagrams (the scopedstatsd role)."""
+    recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    recv.bind(("127.0.0.1", 0))
+    recv.settimeout(5.0)
+    port = recv.getsockname()[1]
+    server = Server(read_config(data={
+        "statsd_listen_addresses": [], "interval": "10s",
+        "stats_address": f"127.0.0.1:{port}"}),
+        extra_sinks=[CaptureSink()])
+    server.start()
+    try:
+        server.handle_packet(b"x:1|c")
+        server.flush_once()
+        data = recv.recv(65536)
+        assert b"veneur.worker.metrics_processed_total:1" in data
+        assert b"|c" in data and b"|ms" in data
+    finally:
+        server.shutdown()
+        recv.close()
+
+
+def test_per_protocol_receive_counters():
+    cap = CaptureSink()
+    server = Server(read_config(data={
+        "statsd_listen_addresses": ["udp://127.0.0.1:0"],
+        "interval": "10s"}), extra_sinks=[cap])
+    server.start()
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.sendto(b"p:1|c", ("127.0.0.1", server.statsd_ports[0]))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                server.stats.get("received_dogstatsd-udp", 0) < 1:
+            time.sleep(0.01)
+        server.flush_once()
+        server.flush_once()
+        per_proto = [x for x in cap.metrics if x.name ==
+                     "veneur.listen.received_per_protocol_total"]
+        assert any("protocol:dogstatsd-udp" in x.tags
+                   for x in per_proto)
+    finally:
+        server.shutdown()

@@ -75,6 +75,12 @@ class Config:
     forward_address: str = ""
     forward_use_grpc: bool = False
 
+    # span plane (reference: indicator_span_timer_name,
+    # objective_span_timer_name config keys; ssf_buffer via SpanChan)
+    indicator_span_timer_name: str = ""
+    objective_span_timer_name: str = ""
+    span_channel_capacity: int = 1024
+
     # sinks
     debug_flushed_metrics: bool = False
     blackhole_sink: bool = False
@@ -136,7 +142,8 @@ class Config:
         if self.metric_max_length <= 0:
             problems.append("metric_max_length must be positive")
         for n in ("tpu_counter_rows", "tpu_gauge_rows", "tpu_histo_rows",
-                  "tpu_set_rows"):
+                  "tpu_set_rows", "span_channel_capacity",
+                  "reader_batch_packets", "tpu_stage_flush_samples"):
             if getattr(self, n) <= 0:
                 problems.append(f"{n} must be positive")
         return problems

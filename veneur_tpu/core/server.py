@@ -48,7 +48,8 @@ log = logging.getLogger("veneur_tpu.server")
 
 class Server:
     def __init__(self, config: Config, extra_sinks: list | None = None,
-                 extra_plugins: list | None = None):
+                 extra_plugins: list | None = None,
+                 extra_span_sinks: list | None = None):
         self.config = config
         self.interval = config.interval_seconds()
         self.is_local = config.is_local()
@@ -69,7 +70,23 @@ class Server:
 
         self.metric_sinks: list = list(extra_sinks or [])
         self.plugins: list = list(extra_plugins or [])
+        self.span_sinks: list = list(extra_span_sinks or [])
         self._build_sinks()
+
+        # the span plane: ssfmetrics extraction always runs first — it
+        # is part of the metric hot path (reference server.go:444-452)
+        from veneur_tpu.core.spans import SpanWorker
+        from veneur_tpu.sinks.ssfmetrics import MetricExtractionSink
+        self.span_sinks.insert(0, MetricExtractionSink(
+            self,
+            indicator_timer_name=config.indicator_span_timer_name,
+            objective_timer_name=config.objective_span_timer_name))
+        self.span_worker = SpanWorker(
+            self.span_sinks,
+            common_tags=dict(t.split(":", 1) for t in config.tags
+                             if ":" in t),
+            capacity=config.span_channel_capacity,
+            stats_cb=self.bump)
 
         self.events: list[dsd.Event] = []
         self.checks: list[dsd.ServiceCheck] = []
@@ -83,6 +100,10 @@ class Server:
             "imports_received": 0, "flushes": 0,
         }
 
+        from veneur_tpu.core.telemetry import Telemetry
+        self.telemetry = Telemetry(self)
+        self._sink_durations: dict[str, float] = {}
+
         self._shutdown = threading.Event()
         self._threads: list[threading.Thread] = []
         self._sockets: list[socket.socket] = []
@@ -91,6 +112,11 @@ class Server:
         self.last_flush = time.monotonic()
         self.http_port: int | None = None
         self.statsd_ports: list[int] = []
+        self.ssf_ports: list[int] = []
+        # gRPC importsrv listeners (global tier) + forward client
+        self.grpc_servers: list = []
+        self.grpc_ports: list[int] = []
+        self._grpc_client = None
 
     # ------------------------------------------------------------------
     # construction
@@ -193,6 +219,13 @@ class Server:
             self._start_statsd(addr)
         if self.config.http_address:
             self._start_http(self.config.http_address)
+        for addr in self.config.grpc_listen_addresses:
+            self._start_grpc(addr)
+        for addr in self.config.ssf_listen_addresses:
+            self._start_ssf(addr)
+        self.span_worker.start()
+        for s in self.span_sinks:
+            s.start()
         t = threading.Thread(target=self._flush_loop, daemon=True,
                              name="flush")
         t.start()
@@ -220,7 +253,8 @@ class Server:
                 port = sock.getsockname()[1]  # resolve port 0 once
                 self._sockets.append(sock)
                 t = threading.Thread(target=self._udp_reader,
-                                     args=(sock,), daemon=True,
+                                     args=(sock, "dogstatsd-udp"),
+                                     daemon=True,
                                      name=f"udp-reader-{i}")
                 t.start()
                 self._threads.append(t)
@@ -244,14 +278,118 @@ class Server:
             sock.bind(path)
             self._sockets.append(sock)
             t = threading.Thread(target=self._udp_reader,
-                                 args=(sock,), daemon=True,
+                                 args=(sock, "dogstatsd-unixgram"),
+                                 daemon=True,
                                  name="unixgram-reader")
             t.start()
             self._threads.append(t)
         else:
             raise ValueError(f"unsupported statsd address {addr!r}")
 
-    def _udp_reader(self, sock: socket.socket) -> None:
+    def _start_grpc(self, addr: str) -> None:
+        """gRPC Forward import listener — the importsrv role
+        (reference networking.go:295 StartGRPC, importsrv/server.go)."""
+        from veneur_tpu.forward.grpc_forward import ImportServer
+        scheme, host, port, _ = parse_addr(addr)
+        if scheme != "tcp":
+            raise ValueError(f"grpc listener must be tcp://: {addr!r}")
+        srv = ImportServer(self, f"{host}:{port}")
+        srv.start()
+        self.grpc_servers.append(srv)
+        self.grpc_ports.append(srv.port)
+
+    def _start_ssf(self, addr: str) -> None:
+        """SSF listeners (reference networking.go:205 StartSSF):
+        udp:// datagrams carry one bare protobuf SSFSpan; unix://
+        streams carry framed spans."""
+        scheme, host, port, path = parse_addr(addr)
+        if scheme == "udp":
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            sock.bind((host, port))
+            self._sockets.append(sock)
+            self.ssf_ports.append(sock.getsockname()[1])
+            t = threading.Thread(target=self._ssf_packet_reader,
+                                 args=(sock,), daemon=True,
+                                 name="ssf-udp")
+            t.start()
+            self._threads.append(t)
+        elif scheme == "unix":
+            if os.path.exists(path):
+                os.unlink(path)
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.bind(path)
+            sock.listen(64)
+            self._sockets.append(sock)
+            t = threading.Thread(target=self._ssf_stream_acceptor,
+                                 args=(sock,), daemon=True,
+                                 name="ssf-unix")
+            t.start()
+            self._threads.append(t)
+        else:
+            raise ValueError(f"unsupported ssf address {addr!r}")
+
+    def _ssf_packet_reader(self, sock: socket.socket) -> None:
+        """UDP SSF: one span per datagram (reference server.go:1300
+        ReadSSFPacketSocket)."""
+        from veneur_tpu.protocol import wire
+        bufsize = min(self.config.trace_max_length_bytes, 65536)
+        while not self._shutdown.is_set():
+            try:
+                data = sock.recv(bufsize)
+            except OSError:
+                return
+            if not data:
+                continue
+            try:
+                span = wire.parse_ssf(data)
+            except wire.SSFParseError:
+                self.bump("ssf_errors")
+                continue
+            self.bump("received_ssf-udp")
+            self.handle_ssf(span)
+
+    def _ssf_stream_acceptor(self, sock: socket.socket) -> None:
+        while not self._shutdown.is_set():
+            try:
+                conn, _ = sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._ssf_stream_conn,
+                                 args=(conn,), daemon=True)
+            t.start()
+
+    def _ssf_stream_conn(self, conn: socket.socket) -> None:
+        """Framed SSF stream (reference server.go:1335
+        ReadSSFStreamSocket): framing errors drop the connection, bad
+        payloads only drop the one span."""
+        from veneur_tpu.protocol import wire
+        f = conn.makefile("rb")
+        try:
+            while not self._shutdown.is_set():
+                try:
+                    span = wire.read_ssf(f)
+                except wire.SSFParseError:
+                    self.bump("ssf_errors")
+                    continue
+                except wire.FramingError:
+                    self.bump("ssf_errors")
+                    return
+                if span is None:
+                    return
+                self.bump("received_ssf-unix")
+                self.handle_ssf(span)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def handle_ssf(self, span) -> None:
+        """Enqueue one span (reference server.go:1190 handleSSF);
+        per-protocol receive counters are bumped at the listeners."""
+        self.span_worker.submit(span)
+
+    def _udp_reader(self, sock: socket.socket,
+                    proto: str = "dogstatsd-udp") -> None:
         """Blocking datagram read loop (reference server.go:1240
         ReadMetricSocket).
 
@@ -277,6 +415,7 @@ class Server:
                 continue
             if parser is None:
                 self.handle_packet(data)
+                self.bump(f"received_{proto}")
                 continue
             batch = [data]
             try:
@@ -287,6 +426,7 @@ class Server:
             except (BlockingIOError, OSError):
                 pass
             self.handle_packet_batch(batch, parser)
+            self.bump(f"received_{proto}", len(batch))
 
     def handle_packet_batch(self, packets: list[bytes],
                             parser) -> None:
@@ -344,10 +484,14 @@ class Server:
                 if not chunk:
                     break
                 buf += chunk
+                nlines = 0
                 while b"\n" in buf:
                     line, buf = buf.split(b"\n", 1)
                     if line:
                         self.handle_packet(line)
+                        nlines += 1
+                if nlines:
+                    self.bump("received_dogstatsd-tcp", nlines)
                 if len(buf) > self.config.metric_max_length:
                     self.bump("packet_errors")
                     buf = b""
@@ -402,6 +546,7 @@ class Server:
                         self._ok(json.dumps({"accepted": acc}).encode(),
                                  "application/json")
                     except (ValueError, KeyError) as e:
+                        server.bump("import_errors")
                         self.send_error(400, str(e))
                 else:
                     self.send_error(404)
@@ -436,6 +581,7 @@ class Server:
         (reference flusher.go:28 Flush)."""
         if self._shutdown.is_set():
             return FlushResult()
+        t_flush0 = time.monotonic_ns()
         with self.lock:
             snap = self.table.swap()
             events = self.events
@@ -469,22 +615,59 @@ class Server:
         if self.is_local and res.forward:
             futures.append(self._pool.submit(self._forward,
                                              res.forward))
+        futures.append(self._pool.submit(self.span_worker.flush))
         for f in futures:
-            f.result(timeout=max(self.interval, 10.0))
+            try:
+                f.result(timeout=max(self.interval, 10.0))
+            except Exception:
+                self.bump("flush_errors")
+                log.exception("flush task failed")
+        with self._stats_lock:
+            sink_durs = dict(self._sink_durations)
+            self._sink_durations.clear()
+        try:
+            self.telemetry.flush_tick(
+                res.tally, time.monotonic_ns() - t_flush0, sink_durs)
+        except Exception:
+            log.exception("self-telemetry emission failed")
         return res
 
-    @staticmethod
-    def _safe_sink_flush(sink, batch, other) -> None:
+    def _safe_sink_flush(self, sink, batch, other) -> None:
+        t0 = time.monotonic_ns()
         try:
             sink.flush(batch)
             if other:
                 sink.flush_other_samples(other)
         except Exception:
+            self.bump("flush_errors")
             log.exception("sink %s flush failed", sink.name)
+        with self._stats_lock:
+            self._sink_durations[sink.name] = (
+                self._sink_durations.get(sink.name, 0) +
+                time.monotonic_ns() - t0)
 
     def _forward(self, rows) -> None:
-        """POST mergeable state upstream (reference flusher.go:363
+        """Ship mergeable state upstream over gRPC or HTTP (reference
+        flusher.go:82-99: forwardGRPC when configured, else
         flushForward; errors dropped-and-counted, never retried)."""
+        t0 = time.monotonic_ns()
+        try:
+            if self.config.forward_use_grpc:
+                self._forward_grpc(rows)
+                return
+            self._forward_http(rows)
+        except Exception as e:
+            # encoding bugs / missing grpcio / anything: forwarding
+            # must never abort the flush pipeline
+            self.bump("metrics_dropped", len(rows))
+            self.bump("forward_errors")
+            log.exception("forward failed: %s", e)
+        finally:
+            self.bump("forward_duration_ns",
+                      time.monotonic_ns() - t0)
+            self.bump("forward_post_metrics", len(rows))
+
+    def _forward_http(self, rows) -> None:
         body, headers = http_import.encode_rows(rows)
         url = self.config.forward_address.rstrip("/") + "/import"
         if not url.startswith("http"):
@@ -496,7 +679,21 @@ class Server:
                 r.read()
         except OSError as e:
             self.bump("metrics_dropped", len(rows))
+            self.bump("forward_errors")
             log.warning("forward failed: %s", e)
+
+    def _forward_grpc(self, rows) -> None:
+        from veneur_tpu.forward.grpc_forward import ForwardClient
+        import grpc as _grpc
+        if self._grpc_client is None:
+            self._grpc_client = ForwardClient(
+                self.config.forward_address)
+        try:
+            self._grpc_client.send(rows)
+        except _grpc.RpcError as e:
+            self.bump("metrics_dropped", len(rows))
+            self.bump("forward_errors")
+            log.warning("grpc forward failed: %s", e)
 
     # ------------------------------------------------------------------
 
@@ -522,4 +719,9 @@ class Server:
                 pass
         if self._httpd:
             self._httpd.shutdown()
+        for g in self.grpc_servers:
+            g.stop()
+        self.span_worker.stop()
+        if self._grpc_client is not None:
+            self._grpc_client.close()
         self._pool.shutdown(wait=False)

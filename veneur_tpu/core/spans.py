@@ -1,0 +1,111 @@
+"""Span worker: fan SSF spans out to every span sink.
+
+The reference's SpanWorker (worker.go:575-719): a buffered channel
+feeding one goroutine that stamps common tags, validates, then gives
+every span sink a bounded chance to ingest (9s timeout each,
+worker.go:611); sinks that error or time out are counted, never fatal.
+Here: a bounded queue drained by a worker thread, with per-sink ingest
+dispatched through a small pool so one wedged sink cannot stall the
+others past the timeout.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor, TimeoutError as FTimeout
+
+log = logging.getLogger("veneur_tpu.spans")
+
+SINK_TIMEOUT = 9.0  # reference worker.go:611 const Timeout
+
+
+class SpanWorker:
+    def __init__(self, sinks: list, common_tags: dict[str, str],
+                 capacity: int = 1024, stats_cb=None):
+        self.sinks = list(sinks)
+        self.common_tags = dict(common_tags)
+        self.queue: queue.Queue = queue.Queue(maxsize=capacity)
+        self._stats_cb = stats_cb or (lambda name, n=1: None)
+        # one single-thread executor PER SINK: a wedged sink can only
+        # wedge itself — its spans are dropped-and-counted while its
+        # ingest hangs, and every other sink keeps flowing (the
+        # reference gets the same isolation from per-sink goroutines,
+        # worker.go:648)
+        self._pools = [ThreadPoolExecutor(max_workers=1)
+                       for _ in self.sinks]
+        self._pending = [None] * len(self.sinks)
+        self._shutdown = threading.Event()
+        self._thread = threading.Thread(target=self._work, daemon=True,
+                                        name="span-worker")
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def submit(self, span) -> bool:
+        """Enqueue; drop-and-count when the buffer is full (the
+        reference counts near-capacity, worker.go:614)."""
+        try:
+            self.queue.put_nowait(span)
+            return True
+        except queue.Full:
+            self._stats_cb("spans_dropped")
+            return False
+
+    def _work(self) -> None:
+        from veneur_tpu.protocol.wire import valid_trace
+        while not self._shutdown.is_set():
+            try:
+                span = self.queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            # common tags fill only missing keys (worker.go:622-628)
+            for k, v in self.common_tags.items():
+                if k not in span.tags:
+                    span.tags[k] = v
+            # neither a valid span nor metrics: client error, drop
+            # (worker.go:636-646)
+            if not valid_trace(span) and len(span.metrics) == 0:
+                self._stats_cb("empty_ssf")
+                continue
+            futs = []
+            for i, s in enumerate(self.sinks):
+                prev = self._pending[i]
+                if prev is not None and not prev.done():
+                    # the sink is still stuck in an earlier ingest:
+                    # don't queue more work behind it
+                    self._stats_cb("span_sink_dropped")
+                    continue
+                self._pending[i] = self._pools[i].submit(s.ingest, span)
+                futs.append((i, s))
+            for i, sink in futs:
+                try:
+                    self._pending[i].result(timeout=SINK_TIMEOUT)
+                    self._pending[i] = None
+                except FTimeout:
+                    # leave the future as pending; later spans skip
+                    # this sink until it returns
+                    self._stats_cb("span_sink_timeouts")
+                    log.warning("span sink %s timed out", sink.name)
+                except Exception:
+                    self._pending[i] = None
+                    self._stats_cb("span_sink_errors")
+                    log.exception("span sink %s ingest failed",
+                                  sink.name)
+            self._stats_cb("spans_processed")
+
+    def flush(self) -> None:
+        """Per-interval sink flush (reference SpanWorker.Flush,
+        worker.go:698)."""
+        for s in self.sinks:
+            try:
+                s.flush()
+            except Exception:
+                log.exception("span sink %s flush failed", s.name)
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        self._thread.join(timeout=1.0)
+        for p in self._pools:
+            p.shutdown(wait=False)

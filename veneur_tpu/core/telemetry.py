@@ -1,0 +1,154 @@
+"""Self-telemetry: the framework reports its own operation using the
+reference's documented operator metric names (README.md:253-299;
+flusher.go:32-47 runtime stats, :305-361 flush-count reporting), so
+existing veneur dashboards and alerts keep working.
+
+Two emission paths, as in the reference:
+- ``stats_address`` set: DogStatsD datagrams to an external agent
+  (the scopedstatsd client role, server.go:335-345).
+- otherwise: samples are injected into the server's own aggregation
+  table — the moral of the reference's in-process loopback channel
+  client (server.go:347-354 NewChannelClient).
+
+All counters are per-interval deltas of the server's stats dict.
+"""
+
+from __future__ import annotations
+
+import gc
+import logging
+import resource
+import socket
+import time
+
+from veneur_tpu.protocol import dogstatsd as dsd
+from veneur_tpu.protocol.addr import parse_addr
+
+log = logging.getLogger("veneur_tpu.telemetry")
+
+# stats-dict key -> (metric name, extra tags)
+_COUNTER_MAP = {
+    "metrics_processed": ("veneur.worker.metrics_processed_total",
+                          ("worker:0",)),
+    "imports_received": ("veneur.worker.metrics_imported_total", ()),
+    "packet_errors": ("veneur.packet.error_total", ()),
+    "import_errors": ("veneur.import.request_error_total", ()),
+    "flush_errors": ("veneur.flush.error_total", ()),
+    "forward_errors": ("veneur.forward.error_total", ()),
+    "spans_processed": ("veneur.worker.spans_processed_total", ()),
+    "ssf_errors": ("veneur.packet.error_total",
+                   ("packet_type:ssf_metric",)),
+}
+
+# per-protocol receive counters (README: veneur.listen.
+# received_per_protocol_total tagged by protocol)
+_PROTOCOLS = ("dogstatsd-udp", "dogstatsd-tcp", "dogstatsd-unixgram",
+              "ssf-udp", "ssf-unix", "grpc")
+
+_FLUSHED_TYPES = ("counters", "gauges", "histograms", "sets")
+
+
+class Telemetry:
+    def __init__(self, server):
+        self.server = server
+        self._last: dict[str, int] = {}
+        self._sock: socket.socket | None = None
+        self._addr = None
+        addr = server.config.stats_address
+        if addr:
+            # accept both url style (udp://host:port, as every other
+            # address key) and bare host:port
+            if "://" in addr:
+                _, host, port, _ = parse_addr(addr)
+            else:
+                host, _, port = addr.rpartition(":")
+                port = int(port)
+            self._addr = (host or "127.0.0.1", port)
+            self._sock = socket.socket(socket.AF_INET,
+                                       socket.SOCK_DGRAM)
+        self._send_errs = 0
+
+    # ------------------------------------------------------------------
+
+    def _delta(self, key: str) -> int:
+        cur = self.server.stats.get(key, 0)
+        d = cur - self._last.get(key, 0)
+        self._last[key] = cur
+        return d
+
+    def flush_tick(self, tally: dict, flush_duration_ns: float,
+                   sink_durations: dict[str, float]) -> None:
+        """Called once per flush with the interval's numbers; builds
+        and emits the operator samples."""
+        samples: list[dsd.Sample] = []
+
+        def count(name, value, tags=()):
+            if value:
+                samples.append(dsd.Sample(
+                    name=name, type=dsd.COUNTER, value=float(value),
+                    tags=tuple(sorted(tags)), scope=dsd.SCOPE_LOCAL))
+
+        def gauge(name, value, tags=()):
+            samples.append(dsd.Sample(
+                name=name, type=dsd.GAUGE, value=float(value),
+                tags=tuple(sorted(tags)), scope=dsd.SCOPE_LOCAL))
+
+        def timer(name, value_ns, tags=()):
+            samples.append(dsd.Sample(
+                name=name, type=dsd.TIMER, value=float(value_ns),
+                tags=tuple(sorted(tags)), scope=dsd.SCOPE_LOCAL))
+
+        for key, (name, tags) in _COUNTER_MAP.items():
+            count(name, self._delta(key), tags)
+        for proto in _PROTOCOLS:
+            count("veneur.listen.received_per_protocol_total",
+                  self._delta(f"received_{proto}"),
+                  (f"protocol:{proto}",))
+        for mtype in _FLUSHED_TYPES:
+            count("veneur.worker.metrics_flushed_total",
+                  tally.get(mtype, 0), (f"metric_type:{mtype}",))
+        count("veneur.forward.post_metrics_total",
+              self._delta("forward_post_metrics"))
+        fwd_ns = self._delta("forward_duration_ns")
+        if fwd_ns:
+            timer("veneur.forward.duration_ns", fwd_ns)
+
+        timer("veneur.flush.total_duration_ns", flush_duration_ns)
+        for sink_name, dur_ns in sink_durations.items():
+            timer("veneur.sink.metric_flush_total_duration_ns", dur_ns,
+                  (f"sink:{sink_name}",))
+
+        # runtime stats (flusher.go:32-43: gc.number, heap bytes)
+        counts = gc.get_stats()
+        gauge("veneur.gc.number",
+              sum(s.get("collections", 0) for s in counts))
+        gauge("veneur.mem.heap_alloc_bytes",
+              resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024)
+        gauge("veneur.flush.flush_timestamp_ns", time.time_ns())
+
+        self._emit(samples)
+
+    # ------------------------------------------------------------------
+
+    def _emit(self, samples: list[dsd.Sample]) -> None:
+        if self._sock is not None:
+            lines = []
+            for s in samples:
+                t = {dsd.COUNTER: "c", dsd.GAUGE: "g",
+                     dsd.TIMER: "ms"}[s.type]
+                tagstr = ("|#" + ",".join(s.tags)) if s.tags else ""
+                lines.append(f"{s.name}:{s.value}|{t}{tagstr}")
+            try:
+                self._sock.sendto("\n".join(lines).encode(), self._addr)
+            except OSError as e:
+                self._send_errs += 1
+                if self._send_errs <= 3:  # don't spam every interval
+                    log.warning("stats_address %s send failed: %s",
+                                self._addr, e)
+            return
+        # loopback: inject into our own table (next interval's flush
+        # carries them, like the reference's async statsd client)
+        srv = self.server
+        with srv.lock:
+            for s in samples:
+                srv.table.ingest(s)

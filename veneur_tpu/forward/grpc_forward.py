@@ -189,6 +189,7 @@ class ImportServer:
             acc, dropped = apply_metric_list(core.table, request)
             core._maybe_device_step_locked()
         core.bump("imports_received", acc)
+        core.bump("received_grpc", len(request.metrics))
         if dropped:
             core.bump("metrics_dropped", dropped)
         return empty_pb2.Empty()

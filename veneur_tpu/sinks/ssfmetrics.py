@@ -1,0 +1,42 @@
+"""ssfmetrics: the span -> metric extraction bridge.
+
+The reference wires this sink into the HOT span path
+(sinks/ssfmetrics/metrics.go:30, constructed server.go:444-452): every
+span's attached SSFSamples become ordinary metrics in the aggregation
+tables, and indicator spans additionally synthesize SLI duration
+timers (samplers/parser.go:129 ConvertIndicatorMetrics).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from veneur_tpu.protocol import ssf_convert
+
+log = logging.getLogger("veneur_tpu.sinks")
+
+
+class MetricExtractionSink:
+    name = "ssfmetrics"
+
+    def __init__(self, server, indicator_timer_name: str = "",
+                 objective_timer_name: str = ""):
+        self._server = server
+        self.indicator_timer_name = indicator_timer_name
+        self.objective_timer_name = objective_timer_name
+
+    def start(self) -> None:
+        pass
+
+    def ingest(self, span) -> None:
+        samples, invalid = ssf_convert.convert_metrics(span)
+        samples.extend(ssf_convert.convert_indicator_metrics(
+            span, self.indicator_timer_name,
+            self.objective_timer_name))
+        if invalid:
+            self._server.bump("ssf_invalid_samples", invalid)
+        for s in samples:
+            self._server.ingest_parsed(s)
+
+    def flush(self) -> None:
+        pass
