@@ -1,0 +1,28 @@
+# Build image for veneur-tpu.  Mirrors the reference's gated build
+# (its Dockerfile runs gofmt + `go test -race ./...` before producing
+# the artifact): the image only builds if the native parser compiles
+# and the full test suite passes on the virtual 8-device CPU mesh.
+
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ protobuf-compiler && rm -rf /var/lib/apt/lists/*
+
+RUN pip install --no-cache-dir \
+        jax flax optax numpy pyyaml grpcio protobuf pytest
+
+WORKDIR /app
+COPY veneur_tpu/ veneur_tpu/
+COPY tests/ tests/
+COPY pytest.ini bench.py __graft_entry__.py ./
+COPY example.yaml example_host.yaml example_proxy.yaml ./
+
+# build gate: native parser compile + full suite (the reference's
+# `go test -race` role; jit purity on device + the suite's threaded
+# integration tests are the concurrency check)
+RUN python -c "import veneur_tpu.native as n; assert n.load()" && \
+    python -m pytest tests/ -q
+
+EXPOSE 8126/udp 8126/tcp 8127 8128/udp 8129
+ENTRYPOINT ["python", "-m", "veneur_tpu.cli.main"]
+CMD ["-f", "example.yaml"]

@@ -1,0 +1,212 @@
+"""Proxy tier tests: consistent-ring properties, discovery
+keep-last-good refresh, and the in-process local -> proxy -> two
+globals topology (the model of reference forward_grpc_test.go and
+consul_discovery_test.go)."""
+
+import json
+import time
+
+import pytest
+
+pytest.importorskip("grpc")
+
+from veneur_tpu.core.config import ProxyConfig, read_config
+from veneur_tpu.core.proxy import ProxyServer
+from veneur_tpu.core.server import Server
+from veneur_tpu.forward.discovery import (ConsulDiscoverer,
+                                          DestinationRing,
+                                          StaticDiscoverer)
+from veneur_tpu.forward.ring import ConsistentRing
+from veneur_tpu.sinks.simple import CaptureSink
+
+
+# ----------------------------------------------------------------------
+# ring
+
+def test_ring_stable_assignment():
+    ring = ConsistentRing(["a:1", "b:1", "c:1"])
+    keys = [f"metric-{i}" for i in range(1000)]
+    first = [ring.get(k) for k in keys]
+    assert first == [ring.get(k) for k in keys]
+    # all members get a share
+    assert set(first) == {"a:1", "b:1", "c:1"}
+
+
+def test_ring_minimal_remap_on_member_change():
+    keys = [f"metric-{i}" for i in range(2000)]
+    r3 = ConsistentRing(["a:1", "b:1", "c:1"])
+    before = {k: r3.get(k) for k in keys}
+    r4 = ConsistentRing(["a:1", "b:1", "c:1", "d:1"])
+    moved = sum(1 for k in keys if r4.get(k) != before[k])
+    # adding 1 of 4 members should move roughly 1/4 of keys, far from
+    # a full reshuffle
+    assert 0.10 < moved / len(keys) < 0.45
+    # keys that moved all moved TO the new member
+    for k in keys:
+        if r4.get(k) != before[k]:
+            assert r4.get(k) == "d:1"
+
+
+def test_ring_empty_raises():
+    with pytest.raises(LookupError):
+        ConsistentRing().get("x")
+
+
+# ----------------------------------------------------------------------
+# discovery
+
+class _FlakyDiscoverer:
+    def __init__(self):
+        self.responses = []
+
+    def get_destinations_for_service(self, service):
+        r = self.responses.pop(0)
+        if isinstance(r, Exception):
+            raise r
+        return r
+
+
+def test_keep_last_good_on_error_and_empty():
+    disc = _FlakyDiscoverer()
+    disc.responses = [["a:1", "b:1"], RuntimeError("consul down"), [],
+                      ["b:1", "c:1"]]
+    ring = DestinationRing(disc, "svc")
+    assert ring.refresh()
+    assert ring.ring.members == ("a:1", "b:1")
+    assert not ring.refresh()  # error: keep last good
+    assert ring.ring.members == ("a:1", "b:1")
+    assert not ring.refresh()  # empty: keep last good
+    assert ring.ring.members == ("a:1", "b:1")
+    assert ring.refresh()
+    assert ring.ring.members == ("b:1", "c:1")
+    assert ring.refresh_failures == 2
+
+
+def test_consul_discoverer_parses_health_response():
+    """Canned Consul health JSON through an injected opener — zero real
+    Consul (the reference's RoundTripper fake,
+    consul_discovery_test.go:14)."""
+    payload = json.dumps([
+        {"Node": {"Address": "10.0.0.1"},
+         "Service": {"Address": "", "Port": 8128}},
+        {"Node": {"Address": "10.0.0.2"},
+         "Service": {"Address": "192.168.1.5", "Port": 8200}},
+    ]).encode()
+
+    class _Resp:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+        def read(self):
+            return payload
+
+    seen_urls = []
+
+    def opener(url, timeout=None):
+        seen_urls.append(url)
+        return _Resp()
+
+    d = ConsulDiscoverer("http://consul:8500", opener=opener)
+    dests = d.get_destinations_for_service("veneur-global")
+    assert dests == ["10.0.0.1:8128", "192.168.1.5:8200"]
+    assert "health/service/veneur-global" in seen_urls[0]
+    assert "passing" in seen_urls[0]
+
+
+# ----------------------------------------------------------------------
+# end-to-end: local -> proxy -> 2 globals
+
+@pytest.fixture
+def chain():
+    servers = []
+    caps = []
+    for _ in range(2):
+        cap = CaptureSink()
+        g = Server(read_config(data={
+            "grpc_listen_addresses": ["tcp://127.0.0.1:0"],
+            "interval": "10s"}), extra_sinks=[cap])
+        g.start()
+        servers.append(g)
+        caps.append(cap)
+    dests = ",".join(f"127.0.0.1:{g.grpc_ports[0]}" for g in servers)
+    proxy = ProxyServer(ProxyConfig(
+        forward_address=dests, grpc_address="127.0.0.1:0",
+        http_address="127.0.0.1:0"))
+    proxy.start()
+
+    lcap = CaptureSink()
+    local = Server(read_config(data={
+        "statsd_listen_addresses": [],
+        "forward_address": f"127.0.0.1:{proxy.grpc_port}",
+        "forward_use_grpc": True, "interval": "10s"}),
+        extra_sinks=[lcap])
+    local.start()
+    yield local, proxy, servers, caps
+    local.shutdown()
+    proxy.shutdown()
+    for g in servers:
+        g.shutdown()
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_local_proxy_two_globals(chain):
+    local, proxy, globals_, caps = chain
+    for s in range(40):
+        for v in range(20):
+            local.handle_packet(
+                f"px.lat:{v}|ms|#series:{s}".encode())
+    local.flush_once()
+    assert _wait(lambda: sum(g.stats.get("imports_received", 0)
+                             for g in globals_) >= 40)
+    for g in globals_:
+        g.flush_once()
+    # both globals got a share (consistent hashing spreads series)
+    share = [g.stats["imports_received"] for g in globals_]
+    assert all(s > 0 for s in share), share
+    assert sum(share) == 40
+    assert proxy.stats["metrics_routed"] == 40
+    # no series double-delivered: total flushed percentile metrics ==
+    # one per series
+    all_metrics = [m for c in caps for m in c.metrics
+                   if m.name == "px.lat.50percentile"]
+    assert len(all_metrics) == 40
+    series_seen = {t for m in all_metrics for t in m.tags}
+    assert len(series_seen) == 40
+
+
+def test_stable_routing_across_refresh(chain):
+    """The same key routes to the same destination across refreshes
+    with unchanged membership."""
+    local, proxy, globals_, caps = chain
+    key_dest = {f"k{i}": proxy.ring.get(f"k{i}") for i in range(50)}
+    proxy.ring.refresh()
+    assert {k: proxy.ring.get(k) for k in key_dest} == key_dest
+
+
+def test_proxy_http_import_path(chain):
+    import urllib.request
+    local, proxy, globals_, caps = chain
+    items = [{"kind": "counter", "name": f"hc{i}", "tags": [],
+              "type": "counter", "scope": "", "value": 2.0}
+             for i in range(10)]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{proxy.http_port}/import",
+        data=json.dumps(items).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    resp = json.loads(urllib.request.urlopen(req).read())
+    assert resp["accepted"] == 10
+    # routed over HTTP to the globals' HTTP /import... the globals in
+    # this fixture only listen on gRPC, so deliveries fail — but the
+    # proxy must count routing and failures, not crash
+    assert _wait(lambda: proxy.stats.get("metrics_routed", 0) >= 10)

@@ -230,3 +230,38 @@ def test_malformed_import_item_does_not_wedge_table(make_server):
     assert _wait(lambda: glob.stats["metrics_processed"] >= 1)
     glob.flush_once()
     assert any(x.name == "after" for x in gcap.metrics)
+
+
+def test_slow_sink_does_not_stall_flush_cadence(make_server):
+    """A sink slower than the interval must not delay subsequent
+    flushes (reference per-tick ctx deadline, server.go:1022-1026)."""
+    import threading
+
+    class SlowSink:
+        name = "slow"
+        calls = 0
+        release = threading.Event()
+
+        def start(self):
+            pass
+
+        def flush(self, metrics):
+            SlowSink.calls += 1
+            SlowSink.release.wait(timeout=30)
+
+        def flush_other_samples(self, samples):
+            pass
+
+    server, cap = make_server(interval="10s")
+    server.metric_sinks.append(SlowSink())
+    _send_udp(server, b"slow.hits:1|c")
+    assert _wait(lambda: server.stats["metrics_processed"] >= 1)
+    t0 = time.monotonic()
+    server.flush_once()
+    # the slow sink wedged for 30s, but flush_once returned within the
+    # interval budget and counted the overrun
+    assert time.monotonic() - t0 < 10.0
+    assert server.stats.get("flush_slow_tasks", 0) >= 1
+    # the fast capture sink still delivered
+    assert any(x.name == "slow.hits" for x in cap.metrics)
+    SlowSink.release.set()

@@ -149,12 +149,41 @@ class Config:
         return problems
 
 
-_FIELD_TYPES = {f.name: f.type for f in fields(Config)}
+@dataclass
+class ProxyConfig:
+    """veneur-proxy configuration (reference config_proxy.go)."""
+    debug: bool = False
+    http_address: str = ""
+    grpc_address: str = ""
+    # static destination list (comma separated), XOR consul discovery
+    forward_address: str = ""
+    consul_forward_service_name: str = ""
+    consul_refresh_interval: str = "30s"
+    consul_url: str = "http://127.0.0.1:8500"
+    forward_timeout: float = 10.0
+    stats_address: str = ""
+
+    def consul_refresh_interval_seconds(self) -> float:
+        return parse_duration(self.consul_refresh_interval)
+
+    def validate(self) -> list[str]:
+        problems = []
+        if not (self.forward_address or
+                self.consul_forward_service_name):
+            problems.append("proxy needs forward_address or "
+                            "consul_forward_service_name")
+        try:
+            if self.consul_refresh_interval_seconds() <= 0:
+                problems.append(
+                    "consul_refresh_interval must be positive")
+        except ValueError as e:
+            problems.append(str(e))
+        return problems
 
 
-def _coerce(name: str, raw: str):
+def _coerce(cls, name: str, raw: str):
     """Coerce an environment-variable string to the field's type."""
-    current = getattr(Config(), name)
+    current = getattr(cls(), name)
     if isinstance(current, bool):
         return raw.lower() in ("1", "true", "yes", "on")
     if isinstance(current, int):
@@ -170,12 +199,16 @@ def _coerce(name: str, raw: str):
 
 
 def read_config(path: str | None = None, data: dict | None = None,
-                strict: bool = False, env: dict | None = None) -> Config:
+                strict: bool = False, env: dict | None = None,
+                cls=Config):
     """Load config: YAML file -> env overrides -> defaults/validation.
 
     ``strict`` mirrors -validate-config-strict (cmd/veneur/main.go:17):
-    unknown keys become errors instead of warnings.
+    unknown keys become errors instead of warnings.  ``cls`` selects
+    the config dataclass (Config or ProxyConfig — the reference's
+    config.go / config_proxy.go split).
     """
+    field_types = {f.name: f.type for f in fields(cls)}
     raw: dict = {}
     if path is not None:
         if yaml is None:
@@ -185,10 +218,10 @@ def read_config(path: str | None = None, data: dict | None = None,
     if data:
         raw.update(data)
 
-    cfg = Config()
+    cfg = cls()
     unknown = []
     for key, value in raw.items():
-        if key in _FIELD_TYPES:
+        if key in field_types:
             if value is not None:
                 setattr(cfg, key, value)
         else:
@@ -200,10 +233,10 @@ def read_config(path: str | None = None, data: dict | None = None,
         log.warning(msg)
 
     env = os.environ if env is None else env
-    for name in _FIELD_TYPES:
+    for name in field_types:
         env_key = "VENEUR_" + name.upper()
         if env_key in env:
-            setattr(cfg, name, _coerce(name, env[env_key]))
+            setattr(cfg, name, _coerce(cls, name, env[env_key]))
 
     problems = cfg.validate()
     if problems:

@@ -1,0 +1,307 @@
+"""veneur-proxy: consistent-hash routing of forwarded metrics across
+the global tier.
+
+The reference binary (cmd/veneur-proxy, proxy.go, proxysrv/): accepts
+forwarded metrics over gRPC (proxysrv/server.go:180 SendMetrics) and
+HTTP /import (proxy.go:587 ProxyMetrics), assigns every metric to one
+global veneur by consistent-hashing its MetricKey
+(proxysrv/server.go:273), batches per destination, and forwards with
+per-destination clients.  Destinations come from discovery with
+keep-last-good refresh (proxy.go:491 RefreshDestinations).
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import socket
+import threading
+import time
+from collections import defaultdict
+from concurrent.futures import ThreadPoolExecutor
+
+from veneur_tpu.forward import http_import
+from veneur_tpu.forward.discovery import (ConsulDiscoverer,
+                                          DestinationRing,
+                                          StaticDiscoverer)
+
+log = logging.getLogger("veneur_tpu.proxy")
+
+
+class ProxyServer:
+    def __init__(self, config):
+        self.config = config
+        self.stats = defaultdict(int)
+        self._stats_lock = threading.Lock()
+        self._shutdown = threading.Event()
+        self._pool = ThreadPoolExecutor(max_workers=16)
+        self._clients: dict[str, object] = {}
+        self._clients_lock = threading.Lock()
+
+        problems = config.validate()
+        if problems:
+            raise ValueError("; ".join(problems))
+        if config.consul_forward_service_name:
+            disc = ConsulDiscoverer(config.consul_url)
+            service = config.consul_forward_service_name
+        else:
+            disc = StaticDiscoverer(
+                [a.strip() for a in
+                 config.forward_address.split(",") if a.strip()])
+            service = "static"
+        if config.debug:
+            logging.getLogger("veneur_tpu").setLevel(logging.DEBUG)
+        self.ring = DestinationRing(disc, service)
+        if not self.ring.refresh():
+            log.warning("initial discovery refresh failed; starting "
+                        "with an empty ring")
+
+        self.grpc_server = None
+        self.grpc_port = None
+        self._httpd = None
+        self.http_port = None
+        self._threads: list[threading.Thread] = []
+
+    def bump(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self.stats[key] += n
+
+    # ------------------------------------------------------------------
+    # listeners
+
+    def start(self) -> None:
+        if self.config.grpc_address:
+            self._start_grpc()
+        if self.config.http_address:
+            self._start_http()
+        t = threading.Thread(target=self._refresh_loop, daemon=True,
+                             name="discovery-refresh")
+        t.start()
+        self._threads.append(t)
+
+    def _start_grpc(self) -> None:
+        import grpc
+        from concurrent import futures as cf
+        from google.protobuf import empty_pb2
+        from veneur_tpu.forward.gen import forward_pb2
+
+        self.grpc_server = grpc.server(
+            cf.ThreadPoolExecutor(max_workers=8),
+            options=[("grpc.max_receive_message_length",
+                      64 * 1024 * 1024)])
+
+        def send_metrics(request, context):
+            self.route_pb_metrics(list(request.metrics))
+            return empty_pb2.Empty()
+
+        handler = grpc.method_handlers_generic_handler(
+            "forwardrpc.Forward",
+            {"SendMetrics": grpc.unary_unary_rpc_method_handler(
+                send_metrics,
+                request_deserializer=forward_pb2.MetricList.FromString,
+                response_serializer=empty_pb2.Empty.SerializeToString)})
+        self.grpc_server.add_generic_rpc_handlers((handler,))
+        host, _, port = self.config.grpc_address.rpartition(":")
+        self.grpc_port = self.grpc_server.add_insecure_port(
+            f"{host or '127.0.0.1'}:{port}")
+        self.grpc_server.start()
+
+    def _start_http(self) -> None:
+        proxy = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path == "/healthcheck":
+                    body = b"ok"
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                if self.path != "/import":
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                try:
+                    items = http_import.decode_body(
+                        body, self.headers.get("Content-Encoding", ""))
+                except (ValueError, KeyError) as e:
+                    proxy.bump("import_errors")
+                    self.send_error(400, str(e))
+                    return
+                proxy.route_json_items(items)
+                out = json.dumps({"accepted": len(items)}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+        host, _, port = self.config.http_address.rpartition(":")
+        self._httpd = http.server.ThreadingHTTPServer(
+            (host or "127.0.0.1", int(port)), Handler)
+        self.http_port = self._httpd.server_port
+        t = threading.Thread(target=self._httpd.serve_forever,
+                             daemon=True, name="proxy-http")
+        t.start()
+        self._threads.append(t)
+
+    # ------------------------------------------------------------------
+    # routing
+
+    # metricpb.Type enum value -> the same type strings the JSON import
+    # schema carries, so one series routes identically whichever
+    # protocol its local forwards over (the reference routes both paths
+    # on MetricKey.String(), proxysrv/server.go:273 / proxy.go:587)
+    _PB_TYPE_NAMES = {0: "counter", 1: "gauge", 2: "histogram",
+                      3: "set", 4: "timer"}
+
+    @classmethod
+    def _pb_key(cls, m) -> str:
+        """MetricKey identity string (proxysrv/server.go:273)."""
+        t = cls._PB_TYPE_NAMES.get(int(m.type), str(m.type))
+        return f"{m.name}|{t}|{','.join(m.tags)}"
+
+    @staticmethod
+    def _json_key(item: dict) -> str:
+        return (f"{item.get('name')}|{item.get('type')}|"
+                f"{','.join(item.get('tags', ()))}")
+
+    def route_pb_metrics(self, metrics: list) -> None:
+        """Group by destination and forward over gRPC, one task per
+        destination (proxysrv/server.go:286 per-dest goroutines)."""
+        groups: dict[str, list] = defaultdict(list)
+        routed = dropped = 0
+        for m in metrics:
+            try:
+                groups[self.ring.get(self._pb_key(m))].append(m)
+                routed += 1
+            except LookupError:
+                dropped += 1
+        self.bump("metrics_routed", routed)
+        if dropped:
+            self.bump("metrics_dropped", dropped)
+        for dest, batch in groups.items():
+            self._pool.submit(self._send_grpc, dest, batch)
+
+    def _send_grpc(self, dest: str, batch: list) -> None:
+        from veneur_tpu.forward.gen import forward_pb2
+        from veneur_tpu.forward.grpc_forward import ForwardClient
+        import grpc
+        try:
+            with self._clients_lock:
+                client = self._clients.get(dest)
+                if client is None:
+                    client = ForwardClient(
+                        dest, timeout=self.config.forward_timeout)
+                    self._clients[dest] = client
+            client._call(forward_pb2.MetricList(metrics=batch),
+                         timeout=self.config.forward_timeout)
+            self.bump("forwards_sent")
+        except (grpc.RpcError, OSError) as e:
+            # dropped-and-counted, never retried within a flush
+            # (reference flusher/proxy error semantics)
+            self.bump("forward_errors")
+            log.warning("proxy forward to %s failed: %s", dest, e)
+
+    def route_json_items(self, items: list[dict]) -> None:
+        """HTTP /import half: route decoded JSON items and re-POST per
+        destination (proxy.go:587 ProxyMetrics)."""
+        groups: dict[str, list] = defaultdict(list)
+        dropped = 0
+        for item in items:
+            try:
+                groups[self.ring.get(self._json_key(item))].append(item)
+            except LookupError:
+                dropped += 1
+        self.bump("metrics_routed", len(items) - dropped)
+        if dropped:
+            self.bump("metrics_dropped", dropped)
+        for dest, batch in groups.items():
+            self._pool.submit(self._send_http, dest, batch)
+
+    def _send_http(self, dest: str, batch: list[dict]) -> None:
+        import urllib.request
+        import zlib
+        body = zlib.compress(json.dumps(batch).encode())
+        url = dest if dest.startswith("http") else f"http://{dest}"
+        req = urllib.request.Request(
+            url.rstrip("/") + "/import", data=body,
+            headers={"Content-Type": "application/json",
+                     "Content-Encoding": "deflate"}, method="POST")
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.config.forward_timeout) as r:
+                r.read()
+            self.bump("forwards_sent")
+        except OSError as e:
+            self.bump("forward_errors")
+            log.warning("proxy forward to %s failed: %s", dest, e)
+
+    # ------------------------------------------------------------------
+
+    def _emit_stats(self) -> None:
+        """Operational metrics to stats_address as DogStatsD deltas
+        (the reference proxy's statsd reporting)."""
+        if not self.config.stats_address:
+            return
+        if not hasattr(self, "_stats_sock"):
+            self._stats_sock = socket.socket(socket.AF_INET,
+                                             socket.SOCK_DGRAM)
+            self._stats_last: dict[str, int] = {}
+            addr = self.config.stats_address
+            host, _, port = addr.removeprefix("udp://").rpartition(":")
+            self._stats_dest = (host or "127.0.0.1", int(port))
+        lines = []
+        with self._stats_lock:
+            snap = dict(self.stats)
+        for key in ("metrics_routed", "metrics_dropped",
+                    "forwards_sent", "forward_errors",
+                    "import_errors"):
+            d = snap.get(key, 0) - self._stats_last.get(key, 0)
+            self._stats_last[key] = snap.get(key, 0)
+            if d:
+                lines.append(f"veneur.proxy.{key}:{d}|c")
+        lines.append(
+            f"veneur.proxy.destinations:{len(self.ring.ring)}|g")
+        try:
+            self._stats_sock.sendto("\n".join(lines).encode(),
+                                    self._stats_dest)
+        except OSError:
+            pass
+
+    def _refresh_loop(self) -> None:
+        interval = self.config.consul_refresh_interval_seconds()
+        while not self._shutdown.wait(interval):
+            self.ring.refresh()
+            self._emit_stats()
+            # drop clients for destinations that left the ring
+            with self._clients_lock:
+                gone = set(self._clients) - set(self.ring.ring.members)
+                for dest in gone:
+                    try:
+                        self._clients.pop(dest).close()
+                    except Exception:
+                        pass
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        if self.grpc_server is not None:
+            self.grpc_server.stop(0.5)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+        with self._clients_lock:
+            for c in self._clients.values():
+                try:
+                    c.close()
+                except Exception:
+                    pass
+        self._pool.shutdown(wait=False)

@@ -103,6 +103,8 @@ class Server:
         from veneur_tpu.core.telemetry import Telemetry
         self.telemetry = Telemetry(self)
         self._sink_durations: dict[str, float] = {}
+        self._flush_pending: dict[str, object] = {}
+        self._tls_context = self._build_tls()
 
         self._shutdown = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -141,7 +143,54 @@ class Server:
         if c.aws_s3_bucket:
             self.plugins.append(S3ArchivePlugin(
                 c.aws_s3_bucket, spool_dir="s3_spool",
-                hostname=c.hostname))
+                hostname=c.hostname, region=c.aws_region))
+        if c.sentry_dsn:
+            # no sentry SDK in this build: honest no-op, loudly
+            log.warning("sentry_dsn set but no sentry SDK is "
+                        "available in this build; crash reporting "
+                        "disabled (panics still log with tracebacks)")
+
+    def _build_tls(self):
+        """TLS (optionally mutual) for the TCP statsd listener
+        (reference server.go:484-518: tls_key + tls_certificate enable
+        TLS; tls_authority_certificate additionally requires client
+        certs)."""
+        c = self.config
+        if not (c.tls_key and c.tls_certificate):
+            if c.tls_authority_certificate:
+                raise ValueError(
+                    "tls_authority_certificate requires tls_key and "
+                    "tls_certificate")
+            return None
+        import ssl
+        import tempfile
+
+        def _matfile(value: str) -> str:
+            # the reference's config carries inline PEM strings
+            # (example.yaml tls_key); file paths also accepted.  Inline
+            # material is spilled 0600 and unlinked at exit so private
+            # keys never persist in /tmp
+            if value.lstrip().startswith("-----BEGIN"):
+                import atexit
+                f = tempfile.NamedTemporaryFile(
+                    mode="w", suffix=".pem", delete=False)
+                os.chmod(f.name, 0o600)
+                f.write(value)
+                f.close()
+                atexit.register(
+                    lambda p=f.name: os.path.exists(p) and
+                    os.unlink(p))
+                return f.name
+            return value
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(certfile=_matfile(c.tls_certificate),
+                            keyfile=_matfile(c.tls_key))
+        if c.tls_authority_certificate:
+            ctx.load_verify_locations(
+                cafile=_matfile(c.tls_authority_certificate))
+            ctx.verify_mode = ssl.CERT_REQUIRED
+        return ctx
 
     # ------------------------------------------------------------------
     # ingest
@@ -226,6 +275,8 @@ class Server:
         self.span_worker.start()
         for s in self.span_sinks:
             s.start()
+        if self.config.enable_profiling:
+            self._start_profiling()
         t = threading.Thread(target=self._flush_loop, daemon=True,
                              name="flush")
         t.start()
@@ -264,6 +315,13 @@ class Server:
             sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             sock.bind((host, port))
             sock.listen(128)
+            if self._tls_context is not None:
+                # TLS termination on the listener; per-connection
+                # handshakes happen in the acceptor thread (reference
+                # server.go:484-518 TLS config + networking.go:104)
+                sock = self._tls_context.wrap_socket(
+                    sock, server_side=True,
+                    do_handshake_on_connect=False)
             self._sockets.append(sock)
             self.statsd_ports.append(sock.getsockname()[1])
             t = threading.Thread(target=self._tcp_acceptor,
@@ -464,9 +522,15 @@ class Server:
             self.bump("metrics_dropped", dropped)
 
     def _tcp_acceptor(self, sock: socket.socket) -> None:
+        import ssl as _ssl
         while not self._shutdown.is_set():
             try:
                 conn, _ = sock.accept()
+            except _ssl.SSLError:
+                # failed handshake (bad/missing client cert, protocol
+                # junk): count and keep accepting
+                self.bump("tls_handshake_errors")
+                continue
             except OSError:
                 return
             t = threading.Thread(target=self._tcp_conn, args=(conn,),
@@ -476,7 +540,17 @@ class Server:
     def _tcp_conn(self, conn: socket.socket) -> None:
         """Line-delimited statsd over TCP with idle timeout (reference
         server.go:1374 handleTCPGoroutine, 10min timeout :80)."""
+        import ssl as _ssl
         conn.settimeout(600)
+        if isinstance(conn, _ssl.SSLSocket):
+            # handshake here, in the per-connection thread, so a slow
+            # client can't block the acceptor
+            try:
+                conn.do_handshake()
+            except (OSError, _ssl.SSLError):
+                self.bump("tls_handshake_errors")
+                conn.close()
+                return
         buf = b""
         try:
             while not self._shutdown.is_set():
@@ -600,25 +674,48 @@ class Server:
                 type=im.STATUS, message=msg))
 
         futures = []
+
+        def submit(key, fn, *args):
+            # per-destination wedge isolation: if a previous interval's
+            # task for this sink/plugin is still running, skip this
+            # interval's rather than leak another pool worker behind it
+            prev = self._flush_pending.get(key)
+            if prev is not None and not prev.done():
+                self.bump("flush_skipped_busy")
+                log.warning("%s still busy from a previous interval; "
+                            "skipping its flush", key)
+                return
+            fut = self._pool.submit(fn, *args)
+            self._flush_pending[key] = fut
+            futures.append(fut)
+
         for sink in self.metric_sinks:
             batch = sinks_base.route(res.metrics, sink.name, sink
                                      if isinstance(sink,
                                                    sinks_base.SinkBase)
                                      else None)
-            futures.append(self._pool.submit(self._safe_sink_flush,
-                                             sink, batch,
-                                             events + checks))
+            submit(f"sink:{sink.name}", self._safe_sink_flush, sink,
+                   batch, events + checks)
         for plugin in self.plugins:
-            futures.append(self._pool.submit(
-                plugin.flush, list(res.metrics),
-                self.flusher.hostname))
+            submit(f"plugin:{plugin.name}", plugin.flush,
+                   list(res.metrics), self.flusher.hostname)
         if self.is_local and res.forward:
-            futures.append(self._pool.submit(self._forward,
-                                             res.forward))
-        futures.append(self._pool.submit(self.span_worker.flush))
+            submit("forward", self._forward, res.forward)
+        submit("spans", self.span_worker.flush)
+        # Wait for sink/forward/span tasks only within the interval
+        # budget — the reference gives each flush a ctx deadline of one
+        # interval (server.go:1022-1026) so a slow sink or a wedged
+        # global can never delay the next tick.  Overrunning tasks keep
+        # running on the pool and are counted, not cancelled.
+        deadline = t_flush0 / 1e9 + self.interval * 0.9
         for f in futures:
             try:
-                f.result(timeout=max(self.interval, 10.0))
+                f.result(timeout=max(0.0,
+                                     deadline - time.monotonic()))
+            except TimeoutError:
+                self.bump("flush_slow_tasks")
+                log.warning("flush task overran the interval budget; "
+                            "continuing without it")
             except Exception:
                 self.bump("flush_errors")
                 log.exception("flush task failed")
@@ -697,6 +794,17 @@ class Server:
 
     # ------------------------------------------------------------------
 
+    def _start_profiling(self) -> None:
+        """Device+host profile capture behind enable_profiling
+        (reference server.go:1512 pkg/profile CPU profiles; here the
+        jax profiler's xplane traces, viewable in tensorboard/xprof)."""
+        import jax
+        try:
+            jax.profiler.start_trace("./jax_profile")
+            log.info("jax profiler trace -> ./jax_profile")
+        except Exception:
+            log.exception("could not start jax profiler")
+
     def _watchdog(self) -> None:
         """Crash if flushes stop happening (reference server.go:1031
         FlushWatchdog: deliberate crash-and-restart)."""
@@ -722,6 +830,12 @@ class Server:
         for g in self.grpc_servers:
             g.stop()
         self.span_worker.stop()
+        if self.config.enable_profiling:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
         if self._grpc_client is not None:
             self._grpc_client.close()
         self._pool.shutdown(wait=False)

@@ -114,6 +114,14 @@ class Telemetry:
             timer("veneur.forward.duration_ns", fwd_ns)
 
         timer("veneur.flush.total_duration_ns", flush_duration_ns)
+        if self.server.config.count_unique_timeseries:
+            # touched-row counts ARE the unique-timeseries tally (the
+            # reference's tallyTimeseries HLL exists because worker
+            # maps shard; one table needs no sketch, flusher.go:135)
+            uniq = sum(tally.get(k, 0) for k in _FLUSHED_TYPES)
+            is_global = not self.server.is_local
+            count("veneur.flush.unique_timeseries_total", uniq,
+                  (f"global_veneur:{str(is_global).lower()}",))
         for sink_name, dur_ns in sink_durations.items():
             timer("veneur.sink.metric_flush_total_duration_ns", dur_ns,
                   (f"sink:{sink_name}",))

@@ -1,0 +1,60 @@
+"""Consistent-hash ring for proxy routing.
+
+The reference proxy assigns every forwarded metric to one global veneur
+by consistent-hashing its MetricKey over the destination ring
+(proxy.go:587, proxysrv/server.go:273, via stathat.com/c/consistent).
+The property that matters is stability: adding/removing one
+destination remaps only ~1/N of keys, and the same key always lands on
+the same destination while membership is unchanged.  The hash function
+itself is process-internal (both ends of the wire are ours), so this
+uses the repo's fnv1a-64+fmix64 instead of stathat's crc32.
+"""
+
+from __future__ import annotations
+
+import bisect
+
+from veneur_tpu.utils.hashing import _fmix64, fnv1a_64_int
+
+REPLICAS = 120  # vnodes per member: keeps load spread within ~10%
+
+
+def _h(data: str) -> int:
+    return _fmix64(fnv1a_64_int(data.encode()))
+
+
+class ConsistentRing:
+    def __init__(self, members: list[str] | None = None,
+                 replicas: int = REPLICAS):
+        self.replicas = replicas
+        self._points: list[int] = []
+        self._owners: list[str] = []
+        self._members: tuple[str, ...] = ()
+        if members:
+            self.set_members(members)
+
+    def set_members(self, members: list[str]) -> None:
+        pairs = []
+        for m in sorted(set(members)):
+            for i in range(self.replicas):
+                pairs.append((_h(f"{i}:{m}"), m))
+        pairs.sort()
+        self._points = [p for p, _ in pairs]
+        self._owners = [m for _, m in pairs]
+        self._members = tuple(sorted(set(members)))
+
+    @property
+    def members(self) -> tuple[str, ...]:
+        return self._members
+
+    def __len__(self) -> int:
+        return len(self._members)
+
+    def get(self, key: str) -> str:
+        """Destination owning ``key``; raises LookupError when empty."""
+        if not self._points:
+            raise LookupError("empty ring")
+        i = bisect.bisect(self._points, _h(key))
+        if i == len(self._points):
+            i = 0
+        return self._owners[i]

@@ -108,8 +108,10 @@ class S3ArchivePlugin:
     key layout (<hostname>/<ts>.tsv.gz) for an external shipper."""
     name = "s3"
 
-    def __init__(self, bucket: str, spool_dir: str, hostname: str = ""):
+    def __init__(self, bucket: str, spool_dir: str, hostname: str = "",
+                 region: str = ""):
         self.bucket = bucket
+        self.region = region  # recorded for the external shipper
         self.spool_dir = spool_dir
         self.hostname = hostname
 

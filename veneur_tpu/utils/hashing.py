@@ -70,12 +70,18 @@ def pack_bytes_matrix(members: Sequence[bytes],
     return mat, lens
 
 
-def fnv1a_64_scalar(data: bytes) -> bytes:
+def fnv1a_64_int(data: bytes) -> int:
+    """Scalar fnv1a-64 (the single authoritative byte loop — ring
+    placement, key identity and member hashing all build on it)."""
     h = int(FNV1A_64_OFFSET)
     prime = int(FNV1A_64_PRIME)
     for b in data:
         h = ((h ^ b) * prime) & 0xFFFFFFFFFFFFFFFF
-    return h.to_bytes(8, "little")
+    return h
+
+
+def fnv1a_64_scalar(data: bytes) -> bytes:
+    return fnv1a_64_int(data).to_bytes(8, "little")
 
 
 def _fmix64(h: int) -> int:
@@ -95,13 +101,9 @@ def key_hash64(name: str, type_code: int, tags: Sequence[str],
     scope) — MUST stay bit-identical to the native parser's key hash
     (veneur_tpu/native/dsd_parse.cpp) so slow-path row allocations and
     fast-path lookups agree.  Tags are assumed already sorted."""
-    h = int(FNV1A_64_OFFSET)
-    prime = int(FNV1A_64_PRIME)
     payload = (name.encode() + b"\x00" + bytes([type_code]) + b"\x00" +
                ",".join(tags).encode() + b"\x00" + bytes([scope_code]))
-    for b in payload:
-        h = ((h ^ b) * prime) & 0xFFFFFFFFFFFFFFFF
-    return _fmix64(h)
+    return _fmix64(fnv1a_64_int(payload))
 
 
 def hash64(members: Sequence[bytes]) -> np.ndarray:
