@@ -1,0 +1,96 @@
+"""Generic gRPC span sink (reference sinks/grpsink, 802 LoC): streams
+every span to a remote service implementing
+``/grpsink.SpanSink/SendSpan`` — the protocol Falconer speaks.  The
+reference's resilience behavior is kept: connection state is watched
+lazily, send failures are counted and dropped, and the channel redials
+automatically (grpc-python channels self-heal).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from veneur_tpu.protocol.gen import grpsink_pb2
+
+try:
+    import grpc
+except ImportError:  # pragma: no cover
+    grpc = None
+
+log = logging.getLogger("veneur_tpu.sinks")
+
+_METHOD = "/grpsink.SpanSink/SendSpan"
+
+
+class GRPCSpanSink:
+    name = "grpsink"
+
+    def __init__(self, target: str, timeout: float = 5.0,
+                 name: str = "grpsink"):
+        if grpc is None:  # pragma: no cover
+            raise RuntimeError("grpcio unavailable")
+        self.name = name
+        self.target = target
+        self._timeout = timeout
+        self._channel = grpc.insecure_channel(target)
+        self._call = self._channel.unary_unary(
+            _METHOD,
+            request_serializer=lambda span: span.SerializeToString(),
+            response_deserializer=grpsink_pb2.Empty.FromString)
+        self.submitted = 0
+        self.dropped = 0
+
+    def start(self) -> None:
+        pass
+
+    def ingest(self, span) -> None:
+        try:
+            self._call(span, timeout=self._timeout)
+            self.submitted += 1
+        except grpc.RpcError as e:
+            self.dropped += 1
+            log.debug("%s span send failed: %s", self.name, e)
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+class FalconerSpanSink(GRPCSpanSink):
+    """Falconer is the grpsink protocol under its product name
+    (reference sinks/falconer/falconer.go: a 13-line wrapper)."""
+
+    def __init__(self, target: str, timeout: float = 5.0):
+        super().__init__(target, timeout=timeout, name="falconer")
+
+
+class GRPCSpanSinkServer:
+    """Loopback test server implementing the SpanSink service — the
+    role of the reference's MockSpanSinkServer (grpsink_test.go:20)."""
+
+    def __init__(self, address: str = "127.0.0.1:0"):
+        from concurrent import futures as cf
+        self.spans = []
+        self._grpc = grpc.server(cf.ThreadPoolExecutor(max_workers=2))
+        handler = grpc.method_handlers_generic_handler(
+            "grpsink.SpanSink",
+            {"SendSpan": grpc.unary_unary_rpc_method_handler(
+                self._send,
+                request_deserializer=lambda b: b,
+                response_serializer=(
+                    grpsink_pb2.Empty.SerializeToString))})
+        self._grpc.add_generic_rpc_handlers((handler,))
+        self.port = self._grpc.add_insecure_port(address)
+
+    def _send(self, request, context):
+        from veneur_tpu.protocol.gen import ssf_pb2
+        self.spans.append(ssf_pb2.SSFSpan.FromString(request))
+        return grpsink_pb2.Empty()
+
+    def start(self):
+        self._grpc.start()
+
+    def stop(self):
+        self._grpc.stop(0.2)

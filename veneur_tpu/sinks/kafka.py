@@ -1,0 +1,321 @@
+"""Kafka sink (reference sinks/kafka, 891 LoC via the sarama client).
+
+No Kafka client library ships in this environment, so this module
+implements the minimal modern wire protocol directly: Metadata v1 for
+leader discovery and Produce v3 carrying RecordBatch v2 record sets
+(varint records, crc32c) — the on-disk/wire format every broker since
+0.11 speaks.  Metrics publish as JSON (the reference's
+``encodeInterMetricJSON``), spans as SSF protobuf or JSON per config
+(``kafka_span_serialization_format``), partitioned by metric-name hash
+(the sarama hash partitioner's role).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import struct
+import threading
+
+from veneur_tpu.core.metrics import InterMetric
+from veneur_tpu.sinks.base import SinkBase
+from veneur_tpu.utils.hashing import fnv1a_64_int
+
+log = logging.getLogger("veneur_tpu.sinks")
+
+# ----------------------------------------------------------------------
+# crc32c (Castagnoli) — required by RecordBatch v2
+
+_CRC32C_TABLE = []
+
+
+def _crc32c_init():
+    poly = 0x82F63B78
+    for n in range(256):
+        c = n
+        for _ in range(8):
+            c = (c >> 1) ^ poly if c & 1 else c >> 1
+        _CRC32C_TABLE.append(c)
+
+
+_crc32c_init()
+
+
+def crc32c(data: bytes) -> int:
+    c = 0xFFFFFFFF
+    for b in data:
+        c = _CRC32C_TABLE[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
+
+
+# ----------------------------------------------------------------------
+# wire primitives
+
+def _zigzag(n: int) -> int:
+    return (n << 1) ^ (n >> 63)
+
+
+def _varint(n: int) -> bytes:
+    u = _zigzag(n) & 0xFFFFFFFFFFFFFFFF
+    out = bytearray()
+    while True:
+        b = u & 0x7F
+        u >>= 7
+        if u:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _str(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">h", len(b)) + b
+
+
+def _record(value: bytes, key: bytes | None, offset_delta: int
+            ) -> bytes:
+    body = bytearray()
+    body += b"\x00"  # attributes
+    body += _varint(0)  # timestamp delta
+    body += _varint(offset_delta)
+    if key is None:
+        body += _varint(-1)
+    else:
+        body += _varint(len(key)) + key
+    body += _varint(len(value)) + value
+    body += _varint(0)  # headers
+    return _varint(len(body)) + bytes(body)
+
+
+def record_batch(records: list[tuple[bytes | None, bytes]],
+                 timestamp_ms: int) -> bytes:
+    """RecordBatch v2 for a list of (key, value) pairs."""
+    recs = b"".join(_record(v, k, i)
+                    for i, (k, v) in enumerate(records))
+    after_crc = struct.pack(
+        ">hiqqqhii", 0, len(records) - 1, timestamp_ms, timestamp_ms,
+        -1, -1, -1, len(records)) + recs
+    crc = crc32c(after_crc)
+    head = struct.pack(">qi", 0, 4 + 1 + 4 + len(after_crc))
+    return head + struct.pack(">ib", -1, 2)[4:] + \
+        struct.pack(">i", -1) + b"\x02" + struct.pack(">I", crc) + \
+        after_crc
+
+
+# the above sliced struct is awkward; rebuild cleanly:
+def record_batch(records, timestamp_ms):  # noqa: F811
+    recs = b"".join(_record(v, k, i)
+                    for i, (k, v) in enumerate(records))
+    after_crc = struct.pack(
+        ">hiqqqhii", 0, len(records) - 1, timestamp_ms, timestamp_ms,
+        -1, -1, -1, len(records)) + recs
+    crc = crc32c(after_crc)
+    # partitionLeaderEpoch(-1) + magic(2) + crc + payload
+    tail = struct.pack(">ibI", -1, 2, crc) + after_crc
+    # baseOffset + batchLength
+    return struct.pack(">qi", 0, len(tail)) + tail
+
+
+class KafkaClient:
+    """One-broker client: Metadata v1 + Produce v3."""
+
+    def __init__(self, broker: str, client_id: str = "veneur-tpu",
+                 timeout: float = 10.0):
+        host, _, port = broker.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port or 9092))
+        self.client_id = client_id
+        self.timeout = timeout
+        self._sock: socket.socket | None = None
+        self._corr = 0
+        self._lock = threading.Lock()
+        self._partitions: dict[str, int] = {}
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                self._addr, timeout=self.timeout)
+        return self._sock
+
+    def _request(self, api_key: int, api_version: int,
+                 body: bytes) -> bytes:
+        self._corr += 1
+        header = struct.pack(">hhi", api_key, api_version,
+                             self._corr) + _str(self.client_id)
+        msg = header + body
+        sock = self._connect()
+        try:
+            sock.sendall(struct.pack(">i", len(msg)) + msg)
+            raw_len = self._read_exact(sock, 4)
+            (length,) = struct.unpack(">i", raw_len)
+            resp = self._read_exact(sock, length)
+        except OSError:
+            self._sock = None
+            raise
+        return resp[4:]  # drop correlation id
+
+    @staticmethod
+    def _read_exact(sock, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise OSError("kafka connection closed")
+            buf += chunk
+        return buf
+
+    def partitions_for(self, topic: str) -> int:
+        """Partition count via Metadata v1 (cached)."""
+        if topic in self._partitions:
+            return self._partitions[topic]
+        body = struct.pack(">i", 1) + _str(topic)
+        resp = self._request(3, 1, body)
+        off = 0
+        (n_brokers,) = struct.unpack_from(">i", resp, off)
+        off += 4
+        for _ in range(n_brokers):
+            off += 4  # node id
+            (hlen,) = struct.unpack_from(">h", resp, off)
+            off += 2 + hlen + 4  # host + port
+            (rlen,) = struct.unpack_from(">h", resp, off)
+            off += 2 + max(rlen, 0)  # nullable rack
+        off += 4  # controller id
+        (n_topics,) = struct.unpack_from(">i", resp, off)
+        off += 4
+        n_parts = 1
+        for _ in range(n_topics):
+            (terr,) = struct.unpack_from(">h", resp, off)
+            off += 2
+            (tlen,) = struct.unpack_from(">h", resp, off)
+            off += 2 + tlen
+            off += 1  # is_internal
+            (np_,) = struct.unpack_from(">i", resp, off)
+            off += 4
+            n_parts = max(np_, 1)
+            for _ in range(np_):
+                off += 2 + 4 + 4  # err, partition, leader
+                (nrep,) = struct.unpack_from(">i", resp, off)
+                off += 4 + 4 * nrep
+                (nisr,) = struct.unpack_from(">i", resp, off)
+                off += 4 + 4 * nisr
+        self._partitions[topic] = n_parts
+        return n_parts
+
+    def produce(self, topic: str, partition: int, batch: bytes,
+                acks: int = 1) -> None:
+        """Produce v3, one partition's record set."""
+        body = (struct.pack(">h", -1) +  # null transactional id
+                struct.pack(">hi", acks,
+                            int(self.timeout * 1000)) +
+                struct.pack(">i", 1) + _str(topic) +
+                struct.pack(">i", 1) +
+                struct.pack(">i", partition) +
+                struct.pack(">i", len(batch)) + batch)
+        with self._lock:
+            resp = self._request(0, 3, body)
+        # response: topics[1] -> partitions[1] -> error code
+        off = 4  # topic array len
+        (tlen,) = struct.unpack_from(">h", resp, off)
+        off += 2 + tlen + 4  # topic name + partition array len
+        off += 4  # partition index
+        (err,) = struct.unpack_from(">h", resp, off)
+        if err != 0:
+            raise OSError(f"kafka produce error code {err}")
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+
+class KafkaMetricSink(SinkBase):
+    """InterMetrics as JSON records, keyed and partitioned by metric
+    name (reference kafka.go encodeInterMetricJSON + hash
+    partitioner)."""
+    name = "kafka"
+
+    def __init__(self, broker: str, check_topic: str = "",
+                 event_topic: str = "",
+                 metric_topic: str = "veneur_metrics",
+                 client: KafkaClient | None = None):
+        super().__init__()
+        self.metric_topic = metric_topic
+        self.check_topic = check_topic
+        self.event_topic = event_topic
+        self.client = client or KafkaClient(broker)
+        self.flushed_total = 0
+
+    def flush(self, metrics: list[InterMetric]) -> None:
+        if not metrics:
+            return
+        try:
+            n_parts = self.client.partitions_for(self.metric_topic)
+            groups: dict[int, list] = {}
+            ts = 0
+            for m in metrics:
+                part = fnv1a_64_int(m.name.encode()) % n_parts
+                value = json.dumps({
+                    "name": m.name, "timestamp": m.timestamp,
+                    "value": m.value, "tags": list(m.tags),
+                    "type": m.type}).encode()
+                groups.setdefault(part, []).append(
+                    (m.name.encode(), value))
+                ts = max(ts, m.timestamp * 1000)
+            for part, records in groups.items():
+                self.client.produce(self.metric_topic, part,
+                                    record_batch(records, ts))
+            self.flushed_total += len(metrics)
+        except OSError as e:
+            log.warning("kafka metric flush failed: %s", e)
+
+
+class KafkaSpanSink:
+    """Spans as protobuf or JSON records (reference kafka.go span
+    half; serialization per kafka_span_serialization_format)."""
+    name = "kafka"
+
+    def __init__(self, broker: str, span_topic: str = "veneur_spans",
+                 serialization: str = "protobuf",
+                 client: KafkaClient | None = None):
+        self.span_topic = span_topic
+        self.serialization = serialization
+        self.client = client or KafkaClient(broker)
+        self._buf: list[tuple[bytes | None, bytes]] = []
+        self._lock = threading.Lock()
+        self.submitted = 0
+
+    def start(self) -> None:
+        pass
+
+    def ingest(self, span) -> None:
+        if self.serialization == "json":
+            from google.protobuf.json_format import MessageToDict
+            value = json.dumps(MessageToDict(span)).encode()
+        else:
+            value = span.SerializeToString()
+        with self._lock:
+            self._buf.append((str(span.trace_id).encode(), value))
+
+    def flush(self) -> None:
+        with self._lock:
+            batch, self._buf = self._buf, []
+        if not batch:
+            return
+        try:
+            n_parts = self.client.partitions_for(self.span_topic)
+            groups: dict[int, list] = {}
+            for key, value in batch:
+                part = fnv1a_64_int(key or b"") % n_parts
+                groups.setdefault(part, []).append((key, value))
+            import time as _t
+            ts = int(_t.time() * 1000)
+            for part, records in groups.items():
+                self.client.produce(self.span_topic, part,
+                                    record_batch(records, ts))
+            self.submitted += len(batch)
+        except OSError as e:
+            log.warning("kafka span flush failed: %s", e)

@@ -1,0 +1,85 @@
+"""SignalFx metric sink (reference sinks/signalfx, 1413 LoC).
+
+Flushed InterMetrics POST to ``/v2/datapoint`` as JSON datapoints with
+tag dimensions.  The reference's headline features are kept: counters
+vs gauges split, per-tag API-key routing (``vary_key_by``: metrics
+carrying that tag key use the matching token's client,
+server.go:520-545), and chunked bodies.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import urllib.request
+
+from veneur_tpu.core.metrics import COUNTER, InterMetric
+from veneur_tpu.sinks.base import SinkBase
+
+log = logging.getLogger("veneur_tpu.sinks")
+
+
+class SignalFxSink(SinkBase):
+    name = "signalfx"
+
+    def __init__(self, api_key: str,
+                 endpoint: str = "https://ingest.signalfx.com",
+                 vary_key_by: str = "",
+                 per_tag_api_keys: dict[str, str] | None = None,
+                 max_per_body: int = 5000, hostname: str = ""):
+        super().__init__()
+        self.api_key = api_key
+        self.endpoint = endpoint.rstrip("/")
+        self.vary_key_by = vary_key_by
+        self.per_tag_api_keys = dict(per_tag_api_keys or {})
+        self.max_per_body = max_per_body
+        self.hostname = hostname
+        self.flushed_total = 0
+
+    def _token_for(self, m: InterMetric) -> str:
+        if self.vary_key_by:
+            for t in m.tags:
+                k, _, v = t.partition(":")
+                if k == self.vary_key_by and v in self.per_tag_api_keys:
+                    return self.per_tag_api_keys[v]
+        return self.api_key
+
+    @staticmethod
+    def _datapoint(m: InterMetric) -> dict:
+        dims = {}
+        for t in m.tags:
+            k, _, v = t.partition(":")
+            dims[k] = v
+        if m.hostname:
+            dims.setdefault("host", m.hostname)
+        return {"metric": m.name, "value": m.value,
+                "timestamp": m.timestamp * 1000, "dimensions": dims}
+
+    def flush(self, metrics: list[InterMetric]) -> None:
+        # group by token so vary-by-tag keys hit their own org
+        by_token: dict[str, dict] = {}
+        for m in metrics:
+            body = by_token.setdefault(self._token_for(m),
+                                       {"gauge": [], "counter": []})
+            kind = "counter" if m.type == COUNTER else "gauge"
+            body[kind].append(self._datapoint(m))
+        for token, body in by_token.items():
+            points = body["gauge"] + body["counter"]
+            for i in range(0, max(len(points), 1), self.max_per_body):
+                chunk = {
+                    "gauge": body["gauge"][i:i + self.max_per_body],
+                    "counter": body["counter"][i:i + self.max_per_body],
+                }
+                if not (chunk["gauge"] or chunk["counter"]):
+                    continue
+                self._post(token, chunk)
+
+    def _post(self, token: str, body: dict) -> None:
+        req = urllib.request.Request(
+            f"{self.endpoint}/v2/datapoint",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-SF-Token": token}, method="POST")
+        with urllib.request.urlopen(req, timeout=10.0) as r:
+            r.read()
+        self.flushed_total += len(body["gauge"]) + len(body["counter"])

@@ -1,0 +1,87 @@
+"""Splunk HEC span sink (reference sinks/splunk, 1291 LoC).
+
+Spans buffer between flushes and POST to the HTTP Event Collector
+(``/services/collector/event``) as newline-delimited JSON events with
+token auth.  The reference's sampling knob is kept: sample 1/N of
+non-error, non-indicator spans (error and indicator spans always
+ship), keyed on trace id so whole traces sample together.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.request
+
+log = logging.getLogger("veneur_tpu.sinks")
+
+
+class SplunkSpanSink:
+    name = "splunk"
+
+    def __init__(self, hec_address: str, token: str,
+                 sample_rate: int = 1, max_per_flush: int = 10000,
+                 hostname: str = ""):
+        self.hec_address = hec_address.rstrip("/")
+        self.token = token
+        self.sample_rate = max(1, int(sample_rate))
+        self.max_per_flush = max_per_flush
+        self.hostname = hostname
+        self._buf: list[dict] = []
+        self._lock = threading.Lock()
+        self.submitted = 0
+        self.skipped = 0
+
+    def start(self) -> None:
+        pass
+
+    def ingest(self, span) -> None:
+        keep = (span.error or span.indicator or
+                span.trace_id % self.sample_rate == 0)
+        if not keep:
+            self.skipped += 1
+            return
+        event = {
+            "host": self.hostname,
+            "sourcetype": "ssf_span",
+            "time": span.start_timestamp / 1e9,
+            "event": {
+                "trace_id": str(span.trace_id),
+                "id": str(span.id),
+                "parent_id": str(span.parent_id),
+                "name": span.name,
+                "service": span.service,
+                "start_timestamp": span.start_timestamp,
+                "end_timestamp": span.end_timestamp,
+                "duration_ns": span.end_timestamp -
+                span.start_timestamp,
+                "error": span.error,
+                "indicator": span.indicator,
+                "tags": dict(span.tags),
+            },
+        }
+        with self._lock:
+            if len(self._buf) < self.max_per_flush:
+                self._buf.append(event)
+            else:
+                self.skipped += 1
+
+    def flush(self) -> None:
+        with self._lock:
+            batch, self._buf = self._buf, []
+        if not batch:
+            return
+        body = "\n".join(json.dumps(e) for e in batch).encode()
+        req = urllib.request.Request(
+            f"{self.hec_address}/services/collector/event",
+            data=body,
+            headers={"Authorization": f"Splunk {self.token}",
+                     "Content-Type": "application/json"},
+            method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=10.0) as r:
+                r.read()
+            self.submitted += len(batch)
+        except OSError as e:
+            log.warning("splunk HEC flush failed: %s", e)

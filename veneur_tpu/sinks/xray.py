@@ -1,0 +1,72 @@
+"""AWS X-Ray span sink (reference sinks/xray, 668 LoC): segment JSON
+over UDP to the X-Ray daemon, ``{"format":"json","version":1}\\n``
+header per datagram, trace ids in X-Ray's ``1-<epoch8>-<24 hex>``
+form, deterministic percentage sampling on trace id.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+
+log = logging.getLogger("veneur_tpu.sinks")
+
+_HEADER = b'{"format": "json", "version": 1}\n'
+
+
+class XRaySpanSink:
+    name = "xray"
+
+    def __init__(self, daemon_address: str = "127.0.0.1:2000",
+                 sample_percentage: float = 100.0,
+                 annotation_tags: tuple[str, ...] = ()):
+        host, _, port = daemon_address.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sample_percentage = max(0.0, min(100.0,
+                                              sample_percentage))
+        self.annotation_tags = set(annotation_tags)
+        self.submitted = 0
+        self.skipped = 0
+
+    def start(self) -> None:
+        pass
+
+    @staticmethod
+    def _trace_id(span) -> str:
+        # X-Ray trace id: "1-<8 hex epoch seconds>-<24 hex random>";
+        # derive the tail from the SSF trace id so all of one trace's
+        # segments share it (reference xray.go CalculateTraceID)
+        epoch = span.start_timestamp // 1_000_000_000
+        return f"1-{epoch & 0xFFFFFFFF:08x}-{span.trace_id & ((1 << 96) - 1):024x}"
+
+    def ingest(self, span) -> None:
+        if (span.trace_id % 10000) >= self.sample_percentage * 100:
+            self.skipped += 1
+            return
+        seg = {
+            "name": (span.service or "unknown")[:200],
+            "id": f"{span.id & 0xFFFFFFFFFFFFFFFF:016x}",
+            "trace_id": self._trace_id(span),
+            "start_time": span.start_timestamp / 1e9,
+            "end_time": span.end_timestamp / 1e9,
+            "error": bool(span.error),
+            "annotations": {
+                k: v for k, v in span.tags.items()
+                if not self.annotation_tags or k in
+                self.annotation_tags},
+        }
+        if span.parent_id:
+            seg["parent_id"] = \
+                f"{span.parent_id & 0xFFFFFFFFFFFFFFFF:016x}"
+            seg["type"] = "subsegment"
+        try:
+            self._sock.sendto(_HEADER + json.dumps(seg).encode(),
+                              self._addr)
+            self.submitted += 1
+        except OSError as e:
+            log.warning("xray send failed: %s", e)
+
+    def flush(self) -> None:
+        pass
