@@ -216,3 +216,28 @@ def test_mixed_batch_with_errors_and_events(parser):
     assert proc == 2 and drop == 0
     snap = table.swap()
     assert float(np.asarray(snap.counters)[0]) == 3.0
+
+
+def test_touched_persists_across_intervals(parser):
+    """Regression: the native single-pass ingest must stamp every
+    class's interval ``touched`` marks (not just its staging dirty
+    masks) — a known-series gauge re-ingested in interval 2 via the
+    fast path used to vanish from every later flush because only the
+    per-step staging mask was set."""
+    t = MetricTable(TableConfig())
+    lines = [b"rg:5|g", b"rc:1|c", b"rt:2|ms", b"rs:m1|s"]
+    t.ingest_columns(_mk_batch(parser, lines))
+    s1 = t.swap()
+    assert s1.gauge_touched[:1].all() and s1.counter_touched[:1].all()
+    # interval 2: same series, fast path again (keys now known -> no
+    # miss-resolution slow path to mask the bug)
+    lines2 = [b"rg:7|g", b"rc:2|c", b"rt:3|ms", b"rs:m2|s"]
+    t.ingest_columns(_mk_batch(parser, lines2))
+    s2 = t.swap()
+    assert s2.gauge_touched[:1].all(), "gauge touched lost in interval 2"
+    assert s2.counter_touched[:1].all()
+    assert s2.histo_touched[:1].all()
+    assert s2.set_touched[:1].all()
+    assert float(np.asarray(s2.gauges)[0]) == 7.0
+    # last_gen advanced -> compaction at gen 2 keeps the series
+    assert int(t.gauge_idx.last_gen[0]) == 1

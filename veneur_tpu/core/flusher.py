@@ -31,6 +31,7 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass, field
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -41,6 +42,49 @@ from veneur_tpu.protocol import dogstatsd as dsd
 
 DEFAULT_AGGREGATES = ("min", "max", "count")
 DEFAULT_PERCENTILES = (0.5, 0.75, 0.99)
+
+
+@jax.jit
+def _combine_stats(stats, imp):
+    """Device-side combine of the local-sample and imported stat
+    planes (weight/sum/rsum add, min min, max max), so the host does
+    one batched readback instead of ping-ponging stats -> host ->
+    device (each leg pays the tunnel's latency)."""
+    return jnp.stack([
+        stats[:, segment.STAT_WEIGHT] + imp[:, segment.STAT_WEIGHT],
+        jnp.minimum(stats[:, segment.STAT_MIN], imp[:, segment.STAT_MIN]),
+        jnp.maximum(stats[:, segment.STAT_MAX], imp[:, segment.STAT_MAX]),
+        stats[:, segment.STAT_SUM] + imp[:, segment.STAT_SUM],
+        stats[:, segment.STAT_RSUM] + imp[:, segment.STAT_RSUM],
+    ], axis=1)
+
+
+@jax.jit
+def _histo_readout(stats, imp, means, weights, qs):
+    """_combine_stats plus the per-row quantile kernel in one
+    dispatch — used only when someone will actually emit quantiles
+    (the batched sort over every digest row is not free)."""
+    comb = _combine_stats(stats, imp)
+    qvals = tdigest._quantile(means, weights, qs,
+                              comb[:, segment.STAT_MIN],
+                              comb[:, segment.STAT_MAX])
+    return comb, qvals
+
+
+@jax.jit
+def _gather_rows(plane, idx):
+    """Compact selected rows on device before readback — d2h over the
+    tunnel is ~10 MB/s, so reading a full register/centroid plane to
+    forward a handful of touched rows would dominate the flush."""
+    return plane[idx]
+
+
+def _pad_idx(rows: list[int]) -> tuple[jnp.ndarray, int]:
+    from veneur_tpu.core.table import _bucket_len
+    n = len(rows)
+    idx = np.zeros(_bucket_len(n, wide=True), np.int32)
+    idx[:n] = rows
+    return jnp.asarray(idx), n
 
 
 @dataclass
@@ -88,12 +132,79 @@ class Flusher:
     def flush(self, snap: Snapshot, now: int | None = None) -> FlushResult:
         ts = int(now if now is not None else time.time())
         res = FlushResult()
-        self._flush_counters(snap, ts, res)
-        self._flush_gauges(snap, ts, res)
-        self._flush_histos(snap, ts, res)
-        self._flush_sets(snap, ts, res)
+        pre = self._prefetch(snap)
+        self._flush_counters(snap, ts, res, pre)
+        self._flush_gauges(snap, ts, res, pre)
+        self._flush_histos(snap, ts, res, pre)
+        self._flush_sets(snap, ts, res, pre)
         res.tally["overflow"] = sum(snap.overflow.values())
         return res
+
+    # ------------------------------------------------------------------
+
+    def _prefetch(self, snap: Snapshot) -> dict:
+        """Launch every device computation the flush needs, then pull
+        all results to host in ONE pipelined jax.device_get — over the
+        tunnel each separate synchronous readback pays ~90ms latency,
+        but async copies overlap to a single latency."""
+        devs: dict = {}
+        pre: dict = {}
+        if snap.counter_meta and snap.counter_touched.any():
+            devs["counters"] = snap.counters
+        if snap.gauge_meta and snap.gauge_touched.any():
+            devs["gauges"] = snap.gauges
+
+        histo_rows = np.nonzero(
+            snap.histo_touched[:len(snap.histo_meta)])[0]
+        pre["histo_rows"] = histo_rows
+        if len(histo_rows):
+            all_pcts = tuple(self.percentiles) + (
+                (0.5,) if "median" in self.aggregates else ())
+            pre["all_pcts"] = all_pcts
+            emit_pcts = not self.is_local
+            any_local_scope = any(
+                snap.histo_meta[r].scope == dsd.SCOPE_LOCAL
+                for r in histo_rows)
+            need_q = bool(all_pcts) and (
+                emit_pcts or "median" in self.aggregates or
+                any_local_scope)
+            if need_q:
+                qs = np.asarray(all_pcts, np.float32)
+                comb, qvals = _histo_readout(
+                    snap.histo_stats, snap.histo_import_stats,
+                    snap.histo_means, snap.histo_weights,
+                    jnp.asarray(qs))
+                devs["qvals"] = qvals
+            else:
+                comb = _combine_stats(snap.histo_stats,
+                                      snap.histo_import_stats)
+            devs["stats"] = snap.histo_stats
+            devs["comb"] = comb
+            fwd = [int(r) for r in histo_rows
+                   if self._forwardable(snap.histo_meta[r], always=True)]
+            pre["histo_fwd"] = fwd
+            if fwd:
+                idx, _ = _pad_idx(fwd)
+                devs["fwd_means"] = _gather_rows(snap.histo_means, idx)
+                devs["fwd_weights"] = _gather_rows(snap.histo_weights,
+                                                   idx)
+
+        set_rows = np.nonzero(snap.set_touched[:len(snap.set_meta)])[0]
+        pre["set_rows"] = set_rows
+        if len(set_rows):
+            fwd = [int(r) for r in set_rows
+                   if self._forwardable(snap.set_meta[r], always=True)]
+            pre["set_fwd"] = fwd
+            if fwd:
+                idx, _ = _pad_idx(fwd)
+                devs["fwd_regs"] = _gather_rows(snap.hll_regs, idx)
+            fwd_set = set(fwd)
+            if any(int(r) not in fwd_set and
+                   self._emit_local(snap.set_meta[r])
+                   for r in set_rows):
+                devs["ests"] = hll.estimate(snap.hll_regs)
+        pre.update(jax.device_get(devs))
+        return pre
 
     # ------------------------------------------------------------------
 
@@ -111,14 +222,14 @@ class Flusher:
                               tags=meta.tags + self.common_tags,
                               type=mtype, hostname=self.hostname)
 
-    def _flush_counters(self, snap: Snapshot, ts: int,
-                        res: FlushResult) -> None:
-        if not snap.counter_meta:
+    def _flush_counters(self, snap: Snapshot, ts: int, res: FlushResult,
+                        pre: dict) -> None:
+        vals = pre.get("counters")
+        if vals is None:
             return
-        vals = np.asarray(snap.counters)
-        for row, meta in enumerate(snap.counter_meta):
-            if not snap.counter_touched[row]:
-                continue
+        for row in np.nonzero(
+                snap.counter_touched[:len(snap.counter_meta)])[0]:
+            meta = snap.counter_meta[row]
             v = float(vals[row])
             if self._forwardable(meta, always=False):
                 res.forward.append(ForwardRow(meta, "counter", value=v))
@@ -127,14 +238,14 @@ class Flusher:
                     self._mk(meta.name, ts, v, meta, im.COUNTER))
         res.tally["counters"] = int(snap.counter_touched.sum())
 
-    def _flush_gauges(self, snap: Snapshot, ts: int,
-                      res: FlushResult) -> None:
-        if not snap.gauge_meta:
+    def _flush_gauges(self, snap: Snapshot, ts: int, res: FlushResult,
+                      pre: dict) -> None:
+        vals = pre.get("gauges")
+        if vals is None:
             return
-        vals = np.asarray(snap.gauges)
-        for row, meta in enumerate(snap.gauge_meta):
-            if not snap.gauge_touched[row]:
-                continue
+        for row in np.nonzero(
+                snap.gauge_touched[:len(snap.gauge_meta)])[0]:
+            meta = snap.gauge_meta[row]
             v = float(vals[row])
             if self._forwardable(meta, always=False):
                 res.forward.append(ForwardRow(meta, "gauge", value=v))
@@ -143,66 +254,37 @@ class Flusher:
                     self._mk(meta.name, ts, v, meta, im.GAUGE))
         res.tally["gauges"] = int(snap.gauge_touched.sum())
 
-    def _flush_histos(self, snap: Snapshot, ts: int,
-                      res: FlushResult) -> None:
-        if not snap.histo_meta:
+    def _flush_histos(self, snap: Snapshot, ts: int, res: FlushResult,
+                      pre: dict) -> None:
+        rows = pre["histo_rows"]
+        if not len(rows):
             return
         # Two stat planes: ``stats`` holds aggregates of raw samples
         # ingested by THIS node ("Local*" in the reference,
         # samplers/samplers.go:484); ``imp`` holds merged forwarded stat
-        # rows.  Aggregates for mixed-scope rows come only from the
-        # local plane (reference gates on LocalWeight/LocalMin/LocalMax,
-        # samplers.go:530-621 — emitting them from merged state would
-        # double-count against the local tier's own emission); rows
-        # flushed with global=true use the combined plane, the analogue
-        # of reading min/max/sum off the merged digest itself.
-        stats = np.asarray(snap.histo_stats)
-        imp = np.asarray(snap.histo_import_stats)
-        comb = np.empty_like(stats)
-        comb[:, segment.STAT_WEIGHT] = (stats[:, segment.STAT_WEIGHT] +
-                                        imp[:, segment.STAT_WEIGHT])
-        comb[:, segment.STAT_MIN] = np.minimum(stats[:, segment.STAT_MIN],
-                                               imp[:, segment.STAT_MIN])
-        comb[:, segment.STAT_MAX] = np.maximum(stats[:, segment.STAT_MAX],
-                                               imp[:, segment.STAT_MAX])
-        comb[:, segment.STAT_SUM] = (stats[:, segment.STAT_SUM] +
-                                     imp[:, segment.STAT_SUM])
-        comb[:, segment.STAT_RSUM] = (stats[:, segment.STAT_RSUM] +
-                                      imp[:, segment.STAT_RSUM])
-        mins = jnp.asarray(comb[:, segment.STAT_MIN])
-        maxs = jnp.asarray(comb[:, segment.STAT_MAX])
+        # rows, pre-combined on device into ``comb``.  Aggregates for
+        # mixed-scope rows come only from the local plane (reference
+        # gates on LocalWeight/LocalMin/LocalMax, samplers.go:530-621 —
+        # emitting them from merged state would double-count against
+        # the local tier's own emission); rows flushed with global=true
+        # use the combined plane, the analogue of reading min/max/sum
+        # off the merged digest itself.
+        stats = pre["stats"]
+        comb = pre["comb"]
+        qvals = pre.get("qvals")
+        all_pcts = pre["all_pcts"]
         emit_pcts = not self.is_local
-        all_pcts = tuple(self.percentiles) + (
-            (0.5,) if "median" in self.aggregates else ())
-        # Quantiles are only needed when someone will emit them — on
-        # global nodes, for the median aggregate, or for local-scope
-        # histos on local nodes.  Skip the kernel + readback otherwise.
-        any_local_scope = any(
-            snap.histo_touched[r] and m.scope == dsd.SCOPE_LOCAL
-            for r, m in enumerate(snap.histo_meta))
-        need_q = bool(all_pcts) and (
-            emit_pcts or "median" in self.aggregates or any_local_scope)
-        qvals = None
-        if need_q:
-            qvals = np.asarray(tdigest.quantile(
-                snap.histo_means, snap.histo_weights,
-                jnp.asarray(np.asarray(all_pcts, np.float32)),
-                mins, maxs))
-        means_np = weights_np = None
+        fwd_pos = {r: i for i, r in enumerate(pre["histo_fwd"])}
 
-        for row, meta in enumerate(snap.histo_meta):
-            if not snap.histo_touched[row]:
-                continue
+        for row in rows:
+            meta = snap.histo_meta[row]
             st = stats[row]
-            forward = self._forwardable(meta, always=True)
-            if forward:
-                if means_np is None:
-                    means_np = np.asarray(snap.histo_means)
-                    weights_np = np.asarray(snap.histo_weights)
+            pos = fwd_pos.get(int(row))
+            if pos is not None:
                 res.forward.append(ForwardRow(
                     meta, "histo", stats=st.copy(),
-                    means=means_np[row].copy(),
-                    weights=weights_np[row].copy()))
+                    means=pre["fwd_means"][pos].copy(),
+                    weights=pre["fwd_weights"][pos].copy()))
             # mixed-scope histos emit local aggregates even while their
             # digest forwards; global-only histos emit nothing locally
             if meta.scope == dsd.SCOPE_GLOBAL and self.is_local:
@@ -264,23 +346,20 @@ class Flusher:
                     f"{meta.name}.{_percentile_suffix(p)}", ts,
                     float(qvals[row, pi]), meta, im.GAUGE))
 
-    def _flush_sets(self, snap: Snapshot, ts: int,
-                    res: FlushResult) -> None:
-        if not snap.set_meta:
+    def _flush_sets(self, snap: Snapshot, ts: int, res: FlushResult,
+                    pre: dict) -> None:
+        rows = pre["set_rows"]
+        if not len(rows):
             return
-        regs_np = None
-        ests = None
-        for row, meta in enumerate(snap.set_meta):
-            if not snap.set_touched[row]:
-                continue
-            if self._forwardable(meta, always=True):
-                if regs_np is None:
-                    regs_np = np.asarray(snap.hll_regs)
-                res.forward.append(ForwardRow(meta, "set",
-                                              regs=regs_np[row].copy()))
+        ests = pre.get("ests")
+        fwd_pos = {r: i for i, r in enumerate(pre.get("set_fwd", ()))}
+        for row in rows:
+            meta = snap.set_meta[row]
+            pos = fwd_pos.get(int(row))
+            if pos is not None:
+                res.forward.append(ForwardRow(
+                    meta, "set", regs=pre["fwd_regs"][pos].copy()))
             elif self._emit_local(meta):
-                if ests is None:
-                    ests = np.asarray(hll.estimate(snap.hll_regs))
                 res.metrics.append(self._mk(
                     meta.name, ts, float(round(ests[row])), meta,
                     im.GAUGE))

@@ -37,15 +37,22 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from veneur_tpu import native
 from veneur_tpu.ops import hll, segment, tdigest
 from veneur_tpu.protocol import columnar, dogstatsd as dsd
 from veneur_tpu.utils import hashing, intern
 
-# jitted, state-donating update steps
-_counter_step = jax.jit(segment.counter_update, donate_argnums=0)
-_gauge_step = jax.jit(segment.gauge_update, donate_argnums=0)
+# jitted, state-donating update steps.  Counters and gauges take
+# host-precombined dense vectors (np.bincount / last-write collapse):
+# over the tunnel-attached TPU the h2d link is the bottleneck, so a
+# batch ships as R floats instead of 12 bytes/sample.
+_counter_dense_step = jax.jit(segment.counter_dense_update,
+                              donate_argnums=0)
+_gauge_dense_step = jax.jit(segment.gauge_dense_update, donate_argnums=0)
 _histo_stats_step = jax.jit(segment.histo_stats_update, donate_argnums=0)
-_hll_step = jax.jit(hll.insert, donate_argnums=0)
+_histo_stats_step_unit = jax.jit(segment.histo_stats_update_unit,
+                                 donate_argnums=0)
+_hll_step_packed = jax.jit(hll.insert_packed, donate_argnums=0)
 # global-tier merge steps (forwarded partial state; duplicates within a
 # batch reduce correctly because every column is an associative scatter)
 _histo_stats_merge = jax.jit(segment.merge_histo_stats, donate_argnums=0)
@@ -56,10 +63,17 @@ _MIN_BUCKET_WIDE = 8  # for batches whose rows are whole planes
 
 
 def _bucket_len(n: int, wide: bool = False) -> int:
+    """Pad-to bucket: powers of two plus 1.5x half-steps, capping pad
+    waste at 33% (a pure pow-2 ladder wastes up to 100%, which is real
+    h2d bytes on multi-MB timer batches) while keeping the compile
+    cache small."""
     b = _MIN_BUCKET_WIDE if wide else _MIN_BUCKET
-    while b < n:
+    while True:
+        if n <= b:
+            return b
+        if n <= b + b // 2:
+            return b + b // 2
         b *= 2
-    return b
 
 
 def _pad_np(arr: np.ndarray, length: int, fill) -> np.ndarray:
@@ -216,19 +230,33 @@ class MetricTable:
         self.histo_idx = _ClassIndex(c.histo_rows)
         self.set_idx = _ClassIndex(c.set_rows)
 
-        self._counter_stage = _Staging()
-        self._gauge_stage = _Staging()
+        # Counters and gauges stage as DENSE per-row host buffers —
+        # every ingest path combines into them directly (counter merge
+        # is associative add, gauge merge is last-write), so a whole
+        # interval's samples ship as R values however many arrived.
+        # f64 accumulator: repeated f32 adds of a hot counter would
+        # drift; one f32 round-off happens at ship time.
+        self._counter_dense = np.zeros(c.counter_rows, np.float64)
+        self._gauge_dense = np.zeros(c.gauge_rows, np.float32)
+        self._gauge_mask = np.zeros(c.gauge_rows, np.uint8)
+        self._counter_dirty = False
+        self._gauge_dirty = False
         self._histo_stage = _Staging()
         self._set_rows: list[int] = []
         self._set_members: list[bytes] = []
         # fast-path set staging: positions already hashed (columnar
         # ingest hashes members natively; slow path stores raw bytes)
+        # packed (idx << 6) | rank per member — see hll.insert_packed
         self._set_pos_rows: list[np.ndarray] = []
-        self._set_pos_idx: list[np.ndarray] = []
-        self._set_pos_rank: list[np.ndarray] = []
+        self._set_pos: list[np.ndarray] = []
         # fast-path series index: identity hash -> row (see
-        # utils.intern); rebuilt after compaction renumbers rows
-        self.key_index = intern.HashIndex()
+        # utils.intern); rebuilt after compaction renumbers rows.
+        # Backed by the C++ table when the native library is available
+        # so vtpu_ingest can probe it in its single combine pass.
+        self._lib = native.load()
+        self.key_index = (intern.NativeHashIndex(self._lib)
+                          if self._lib is not None
+                          else intern.HashIndex())
 
         # global-tier import staging (merge of forwarded state; the
         # receive half of reference worker.go:438 ImportMetricGRPC).
@@ -278,14 +306,17 @@ class MetricTable:
                                           s.type, self.gen)
             if row is None:
                 return False
-            self._counter_stage.append([row], [s.value], [weight])
+            self._counter_dense[row] += s.value * weight
+            self._counter_dirty = True
             self._staged_n += 1
         elif s.type == dsd.GAUGE:
             row = self.gauge_idx.lookup(key, s.name, s.tags, s.scope,
                                         s.type, self.gen)
             if row is None:
                 return False
-            self._gauge_stage.append([row], [s.value])
+            self._gauge_dense[row] = s.value
+            self._gauge_mask[row] = 1
+            self._gauge_dirty = True
             self._staged_n += 1
         elif s.type in (dsd.TIMER, dsd.HISTOGRAM):
             row = self.histo_idx.lookup(key, s.name, s.tags, s.scope,
@@ -356,9 +387,14 @@ class MetricTable:
                        ) -> tuple[int, int]:
         """Batch ingest of a parsed buffer's metric lines (type codes
         0-4; events/service-checks/errors are the caller's per-line
-        business).  Returns (processed, dropped).  The whole batch is a
-        handful of numpy passes + list appends — no per-sample Python.
+        business).  Returns (processed, dropped).  With the native
+        library this is ONE C++ pass (probe + combine); the numpy
+        fallback is a handful of vectorized passes — either way no
+        per-sample Python.
         """
+        if self._lib is not None and isinstance(
+                self.key_index, intern.NativeHashIndex):
+            return self._ingest_columns_native(pb)
         tc = pb.type_code
         sel = np.nonzero(tc <= columnar.CODE_SET)[0]
         if len(sel) == 0:
@@ -384,14 +420,19 @@ class MetricTable:
         cmask = (codes == columnar.CODE_COUNTER) & live
         if cmask.any():
             r = rows[cmask]
-            # counter kernel multiplies value*weight on device
-            self._counter_stage.append(r, vals[cmask], wts[cmask])
+            self._counter_dense += np.bincount(
+                r, weights=vals[cmask] * wts[cmask],
+                minlength=self.config.counter_rows)
+            self._counter_dirty = True
             self.counter_idx.touch_rows(r, self.gen)
 
         gmask = (codes == columnar.CODE_GAUGE) & live
         if gmask.any():
             r = rows[gmask]
-            self._gauge_stage.append(r, vals[gmask])
+            # fancy assignment applies in index order: last write wins
+            self._gauge_dense[r] = vals[gmask]
+            self._gauge_mask[r] = 1
+            self._gauge_dirty = True
             self.gauge_idx.touch_rows(r, self.gen)
 
         hmask = ((codes == columnar.CODE_TIMER) |
@@ -406,11 +447,98 @@ class MetricTable:
             r = rows[smask]
             idx, rank = hashing.hll_position(pb.member_hash[sel][smask])
             self._set_pos_rows.append(np.asarray(r, np.int32))
-            self._set_pos_idx.append(idx)
-            self._set_pos_rank.append(rank)
+            self._set_pos.append(hll.pack_positions(idx, rank))
             self.set_idx.touch_rows(r, self.gen)
 
         processed = len(sel)
+        self._staged_n += processed - dropped
+        return processed, dropped
+
+    def _ingest_columns_native(self, pb: columnar.ParsedBatch
+                               ) -> tuple[int, int]:
+        """Single-pass C++ ingest (vtpu_ingest): probe the native
+        identity index and combine into dense counter/gauge buffers and
+        histo/set append columns, all in one cache-friendly loop.
+        Python only resolves never-seen keys, then re-runs the pass
+        over just the recorded miss lines."""
+        import ctypes as ct
+        n = pb.n
+        if n == 0:
+            return 0, 0
+        lib = self._lib
+        u8p = ct.POINTER(ct.c_uint8)
+        u64p = ct.POINTER(ct.c_uint64)
+        f32p = ct.POINTER(ct.c_float)
+        f64p = ct.POINTER(ct.c_double)
+        i32p = ct.POINTER(ct.c_int32)
+        i64p = ct.POINTER(ct.c_int64)
+
+        hr = np.empty(n, np.int32)
+        hv = np.empty(n, np.float32)
+        hw = np.empty(n, np.float32)
+        sr = np.empty(n, np.int32)
+        sp = np.empty(n, np.int32)
+        miss = np.empty(n, np.int64)
+        meta = np.zeros(11, np.int64)
+
+        def run(subset_n: int) -> None:
+            lib.vtpu_ingest(
+                self.key_index.handle,
+                pb.key_hash.ctypes.data_as(u64p),
+                pb.type_code.ctypes.data_as(u8p),
+                pb.value.ctypes.data_as(f64p),
+                pb.member_hash.ctypes.data_as(u64p),
+                pb.weight.ctypes.data_as(f32p),
+                n,
+                miss.ctypes.data_as(i64p), subset_n,
+                hashing.HLL_P,
+                self._counter_dense.ctypes.data_as(f64p),
+                self.counter_idx.touched.view(np.uint8)
+                    .ctypes.data_as(u8p),
+                self._gauge_dense.ctypes.data_as(f32p),
+                self._gauge_mask.ctypes.data_as(u8p),
+                self.gauge_idx.touched.view(np.uint8)
+                    .ctypes.data_as(u8p),
+                hr.ctypes.data_as(i32p),
+                hv.ctypes.data_as(f32p),
+                hw.ctypes.data_as(f32p),
+                self.histo_idx.touched.view(np.uint8)
+                    .ctypes.data_as(u8p),
+                sr.ctypes.data_as(i32p),
+                sp.ctypes.data_as(i32p),
+                self.set_idx.touched.view(np.uint8)
+                    .ctypes.data_as(u8p),
+                miss.ctypes.data_as(i64p),
+                meta.ctypes.data_as(i64p))
+
+        run(-1)
+        n_miss = int(meta[2])
+        if n_miss:
+            miss_lines = miss[:n_miss].copy()
+            self._resolve_misses(pb, miss_lines,
+                                 pb.key_hash[miss_lines])
+            # second pass over just the miss lines (resolved keys now
+            # hit; unparseable ones are DROPPED and counted)
+            run(n_miss)
+
+        processed = int(meta[3])
+        dropped = int(meta[6:11].sum())
+        if dropped:
+            self.counter_idx.overflow += int(meta[6])
+            self.gauge_idx.overflow += int(meta[7])
+            self.histo_idx.overflow += int(meta[8] + meta[9])
+            self.set_idx.overflow += int(meta[10])
+        if meta[4]:
+            self._counter_dirty = True
+        if meta[5]:
+            self._gauge_dirty = True
+        hn = int(meta[0])
+        if hn:
+            self._histo_stage.append(hr[:hn], hv[:hn], hw[:hn])
+        sn = int(meta[1])
+        if sn:
+            self._set_pos_rows.append(sr[:sn])
+            self._set_pos.append(sp[:sn])
         self._staged_n += processed - dropped
         return processed, dropped
 
@@ -430,7 +558,8 @@ class MetricTable:
                                       dsd.COUNTER, self.gen)
         if row is None:
             return False
-        self._counter_stage.append([row], [value], [1.0])
+        self._counter_dense[row] += value
+        self._counter_dirty = True
         self._staged_n += 1
         return True
 
@@ -441,7 +570,9 @@ class MetricTable:
                                     dsd.GAUGE, self.gen)
         if row is None:
             return False
-        self._gauge_stage.append([row], [value])
+        self._gauge_dense[row] = value
+        self._gauge_mask[row] = 1
+        self._gauge_dirty = True
         self._staged_n += 1
         return True
 
@@ -506,27 +637,30 @@ class MetricTable:
     # device step
 
     def device_step(self) -> None:
-        """Push all staged samples to the device as batched updates."""
+        """Push all staged samples to the device as batched updates.
+
+        Counters and gauges are pre-combined on host into dense per-row
+        vectors (duplicate rows collapse — legal because counter merge
+        is associative addition and gauge merge is last-write), so the
+        h2d transfer is O(rows) not O(samples).  Histo values must ship
+        per-sample (the digest needs the distribution); sets ship 8
+        packed bytes per member."""
         c = self.config
         self._staged_n = 0
-        batch = self._counter_stage.take()
-        if batch is not None:
-            rows, vals, wts = batch
-            b = _bucket_len(len(rows))
-            self.counters = _counter_step(
-                self.counters,
-                jnp.asarray(_pad_np(rows, b, c.counter_rows)),
-                jnp.asarray(_pad_np(vals, b, 0.0)),
-                jnp.asarray(_pad_np(wts, b, 0.0)))
+        if self._counter_dirty:
+            self.counters = _counter_dense_step(
+                self.counters, self._counter_dense.astype(np.float32))
+            self._counter_dense.fill(0.0)
+            self._counter_dirty = False
 
-        batch = self._gauge_stage.take()
-        if batch is not None:
-            rows, vals, _ = batch
-            b = _bucket_len(len(rows))
-            self.gauges = _gauge_step(
-                self.gauges,
-                jnp.asarray(_pad_np(rows, b, c.gauge_rows)),
-                jnp.asarray(_pad_np(vals, b, 0.0)))
+        if self._gauge_dirty:
+            # .copy(): the h2d transfer is async and the staging buffer
+            # is mutated by the very next ingest
+            self.gauges = _gauge_dense_step(
+                self.gauges, self._gauge_dense.copy(),
+                self._gauge_mask.astype(bool))
+            self._gauge_mask.fill(0)
+            self._gauge_dirty = False
 
         batch = self._histo_stage.take()
         if batch is not None:
@@ -537,30 +671,23 @@ class MetricTable:
             self._histo_device_step(*batch, with_stats=False)
 
         if self._set_rows or self._set_pos_rows:
-            parts_rows, parts_idx, parts_rank = ([], [], [])
+            parts_rows, parts_pos = [], []
             if self._set_rows:
                 idx, rank = hashing.hash_members(self._set_members)
                 parts_rows.append(np.asarray(self._set_rows, np.int32))
-                parts_idx.append(idx.astype(np.int32))
-                parts_rank.append(rank.astype(np.int32))
+                parts_pos.append(hll.pack_positions(idx, rank))
                 self._set_rows, self._set_members = [], []
             if self._set_pos_rows:
                 parts_rows.extend(self._set_pos_rows)
-                parts_idx.extend(np.asarray(a, np.int32)
-                                 for a in self._set_pos_idx)
-                parts_rank.extend(np.asarray(a, np.int32)
-                                  for a in self._set_pos_rank)
-                self._set_pos_rows, self._set_pos_idx, \
-                    self._set_pos_rank = [], [], []
+                parts_pos.extend(self._set_pos)
+                self._set_pos_rows, self._set_pos = [], []
             rows = np.concatenate(parts_rows)
-            idx = np.concatenate(parts_idx)
-            rank = np.concatenate(parts_rank)
+            pos = np.concatenate(parts_pos)
             b = _bucket_len(len(rows))
-            self.hll_regs = _hll_step(
+            self.hll_regs = _hll_step_packed(
                 self.hll_regs,
                 jnp.asarray(_pad_np(rows, b, c.set_rows)),
-                jnp.asarray(_pad_np(idx, b, 0)),
-                jnp.asarray(_pad_np(rank, b, 0)))
+                jnp.asarray(_pad_np(pos, b, 0)))
 
         if self._stats_import_rows:
             rows = np.asarray(self._stats_import_rows, np.int32)
@@ -600,15 +727,32 @@ class MetricTable:
         within-row rank (vectorized on host).  ``with_stats=False`` for
         imported centroids, whose stats arrive via the stat-row path."""
         c = self.config
+        # unit-weight batches (no client sample-rate — the common case)
+        # skip shipping the weights column entirely
+        unit = bool(np.all(wts == 1.0))
         b = _bucket_len(len(rows))
+        rows_dev = jnp.asarray(_pad_np(rows, b, c.histo_rows))
+        vals_dev = jnp.asarray(_pad_np(vals, b, 0.0))
         if with_stats:
-            self.histo_stats = _histo_stats_step(
-                self.histo_stats,
-                jnp.asarray(_pad_np(rows, b, c.histo_rows)),
-                jnp.asarray(_pad_np(vals, b, 0.0)),
-                jnp.asarray(_pad_np(wts, b, 0.0)))
+            if unit:
+                self.histo_stats = _histo_stats_step_unit(
+                    self.histo_stats, rows_dev, vals_dev)
+            else:
+                self.histo_stats = _histo_stats_step(
+                    self.histo_stats, rows_dev, vals_dev,
+                    jnp.asarray(_pad_np(wts, b, 0.0)))
 
-        # within-row rank -> chunk id
+        # densify drops samples past ``histo_slots`` per row per call,
+        # so batches where some row exceeds it must be split by
+        # within-row rank.  The rank computation needs a host argsort
+        # (~1s for 10M rows on one core) — skip it when the per-row max
+        # (one cheap bincount) already fits.
+        counts = np.bincount(rows) if len(rows) else np.zeros(1, np.int64)
+        if int(counts.max(initial=0)) <= c.histo_slots:
+            self._digest_merge(rows, vals, wts, unit,
+                               rows_dev=rows_dev, vals_dev=vals_dev)
+            return
+
         order = np.argsort(rows, kind="stable")
         sorted_rows = rows[order]
         first = np.ones(len(rows), dtype=bool)
@@ -620,12 +764,25 @@ class MetricTable:
         n_chunks = int(chunk_of.max()) + 1 if len(rows) else 0
         for ci in range(n_chunks):
             sel = order[chunk_of == ci]
-            b = _bucket_len(len(sel))
+            self._digest_merge(rows[sel], vals[sel], wts[sel], unit)
+
+    def _digest_merge(self, rows, vals, wts, unit,
+                      rows_dev=None, vals_dev=None) -> None:
+        c = self.config
+        b = _bucket_len(len(rows))
+        if rows_dev is None:
+            rows_dev = jnp.asarray(_pad_np(rows, b, c.histo_rows))
+            vals_dev = jnp.asarray(_pad_np(vals, b, 0.0))
+        if unit:
+            self.histo_means, self.histo_weights = \
+                tdigest.add_samples_unit(
+                    self.histo_means, self.histo_weights, rows_dev,
+                    vals_dev, slots=min(c.histo_slots, b),
+                    compression=c.compression)
+        else:
             self.histo_means, self.histo_weights = tdigest.add_samples(
-                self.histo_means, self.histo_weights,
-                jnp.asarray(_pad_np(rows[sel], b, c.histo_rows)),
-                jnp.asarray(_pad_np(vals[sel], b, 0.0)),
-                jnp.asarray(_pad_np(wts[sel], b, 0.0)),
+                self.histo_means, self.histo_weights, rows_dev,
+                vals_dev, jnp.asarray(_pad_np(wts, b, 0.0)),
                 slots=min(c.histo_slots, b),
                 compression=c.compression)
 
@@ -636,6 +793,12 @@ class MetricTable:
         """End the interval: push remaining staging, hand the device
         arrays to the caller, re-seed fresh state, maybe compact."""
         self.device_step()
+        # the native ingest marks touched[] but defers last_gen (gen is
+        # constant within an interval, so one vectorized stamp here is
+        # equivalent to stamping per batch)
+        for idx in (self.counter_idx, self.gauge_idx, self.histo_idx,
+                    self.set_idx):
+            idx.last_gen[idx.touched] = self.gen
         snap = Snapshot(
             gen=self.gen,
             counters=self.counters,

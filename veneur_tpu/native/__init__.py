@@ -31,8 +31,11 @@ _tried = False
 def _build() -> bool:
     os.makedirs(_BUILD_DIR, exist_ok=True)
     tmp = _SO + f".tmp.{os.getpid()}"
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-           "-o", tmp, _SRC]
+    # -mtune (not -march): tuned for this host but ISA-portable — the
+    # cached .so may be reused on a different CPU (image builds) where
+    # -march=native code would SIGILL past the mtime freshness check
+    cmd = ["g++", "-O3", "-mtune=native", "-shared", "-fPIC",
+           "-std=c++17", "-o", tmp, _SRC]
     try:
         subprocess.run(cmd, check=True, capture_output=True,
                        timeout=120)
@@ -72,5 +75,26 @@ def load() -> ctypes.CDLL | None:
             u8p, i64, u64p, u8p, f64p, u64p, f32p, u8p, i64p, i32p, i64]
         lib.vtpu_hash_members.restype = None
         lib.vtpu_hash_members.argtypes = [u8p, i64p, i64p, i64, u64p]
+        vp = ctypes.c_void_p
+        lib.vtpu_index_new.restype = vp
+        lib.vtpu_index_new.argtypes = [i64]
+        lib.vtpu_index_free.restype = None
+        lib.vtpu_index_free.argtypes = [vp]
+        lib.vtpu_index_clear.restype = None
+        lib.vtpu_index_clear.argtypes = [vp]
+        lib.vtpu_index_insert.restype = None
+        lib.vtpu_index_insert.argtypes = [vp, ctypes.c_uint64,
+                                          ctypes.c_int32]
+        lib.vtpu_index_count.restype = i64
+        lib.vtpu_index_count.argtypes = [vp]
+        lib.vtpu_index_lookup.restype = None
+        lib.vtpu_index_lookup.argtypes = [vp, u64p, i64, i32p]
+        lib.vtpu_ingest.restype = None
+        lib.vtpu_ingest.argtypes = [
+            vp, u64p, u8p, f64p, u64p, f32p, i64, i64p, i64, i64,
+            f64p, u8p, f32p, u8p, u8p,
+            i32p, f32p, f32p, u8p,
+            i32p, i32p, u8p,
+            i64p, i64p]
         _lib = lib
         return _lib

@@ -131,8 +131,9 @@ int64_t vtpu_parse_batch(
   int64_t out = 0;
   int64_t pos = 0;
   while (pos < len && out < max_lines) {
-    int64_t eol = pos;
-    while (eol < len && buf[eol] != '\n') eol++;
+    const uint8_t* nl =
+        (const uint8_t*)memchr(buf + pos, '\n', (size_t)(len - pos));
+    const int64_t eol = nl ? (int64_t)(nl - buf) : len;
     const uint8_t* line = buf + pos;
     int64_t n = eol - pos;
     int64_t start = pos;
@@ -160,15 +161,12 @@ int64_t vtpu_parse_batch(
     }
 
     // name:value|type[|@rate][|#tags]
-    int64_t colon = -1;
-    for (int64_t i = 0; i < n; i++) {
-      if (line[i] == ':') { colon = i; break; }
-    }
+    const uint8_t* cp = (const uint8_t*)memchr(line, ':', (size_t)n);
+    const int64_t colon = cp ? (int64_t)(cp - line) : -1;
     if (colon <= 0) { type_code[out++] = T_ERROR; continue; }
-    int64_t pipe1 = -1;
-    for (int64_t i = colon + 1; i < n; i++) {
-      if (line[i] == '|') { pipe1 = i; break; }
-    }
+    const uint8_t* pp = (const uint8_t*)memchr(
+        line + colon + 1, '|', (size_t)(n - colon - 1));
+    const int64_t pipe1 = pp ? (int64_t)(pp - line) : -1;
     if (pipe1 < 0 || pipe1 == colon + 1) {
       type_code[out++] = T_ERROR;
       continue;
@@ -312,6 +310,200 @@ void vtpu_hash_members(const uint8_t* buf, const int64_t* offs,
   for (int64_t i = 0; i < n; i++) {
     out[i] = fmix64(fnv1a64(kFnvOffset, buf + offs[i], lens[i]));
   }
+}
+
+// ---------------------------------------------------------------------
+// Identity index: open-addressing u64 key -> i32 row, the native twin
+// of utils/intern.HashIndex (same sentinels: -1 missing, -2 dropped;
+// key 0 aliased so the empty-slot sentinel stays unambiguous).  Owned
+// by C++ so the per-batch lookup+combine below runs without crossing
+// back into Python per probe round.
+
+struct VtpuIndex {
+  uint64_t* keys;
+  int32_t* vals;
+  int64_t cap;  // power of two
+  int64_t count;
+};
+
+static constexpr uint64_t kZeroAlias = 0x9E3779B97F4A7C15ULL;
+
+static inline uint64_t canon_key(uint64_t k) {
+  return k ? k : kZeroAlias;
+}
+
+static void index_alloc(VtpuIndex* t, int64_t cap) {
+  t->cap = cap;
+  t->keys = (uint64_t*)calloc((size_t)cap, 8);
+  t->vals = (int32_t*)malloc((size_t)cap * 4);
+  for (int64_t i = 0; i < cap; i++) t->vals[i] = -1;
+  t->count = 0;
+}
+
+static inline int32_t index_get(const VtpuIndex* t, uint64_t key) {
+  key = canon_key(key);
+  uint64_t mask = (uint64_t)t->cap - 1;
+  uint64_t i = key & mask;
+  for (;;) {
+    uint64_t k = t->keys[i];
+    if (k == key) return t->vals[i];
+    if (k == 0) return -1;
+    i = (i + 1) & mask;
+  }
+}
+
+static void index_put(VtpuIndex* t, uint64_t key, int32_t val);
+
+static void index_grow(VtpuIndex* t) {
+  uint64_t* ok = t->keys;
+  int32_t* ov = t->vals;
+  int64_t ocap = t->cap;
+  index_alloc(t, ocap * 2);
+  for (int64_t i = 0; i < ocap; i++) {
+    if (ok[i]) index_put(t, ok[i], ov[i]);
+  }
+  free(ok);
+  free(ov);
+}
+
+static void index_put(VtpuIndex* t, uint64_t key, int32_t val) {
+  if (t->count * 5 >= t->cap * 3) index_grow(t);
+  key = canon_key(key);
+  uint64_t mask = (uint64_t)t->cap - 1;
+  uint64_t i = key & mask;
+  for (;;) {
+    uint64_t k = t->keys[i];
+    if (k == 0) {
+      t->keys[i] = key;
+      t->vals[i] = val;
+      t->count++;
+      return;
+    }
+    if (k == key) {
+      t->vals[i] = val;
+      return;
+    }
+    i = (i + 1) & mask;
+  }
+}
+
+void* vtpu_index_new(int64_t capacity) {
+  int64_t cap = 1024;
+  while (cap < capacity) cap <<= 1;
+  VtpuIndex* t = new VtpuIndex;
+  index_alloc(t, cap);
+  return t;
+}
+
+void vtpu_index_free(void* p) {
+  VtpuIndex* t = (VtpuIndex*)p;
+  free(t->keys);
+  free(t->vals);
+  delete t;
+}
+
+void vtpu_index_clear(void* p) {
+  VtpuIndex* t = (VtpuIndex*)p;
+  memset(t->keys, 0, (size_t)t->cap * 8);
+  for (int64_t i = 0; i < t->cap; i++) t->vals[i] = -1;
+  t->count = 0;
+}
+
+void vtpu_index_insert(void* p, uint64_t key, int32_t val) {
+  index_put((VtpuIndex*)p, key, val);
+}
+
+int64_t vtpu_index_count(void* p) { return ((VtpuIndex*)p)->count; }
+
+void vtpu_index_lookup(void* p, const uint64_t* keys, int64_t n,
+                       int32_t* out) {
+  const VtpuIndex* t = (const VtpuIndex*)p;
+  for (int64_t i = 0; i < n; i++) out[i] = index_get(t, keys[i]);
+}
+
+// ---------------------------------------------------------------------
+// One-pass ingest: for every parsed metric line, probe the identity
+// index and combine straight into per-class staging — dense
+// accumulation for counters (associative add) and gauges (last-write),
+// append columns for histos (the digest needs the raw distribution)
+// and sets (packed HLL position).  This is the whole of
+// MetricTable.ingest_columns' numpy pass pipeline in one cache-friendly
+// loop; the Python side only resolves never-seen keys (slow parse +
+// row allocation) and re-runs the ingest over the recorded miss lines.
+//
+// meta in/out layout: [0]=histo append cursor, [1]=set append cursor,
+// [2]=miss count (out only), [3]=processed (metric lines with a
+// resolved key, incl. dropped), [4]=counter hits, [5]=gauge hits,
+// [6..10]=dropped per type code 0..4.
+void vtpu_ingest(
+    void* tblp, const uint64_t* keys, const uint8_t* types,
+    const double* vals, const uint64_t* members, const float* wts,
+    int64_t n, const int64_t* subset, int64_t subset_n, int64_t hll_p,
+    double* counter_dense, uint8_t* counter_touch,
+    float* gauge_dense, uint8_t* gauge_mask, uint8_t* gauge_touch,
+    int32_t* histo_rows, float* histo_vals, float* histo_wts,
+    uint8_t* histo_touch,
+    int32_t* set_rows, int32_t* set_pos, uint8_t* set_touch,
+    int64_t* miss_idx, int64_t* meta) {
+  const VtpuIndex* t = (const VtpuIndex*)tblp;
+  int64_t hn = meta[0], sn = meta[1], mn = 0;
+  int64_t processed = 0, cn = 0, gn = 0;
+  const int64_t total = subset_n >= 0 ? subset_n : n;
+  for (int64_t j = 0; j < total; j++) {
+    const int64_t i = subset_n >= 0 ? subset[j] : j;
+    const uint8_t tc = types[i];
+    if (tc > T_SET) continue;
+    const int32_t row = index_get(t, keys[i]);
+    if (row == -1) {
+      miss_idx[mn++] = i;
+      continue;
+    }
+    processed++;
+    if (row < 0) {  // DROPPED (-2): class table full
+      meta[6 + tc]++;
+      continue;
+    }
+    switch (tc) {
+      case T_COUNTER:
+        counter_dense[row] += vals[i] * (double)wts[i];
+        counter_touch[row] = 1;
+        cn++;
+        break;
+      case T_GAUGE:
+        gauge_dense[row] = (float)vals[i];
+        gauge_mask[row] = 1;  // staging dirty mask (cleared per step)
+        gauge_touch[row] = 1;  // interval-scoped flush-emission mark
+        gn++;
+        break;
+      case T_TIMER:
+      case T_HISTOGRAM:
+        histo_rows[hn] = row;
+        histo_vals[hn] = (float)vals[i];
+        histo_wts[hn] = wts[i];
+        histo_touch[row] = 1;
+        hn++;
+        break;
+      case T_SET: {
+        // bit split parameterized by hll_p so utils/hashing.HLL_P
+        // stays the single source of truth
+        const uint64_t h = members[i];
+        const uint32_t ridx = (uint32_t)(h >> (64 - hll_p));
+        const uint64_t w = (h << hll_p) | (1ULL << (hll_p - 1));
+        const int rank = __builtin_clzll(w) + 1;
+        set_rows[sn] = row;
+        set_pos[sn] = (int32_t)((ridx << 6) | (uint32_t)rank);
+        set_touch[row] = 1;
+        sn++;
+        break;
+      }
+    }
+  }
+  meta[0] = hn;
+  meta[1] = sn;
+  meta[2] = mn;
+  meta[3] += processed;
+  meta[4] += cn;
+  meta[5] += gn;
 }
 
 }  // extern "C"

@@ -92,6 +92,39 @@ def histo_stats_update(stats: Array, row_ids: Array, values: Array,
     return merge_histo_stats(stats, row_ids, incoming)
 
 
+def counter_dense_update(state: Array, dense: Array) -> Array:
+    """Add a host-precombined per-row total vector (f32[R]).
+
+    The host collapses a whole staging batch with ``np.bincount`` so
+    the transfer is R floats instead of 12 bytes/sample and the device
+    op is an elementwise add instead of a scatter.  Semantically
+    identical to counter_update over the same batch (addition is
+    associative; rate correction already applied host-side)."""
+    return state + dense
+
+
+def gauge_dense_update(state: Array, dense: Array, mask: Array) -> Array:
+    """Apply host-precombined last-write values: ``dense`` f32[R] holds
+    the final value for rows with ``mask`` set; other rows keep state.
+    """
+    return jnp.where(mask, dense, state)
+
+
+def histo_stats_update_unit(stats: Array, row_ids: Array,
+                            values: Array) -> Array:
+    """histo_stats_update specialised to sample weight 1 (the
+    overwhelmingly common no-sample-rate case): the weights column is
+    synthesised on device so the batch ships only (rows, values).
+    Padding entries must use row_id == num_rows (scatter drops them),
+    so the synthetic weight never pollutes real rows."""
+    ones = jnp.ones_like(values)
+    incoming = jnp.stack([
+        ones, values, values, values,
+        jnp.where(values != 0, 1.0 / values, 0.0)
+    ], axis=1)
+    return merge_histo_stats(stats, row_ids, incoming)
+
+
 def empty_counter_state(num_rows: int) -> Array:
     return jnp.zeros((num_rows,), dtype=jnp.float32)
 

@@ -186,6 +186,24 @@ def add_samples(means: Array, weights: Array, row_ids: Array,
                        compression=compression)
 
 
+@partial(jax.jit, static_argnames=("slots", "compression"),
+         donate_argnums=(0, 1))
+def add_samples_unit(means: Array, weights: Array, row_ids: Array,
+                     values: Array, slots: int = 256,
+                     compression: float = DEFAULT_COMPRESSION
+                     ) -> tuple[Array, Array]:
+    """add_samples specialised to unit sample weights (no sample-rate
+    correction), synthesised on device so batches ship only
+    (rows, values) — a third less host->device traffic on the timer hot
+    path.  Padding entries MUST use row_id == num_rows: densify's
+    scatter drops them, so the synthetic weight never lands."""
+    num_rows = means.shape[0]
+    ones = jnp.ones_like(values)
+    dense_v, dense_w = densify(row_ids, values, ones, num_rows, slots)
+    return _merge_impl(means, weights, dense_v, dense_w,
+                       compression=compression)
+
+
 def quantile(means: Array, weights: Array, qs: Array,
              mins: Array | None = None,
              maxs: Array | None = None) -> Array:

@@ -117,3 +117,46 @@ class HashIndex:
         self.keys[:] = _EMPTY
         self.vals[:] = MISSING
         self.count = 0
+
+
+class NativeHashIndex:
+    """Same contract as HashIndex, backed by the C++ table in
+    veneur_tpu/native/dsd_parse.cpp so the single-pass native ingest
+    (vtpu_ingest) can probe it without crossing into Python.  Sentinel
+    values and the zero-key alias match HashIndex exactly."""
+
+    def __init__(self, lib, capacity: int = 1 << 16):
+        import ctypes
+        self._lib = lib
+        self._ct = ctypes
+        self.handle = lib.vtpu_index_new(capacity)
+
+    def __del__(self):
+        h = getattr(self, "handle", None)
+        if h:
+            self._lib.vtpu_index_free(h)
+            self.handle = None
+
+    @property
+    def count(self) -> int:
+        return int(self._lib.vtpu_index_count(self.handle))
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        ct = self._ct
+        keys = np.ascontiguousarray(keys, np.uint64)
+        out = np.empty(len(keys), np.int32)
+        if len(keys):
+            self._lib.vtpu_index_lookup(
+                self.handle,
+                keys.ctypes.data_as(ct.POINTER(ct.c_uint64)),
+                len(keys),
+                out.ctypes.data_as(ct.POINTER(ct.c_int32)))
+        return out
+
+    def insert(self, key: int, val: int) -> None:
+        self._lib.vtpu_index_insert(self.handle,
+                                    self._ct.c_uint64(int(key)),
+                                    self._ct.c_int32(int(val)))
+
+    def clear(self) -> None:
+        self._lib.vtpu_index_clear(self.handle)
