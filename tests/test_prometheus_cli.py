@@ -1,0 +1,164 @@
+"""veneur-prometheus poller tests: exposition parsing, counter
+diffing across scrapes, histogram/summary handling (the model of
+cmd/veneur-prometheus/cache.go's diff semantics)."""
+
+from veneur_tpu.cli.prometheus import (parse_exposition, translate)
+
+SCRAPE_1 = """\
+# HELP http_requests_total Total requests.
+# TYPE http_requests_total counter
+http_requests_total{method="get",code="200"} 100
+http_requests_total{method="post",code="200"} 3
+# TYPE queue_depth gauge
+queue_depth 7.5
+# TYPE rpc_duration_seconds summary
+rpc_duration_seconds{quantile="0.5"} 0.05
+rpc_duration_seconds_sum 12.5
+rpc_duration_seconds_count 200
+# TYPE req_size histogram
+req_size_bucket{le="100"} 40
+req_size_bucket{le="+Inf"} 50
+req_size_sum 4000
+req_size_count 50
+untyped_thing 9
+"""
+
+SCRAPE_2 = """\
+# TYPE http_requests_total counter
+http_requests_total{method="get",code="200"} 150
+http_requests_total{method="post",code="200"} 3
+# TYPE queue_depth gauge
+queue_depth 6
+# TYPE rpc_duration_seconds summary
+rpc_duration_seconds{quantile="0.5"} 0.06
+rpc_duration_seconds_sum 13.5
+rpc_duration_seconds_count 230
+# TYPE req_size histogram
+req_size_bucket{le="100"} 45
+req_size_bucket{le="+Inf"} 60
+req_size_sum 4800
+req_size_count 60
+untyped_thing 11
+"""
+
+
+def test_parse_exposition_types_and_labels():
+    got = parse_exposition(SCRAPE_1)
+    by = {(n, tuple(sorted(l.items()))): (v, t) for n, l, v, t in got}
+    assert by[("http_requests_total",
+               (("code", "200"), ("method", "get")))] == (100.0,
+                                                          "counter")
+    assert by[("queue_depth", ())] == (7.5, "gauge")
+    assert by[("req_size_bucket", (("le", "100"),))][1] == "histogram"
+    assert by[("untyped_thing", ())] == (9.0, "untyped")
+
+
+def test_first_scrape_emits_gauges_only():
+    cache = {}
+    lines = translate(parse_exposition(SCRAPE_1), cache)
+    text = b"\n".join(lines).decode()
+    # cumulative series: cached, not emitted on first sight
+    assert "http_requests_total" not in text
+    assert "req_size_bucket" not in text
+    # instantaneous series: emitted as gauges
+    assert "queue_depth:7.5|g" in text
+    assert 'rpc_duration_seconds:0.05|g|#quantile:0.5' in text
+    assert "untyped_thing:9|g" in text
+
+
+def test_second_scrape_emits_deltas():
+    cache = {}
+    translate(parse_exposition(SCRAPE_1), cache)
+    lines = translate(parse_exposition(SCRAPE_2), cache)
+    text = b"\n".join(lines).decode()
+    assert "http_requests_total:50|c|#code:200,method:get" in text
+    # unchanged counter: no zero-delta noise
+    assert "method:post" not in text
+    assert "queue_depth:6|g" in text
+    assert "req_size_bucket:5|c|#le:100" in text
+    assert "req_size_sum:800|c" in text
+    assert "req_size_count:10|c" in text
+    assert "rpc_duration_seconds_count:30|c" in text
+
+
+def test_counter_reset_suppressed():
+    cache = {}
+    translate(parse_exposition(SCRAPE_2), cache)
+    # process restarted: counter fell from 150 to 5 -> no negative
+    # delta emitted, cache rebased
+    lines = translate(parse_exposition(
+        "# TYPE http_requests_total counter\n"
+        'http_requests_total{method="get",code="200"} 5\n'), cache)
+    assert not [l for l in lines if b"http_requests" in l]
+    lines = translate(parse_exposition(
+        "# TYPE http_requests_total counter\n"
+        'http_requests_total{method="get",code="200"} 9\n'), cache)
+    assert lines == [b"http_requests_total:4|c|#code:200,method:get"]
+
+
+def test_ignored_and_added_labels():
+    cache = {}
+    lines = translate(parse_exposition(SCRAPE_1), cache,
+                      ignored_labels=("quantile",),
+                      added_tags=("dc:east",))
+    text = b"\n".join(lines).decode()
+    assert "rpc_duration_seconds:0.05|g|#dc:east" in text
+    assert "quantile" not in text
+
+
+def test_main_once_against_live_http(tmp_path):
+    """End-to-end: a real HTTP exposition endpoint scraped with -once,
+    datagrams arriving at a local UDP socket."""
+    import http.server
+    import socket
+    import threading
+    from veneur_tpu.cli.prometheus import main
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = SCRAPE_1.encode()
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.settimeout(2.0)
+    try:
+        rc = main(["-host",
+                   f"http://127.0.0.1:{httpd.server_port}/metrics",
+                   "-statsd-host",
+                   f"127.0.0.1:{rx.getsockname()[1]}", "-once"])
+        assert rc == 0
+        got = []
+        rx.settimeout(0.5)
+        try:
+            while True:
+                got.append(rx.recv(65536))
+        except socket.timeout:
+            pass
+        text = b"\n".join(got).decode()
+        assert "queue_depth:7.5|g" in text
+    finally:
+        httpd.shutdown()
+        rx.close()
+
+
+def test_label_unescape_single_pass():
+    """Escaped backslash followed by 'n' must decode to backslash+n,
+    not a newline (sequential str.replace gets this wrong); decoded
+    control characters are flattened before entering the datagram."""
+    from veneur_tpu.cli.prometheus import translate
+    text = '# TYPE m gauge\nm{path="C:\\\\new",msg="a\\nb"} 1\n'
+    samples = parse_exposition(text)
+    labels = samples[0][1]
+    assert labels["path"] == "C:\\new"
+    assert labels["msg"] == "a\nb"
+    (line,) = translate(samples, {})
+    assert b"\n" not in line
+    assert b"path:C:\\new" in line

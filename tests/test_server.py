@@ -265,3 +265,55 @@ def test_slow_sink_does_not_stall_flush_cadence(make_server):
     # the fast capture sink still delivered
     assert any(x.name == "slow.hits" for x in cap.metrics)
     SlowSink.release.set()
+
+
+def test_debug_pprof_and_quitquitquit():
+    """pprof-style debug endpoints (reference http.go:52-57) and the
+    opt-in /quitquitquit graceful-shutdown endpoint (server.go:82)."""
+    import urllib.request
+    from veneur_tpu.core.config import read_config
+    from veneur_tpu.core.server import Server
+
+    server = Server(read_config(data={
+        "statsd_listen_addresses": [],
+        "http_address": "127.0.0.1:0", "http_quit": True,
+        "interval": "10s"}))
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.http_port}"
+        body = urllib.request.urlopen(
+            base + "/debug/pprof/goroutine", timeout=5).read()
+        assert b"Thread" in body or b"File" in body
+        body = urllib.request.urlopen(
+            base + "/debug/pprof/heap", timeout=5).read()
+        assert b"tracemalloc" in body or b"size=" in body
+        body = urllib.request.urlopen(
+            base + "/quitquitquit", timeout=5).read()
+        assert body == b"terminating"
+        deadline = time.monotonic() + 5
+        while (not server._shutdown.is_set() and
+               time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert server._shutdown.is_set()
+    finally:
+        server.shutdown()
+
+
+def test_quitquitquit_disabled_by_default():
+    import urllib.error
+    import urllib.request
+    from veneur_tpu.core.config import read_config
+    from veneur_tpu.core.server import Server
+
+    server = Server(read_config(data={
+        "statsd_listen_addresses": [],
+        "http_address": "127.0.0.1:0", "interval": "10s"}))
+    server.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.http_port}/quitquitquit",
+                timeout=5)
+        assert not server._shutdown.is_set()
+    finally:
+        server.shutdown()

@@ -1,0 +1,245 @@
+"""Trace-client tests: backends, span API, metric report helpers, and
+the end-to-end loop of a client span landing in a server's sinks (the
+model of reference trace/client_test.go + trace/testbackend)."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from veneur_tpu.protocol import wire
+from veneur_tpu.protocol.gen import ssf_pb2
+from veneur_tpu.trace import (ChannelBackend, Client, PacketBackend,
+                              StreamBackend, metrics as tm, scoped,
+                              spans as ts)
+
+
+def _drain(client, timeout=2.0):
+    deadline = time.monotonic() + timeout
+    while client._q.qsize() and time.monotonic() < deadline:
+        time.sleep(0.005)
+    client.flush()
+
+
+# ----------------------------------------------------------------------
+# span API
+
+def test_span_lifecycle_and_children():
+    root = ts.start_trace("root", service="svc",
+                          tags={"env": "test"})
+    assert root.trace_id > 0 and root.span_id > 0
+    child = root.child("step")
+    assert child.trace_id == root.trace_id
+    assert child.proto.parent_id == root.span_id
+    assert child.proto.service == "svc"
+    p = child.finish()
+    assert p.end_timestamp >= p.start_timestamp
+
+
+def test_start_span_context_manager_records_and_marks_errors():
+    got = []
+    client = Client(ChannelBackend(got.append))
+    with ts.start_span(client, "ok-op", service="s"):
+        pass
+    with pytest.raises(ValueError):
+        with ts.start_span(client, "bad-op", service="s"):
+            raise ValueError("boom")
+    _drain(client)
+    client.close()
+    by_name = {s.name: s for s in got}
+    assert not by_name["ok-op"].error
+    assert by_name["bad-op"].error
+    assert by_name["bad-op"].tags["error.type"] == "ValueError"
+
+
+def test_client_backpressure_drops_not_blocks():
+    block = threading.Event()
+
+    class Slow:
+        def send(self, span):
+            block.wait(1.0)
+
+        def flush(self):
+            pass
+
+        def close(self):
+            pass
+
+    client = Client(Slow(), capacity=2)
+    t0 = time.monotonic()
+    for _ in range(50):
+        client.record(ssf_pb2.SSFSpan(id=1, trace_id=1))
+    assert time.monotonic() - t0 < 0.5  # never blocked
+    assert client.dropped >= 40
+    block.set()
+    client.close()
+
+
+# ----------------------------------------------------------------------
+# backends
+
+def test_packet_backend_udp_roundtrip():
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.settimeout(2.0)
+    port = rx.getsockname()[1]
+    client = Client(PacketBackend(f"udp://127.0.0.1:{port}"))
+    sp = ts.start_trace("net-op", service="svc")
+    sp.finish(client)
+    data, _ = rx.recvfrom(65536)
+    got = wire.parse_ssf(data)
+    assert got.name == "net-op" and got.service == "svc"
+    client.close()
+    rx.close()
+
+
+def test_stream_backend_frames_and_reconnects(tmp_path):
+    path = str(tmp_path / "ssf.sock")
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    srv.bind(path)
+    srv.listen(1)
+    backend = StreamBackend(f"unix://{path}")
+    sp = ts.start_trace("framed", service="svc").finish()
+    backend.send(sp)
+    backend.flush()
+    conn, _ = srv.accept()
+    conn.settimeout(2.0)
+    got = wire.read_ssf(conn.makefile("rb"))
+    assert got.name == "framed"
+    # kill the server side: next send errors, then a fresh listener
+    # accepts a reconnect after backoff
+    conn.close()
+    srv.close()
+    with pytest.raises(OSError):
+        for _ in range(10):  # buffered writes may take a few to EPIPE
+            backend.send(sp)
+            backend.flush()
+    import os
+    os.unlink(path)
+    srv2 = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    srv2.bind(path)
+    srv2.listen(1)
+    deadline = time.monotonic() + 3.0
+    sent = False
+    while time.monotonic() < deadline:
+        try:
+            backend.send(sp)
+            backend.flush()
+            sent = True
+            break
+        except OSError:
+            time.sleep(0.02)  # linear backoff window
+    assert sent
+    conn2, _ = srv2.accept()
+    got2 = wire.read_ssf(conn2.makefile("rb"))
+    assert got2.name == "framed"
+    backend.close()
+    conn2.close()
+    srv2.close()
+
+
+# ----------------------------------------------------------------------
+# metrics helpers + scoped client
+
+def test_report_helpers_build_metrics_only_span():
+    got = []
+    client = Client(ChannelBackend(got.append))
+    assert tm.report_batch(client, [
+        tm.count("c", 2, {"a": "b"}),
+        tm.timing("t", 0.5),
+        tm.set_sample("s", "m1"),
+        tm.status("up", ssf_pb2.SSFSample.OK, "fine"),
+    ])
+    _drain(client)
+    client.close()
+    (span,) = got
+    assert not span.name and span.id == 0  # metrics-only
+    kinds = [m.metric for m in span.metrics]
+    assert kinds == [ssf_pb2.SSFSample.COUNTER,
+                     ssf_pb2.SSFSample.HISTOGRAM,
+                     ssf_pb2.SSFSample.SET,
+                     ssf_pb2.SSFSample.STATUS]
+    assert span.metrics[0].tags["a"] == "b"
+    assert span.metrics[1].value == 500.0 and span.metrics[1].unit == "ms"
+    assert span.metrics[3].message == "fine"
+
+
+def test_scoped_client_tags_and_scopes():
+    got = []
+    client = Client(ChannelBackend(got.append))
+    sc = scoped.ScopedClient(client, tags={"host": "h1"},
+                             count_scope=scoped.GLOBAL,
+                             gauge_scope=scoped.LOCAL)
+    sc.incr("hits", tags={"route": "r"})
+    sc.gauge("depth", 4.0)
+    _drain(client)
+    client.close()
+    c = got[0].metrics[0]
+    g = got[1].metrics[0]
+    assert c.scope == ssf_pb2.SSFSample.GLOBAL
+    assert c.tags["host"] == "h1" and c.tags["route"] == "r"
+    assert g.scope == ssf_pb2.SSFSample.LOCAL
+
+
+# ----------------------------------------------------------------------
+# end to end: client -> server SSF listener -> metric table -> sink
+
+def test_client_span_samples_land_in_server(request):
+    from veneur_tpu.core.config import read_config
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.sinks.simple import CaptureSink
+
+    cap = CaptureSink()
+    server = Server(read_config(data={
+        "ssf_listen_addresses": ["udp://127.0.0.1:0"],
+        "statsd_listen_addresses": [],
+        "interval": "10s"}), extra_sinks=[cap])
+    server.start()
+    request.addfinalizer(server.shutdown)
+
+    client = Client(PacketBackend(
+        f"udp://127.0.0.1:{server.ssf_ports[0]}"))
+    request.addfinalizer(client.close)
+    with ts.start_span(client, "e2e-op", service="svc") as sp:
+        sp.add_sample(tm.count("trace.hits", 5))
+
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if server.stats.get("spans_received", 0) >= 1:
+            break
+        time.sleep(0.02)
+    server.flush_once()
+    deadline = time.monotonic() + 5.0
+    names = {}
+    while time.monotonic() < deadline:
+        names = {m.name: m.value for m in cap.metrics}
+        if "trace.hits" in names:
+            break
+        time.sleep(0.05)
+    assert names.get("trace.hits") == 5.0
+
+
+def test_server_flush_traces_itself(request):
+    """The server opens a 'flush' span through its loopback client
+    each interval (reference flusher.go:29 + NewChannelClient
+    server.go:347): the span re-enters its own span pipeline."""
+    from veneur_tpu.core.config import read_config
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.sinks.simple import CaptureSink
+
+    scap = CaptureSink()
+    server = Server(read_config(data={
+        "statsd_listen_addresses": [], "interval": "10s"}),
+        extra_span_sinks=[scap])
+    server.start()
+    request.addfinalizer(server.shutdown)
+    server.flush_once()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if any(s.name == "flush" for s in scap.spans):
+            break
+        time.sleep(0.02)
+    flush_spans = [s for s in scap.spans if s.name == "flush"]
+    assert flush_spans and flush_spans[0].service == "veneur"
+    assert flush_spans[0].end_timestamp > flush_spans[0].start_timestamp

@@ -57,6 +57,9 @@ class Config:
     ssf_listen_addresses: list[str] = field(default_factory=list)
     grpc_listen_addresses: list[str] = field(default_factory=list)
     http_address: str = ""
+    # serve POST-free GET /quitquitquit for graceful shutdown
+    # (reference server.go:82 http_quit)
+    http_quit: bool = False
     num_readers: int = 1
     # datagrams a reader sweeps into one columnar parse batch
     reader_batch_packets: int = 512

@@ -87,6 +87,13 @@ class Server:
                              if ":" in t),
             capacity=config.span_channel_capacity,
             stats_cb=self.bump)
+        # in-process loopback trace client: the server (and any
+        # embedding code) traces into its OWN span pipeline — the role
+        # of the reference's NewChannelClient (server.go:347-354)
+        from veneur_tpu import trace as vtrace
+        self.trace_client = vtrace.Client(
+            vtrace.ChannelBackend(self.span_worker.submit),
+            capacity=256)
 
         self.events: list[dsd.Event] = []
         self.checks: list[dsd.ServiceCheck] = []
@@ -94,6 +101,7 @@ class Server:
         # read-modify-write is not atomic, so guard with a dedicated
         # lock (cheaper than widening self.lock's critical sections)
         self._stats_lock = threading.Lock()
+        self._pprof_lock = threading.Lock()
         self.stats: dict[str, int] = {
             "packets_received": 0, "packet_errors": 0,
             "metrics_processed": 0, "metrics_dropped": 0,
@@ -600,6 +608,89 @@ class Server:
                     self._ok(__version__.encode())
                 elif self.path == "/builddate":
                     self._ok(b"dev")
+                elif self.path.startswith("/debug/pprof"):
+                    # the role of net/http/pprof (reference
+                    # http.go:52-57): live profiling without restart
+                    self._pprof()
+                elif (self.path == "/quitquitquit" and
+                      server.config.http_quit):
+                    # graceful shutdown endpoint (reference
+                    # server.go:82 httpQuit + handlers_global.go)
+                    self._ok(b"terminating")
+                    threading.Thread(target=server.shutdown,
+                                     daemon=True).start()
+                else:
+                    self.send_error(404)
+
+            def _pprof(self):
+                import io as _io
+                path, _, query = self.path.partition("?")
+                part = path.rsplit("/", 1)[-1]
+                if part in ("pprof", "goroutine", "threads"):
+                    # thread stack dump — the goroutine profile's role
+                    import sys
+                    import traceback
+                    names = {t.ident: t.name
+                             for t in threading.enumerate()}
+                    buf = _io.StringIO()
+                    for tid, frame in sys._current_frames().items():
+                        buf.write(f"Thread {names.get(tid, tid)}:\n")
+                        buf.writelines(traceback.format_stack(frame))
+                        buf.write("\n")
+                    self._ok(buf.getvalue().encode())
+                elif part == "heap":
+                    import tracemalloc
+                    if "start=1" in query:
+                        tracemalloc.start()
+                        self._ok(b"tracing started")
+                    elif "stop=1" in query:
+                        # tracing has per-allocation overhead: always
+                        # stoppable so one debug query can't degrade a
+                        # long-running server until restart
+                        tracemalloc.stop()
+                        self._ok(b"tracing stopped")
+                    elif not tracemalloc.is_tracing():
+                        self._ok(b"tracemalloc not tracing; GET "
+                                 b"/debug/pprof/heap?start=1 first")
+                    else:
+                        snap = tracemalloc.take_snapshot()
+                        top = snap.statistics("lineno")[:50]
+                        self._ok("\n".join(str(s)
+                                           for s in top).encode())
+                elif part == "profile":
+                    import cProfile
+                    import pstats
+                    seconds = 2.0
+                    if "seconds=" in query:
+                        try:
+                            seconds = float(
+                                query.split("seconds=")[1]
+                                .split("&")[0])
+                        except ValueError:
+                            pass
+                    # only one profiler can be active per process
+                    # (concurrent requests or enable_profiling would
+                    # raise): serialize, and 503 on any other active
+                    # profiling tool
+                    if not server._pprof_lock.acquire(blocking=False):
+                        self.send_error(
+                            503, "profiling already in progress")
+                        return
+                    try:
+                        prof = cProfile.Profile()
+                        try:
+                            prof.enable()
+                        except ValueError as e:
+                            self.send_error(503, str(e))
+                            return
+                        time.sleep(min(seconds, 30.0))
+                        prof.disable()
+                    finally:
+                        server._pprof_lock.release()
+                    buf = _io.StringIO()
+                    pstats.Stats(prof, stream=buf).sort_stats(
+                        "cumulative").print_stats(60)
+                    self._ok(buf.getvalue().encode())
                 else:
                     self.send_error(404)
 
@@ -656,6 +747,11 @@ class Server:
         if self._shutdown.is_set():
             return FlushResult()
         t_flush0 = time.monotonic_ns()
+        # self-trace the flush through the loopback client (reference
+        # flusher.go:29 StartSpan("flush")); the span re-enters the
+        # span pipeline and ssfmetrics extraction next interval
+        from veneur_tpu.trace import spans as _tspans
+        _flush_span = _tspans.Span("flush", service="veneur")
         with self.lock:
             snap = self.table.swap()
             events = self.events
@@ -727,6 +823,7 @@ class Server:
                 res.tally, time.monotonic_ns() - t_flush0, sink_durs)
         except Exception:
             log.exception("self-telemetry emission failed")
+        _flush_span.finish(self.trace_client)
         return res
 
     def _safe_sink_flush(self, sink, batch, other) -> None:
@@ -829,6 +926,7 @@ class Server:
             self._httpd.shutdown()
         for g in self.grpc_servers:
             g.stop()
+        self.trace_client.close()
         self.span_worker.stop()
         if self.config.enable_profiling:
             try:

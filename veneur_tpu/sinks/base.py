@@ -48,6 +48,19 @@ class Plugin(Protocol):
     def flush(self, metrics: list[InterMetric], hostname: str) -> None: ...
 
 
+@runtime_checkable
+class DerivedMetricsProcessor(Protocol):
+    """Re-injection point for computed samples (reference
+    samplers/derived.go:8 ``DerivedMetricsProcessor``): anything that
+    synthesizes metrics mid-pipeline — the ssfmetrics span bridge,
+    SLI indicator timers — hands them here to enter aggregation like
+    any ingested sample.  ``core.Server`` satisfies this."""
+
+    def ingest_parsed(self, sample) -> None: ...
+
+    def bump(self, key: str, n: int = 1) -> None: ...
+
+
 class SinkBase:
     """Convenience base with excluded-tag stripping."""
 

@@ -1,0 +1,22 @@
+"""Client-side tracing: the framework's self-telemetry transport and
+span API (the role of the reference's trace/ package: client.go,
+backend.go, trace.go, metrics/client.go, plus scopedstatsd/).
+
+``client``   — async span pump with channel / datagram / framed-stream
+               backends (trace/client.go:56, trace/backend.go:47-160)
+``spans``    — Trace/Span construction and context-manager API
+               (trace/trace.go:53, :269, :329)
+``metrics``  — one-off metric reporting via metrics-only spans
+               (trace/metrics/client.go:22-50)
+``scoped``   — tag-adding, scope-forcing wrapper client
+               (scopedstatsd/client.go:13)
+"""
+
+from veneur_tpu.trace.client import (ChannelBackend, Client,
+                                     PacketBackend, StreamBackend)
+from veneur_tpu.trace.spans import Span, start_trace, start_span
+from veneur_tpu.trace import metrics, scoped
+
+__all__ = ["Client", "ChannelBackend", "PacketBackend",
+           "StreamBackend", "Span", "start_trace", "start_span",
+           "metrics", "scoped"]
