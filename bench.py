@@ -30,6 +30,7 @@ from __future__ import annotations
 import json
 import sys
 import time
+from collections import deque
 
 import numpy as np
 
@@ -49,7 +50,8 @@ def _block(table):
         jax.block_until_ready(arr)
 
 
-STEADY_INTERVALS = 3
+STEADY_INTERVALS = 5
+FLUSH_LAG = 2  # intervals a flush readback may trail its swap
 
 
 def _ingest_interval(table, bufs, parser):
@@ -64,12 +66,14 @@ def _ingest_interval(table, bufs, parser):
 
 def _run_config(bufs, flush_launch, **table_kw):
     """Cold interval (compiles + row allocation), then
-    STEADY_INTERVALS timed intervals with the flush readback of
-    interval k overlapped with the ingest of interval k+1 — exactly
+    STEADY_INTERVALS timed intervals with each interval's flush
+    readback allowed to trail by up to FLUSH_LAG intervals of ingest —
     how the real server runs (flush tasks go to a pool; the next
-    tick's ingest never waits on readback).  ``flush_launch(snap)``
-    must dispatch device work + async host copies and return a
-    closure producing the flush result."""
+    tick's ingest never waits on readback; the tunnel's d2h latency
+    hides behind subsequent parse work).  Every flush result is still
+    produced and consumed inside the timed region.  ``flush_launch``
+    must dispatch device work + async host copies and return a closure
+    producing the flush result."""
     from veneur_tpu.protocol import columnar
     parser = columnar.ColumnarParser()
     table = _mk_table(**table_kw)
@@ -81,21 +85,21 @@ def _run_config(bufs, flush_launch, **table_kw):
 
     t0 = time.perf_counter()
     total = 0
-    pending = None
-    out = None
+    pending: deque = deque()
+    outs = []
     for _ in range(STEADY_INTERVALS):
         total += _ingest_interval(table, bufs, parser)
-        snap = table.swap()
-        if pending is not None:
-            out = pending()
-        pending = flush_launch(snap)
-    out = pending()
+        pending.append(flush_launch(table.swap()))
+        while len(pending) > FLUSH_LAG:
+            outs.append(pending.popleft()())
+    while pending:
+        outs.append(pending.popleft()())
     _block(table)
     dt = time.perf_counter() - t0
     return {"samples": total, "seconds": round(dt, 4),
             "samples_per_sec": round(total / dt, 1),
             "intervals": STEADY_INTERVALS,
-            "cold_interval_seconds": round(cold, 4)}, out
+            "cold_interval_seconds": round(cold, 4)}, outs[-1]
 
 
 def _async_np(*arrs):
@@ -207,15 +211,15 @@ def bench_timers() -> dict:
     cold = time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    pending = None
+    pending: deque = deque()
     quant = None
     for _ in range(STEADY_INTERVALS):
         one_ingest(table)
-        snap = table.swap()
-        if pending is not None:
-            quant = pending()
-        pending = flush_launch(snap)
-    quant = pending()
+        pending.append(flush_launch(table.swap()))
+        while len(pending) > FLUSH_LAG:
+            quant = pending.popleft()()
+    while pending:
+        quant = pending.popleft()()
     _block(table)
     dt = time.perf_counter() - t0
 

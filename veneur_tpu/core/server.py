@@ -781,7 +781,11 @@ class Server:
                 log.warning("%s still busy from a previous interval; "
                             "skipping its flush", key)
                 return
-            fut = self._pool.submit(fn, *args)
+            try:
+                fut = self._pool.submit(fn, *args)
+            except RuntimeError:
+                # shutdown() closed the pool mid-flush; drop the task
+                return
             self._flush_pending[key] = fut
             futures.append(fut)
 

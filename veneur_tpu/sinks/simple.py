@@ -60,8 +60,10 @@ class CaptureSink(SinkBase):
         self.other: list = []
         self.spans: list = []
 
-    def flush(self, metrics: list[InterMetric]) -> None:
-        self.batches.append(list(metrics))
+    def flush(self, metrics: list[InterMetric] | None = None) -> None:
+        # doubles as a SpanSink, whose flush() takes no batch
+        if metrics is not None:
+            self.batches.append(list(metrics))
 
     def flush_other_samples(self, samples: list) -> None:
         self.other.extend(samples)

@@ -99,11 +99,24 @@ def key_hash64(name: str, type_code: int, tags: Sequence[str],
                scope_code: int) -> int:
     """64-bit series-identity hash over (name, type, sorted tags,
     scope) — MUST stay bit-identical to the native parser's key hash
-    (veneur_tpu/native/dsd_parse.cpp) so slow-path row allocations and
-    fast-path lookups agree.  Tags are assumed already sorted."""
+    (block_hash in veneur_tpu/native/dsd_parse.cpp) so slow-path row
+    allocations and fast-path lookups agree.  Tags are assumed already
+    sorted.
+
+    Scheme: FNV-style folding 8 little-endian payload bytes per
+    multiply (8x fewer dependent multiplies than byte-serial FNV —
+    this hash is the native parser's hot loop), zero-padded tail,
+    length mixed in so padding can't collide, fmix64 finalizer."""
     payload = (name.encode() + b"\x00" + bytes([type_code]) + b"\x00" +
                ",".join(tags).encode() + b"\x00" + bytes([scope_code]))
-    return _fmix64(fnv1a_64_int(payload))
+    h = int(FNV1A_64_OFFSET)
+    prime = int(FNV1A_64_PRIME)
+    mask = 0xFFFFFFFFFFFFFFFF
+    for i in range(0, len(payload), 8):
+        chunk = int.from_bytes(payload[i:i + 8], "little")
+        h = ((h ^ chunk) * prime) & mask
+    h ^= len(payload)
+    return _fmix64(h)
 
 
 def hash64(members: Sequence[bytes]) -> np.ndarray:
