@@ -65,3 +65,20 @@ def test_parse_duration():
     assert parse_duration(3) == 3.0
     with pytest.raises(ValueError):
         parse_duration("xx")
+
+
+def test_env_override_dict_field():
+    """VENEUR_* env overrides coerce dict-typed fields (the signalfx
+    per-tag API-key map) from "k1:v1,k2:v2" form."""
+    from veneur_tpu.core.config import read_config
+    c = read_config(data={"interval": "10s"}, env={
+        "VENEUR_SIGNALFX_PER_TAG_API_KEYS": "infra:tok1, web:tok2"})
+    assert c.signalfx_per_tag_api_keys == {"infra": "tok1",
+                                           "web": "tok2"}
+
+
+def test_kafka_serialization_format_validated():
+    from veneur_tpu.core.config import read_config
+    with pytest.raises(ValueError, match="serialization"):
+        read_config(data={"interval": "10s",
+                          "kafka_span_serialization_format": "avro"})

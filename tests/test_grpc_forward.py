@@ -3,6 +3,8 @@ compatibility (dense + sparse), and an in-process local -> global chain
 over real loopback gRPC — the forwardGRPCFixture topology
 (reference forward_grpc_test.go:19-57)."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -236,3 +238,89 @@ def test_grpc_forward_chain(tmp_path):
             local.shutdown()
     finally:
         glob.shutdown()
+
+
+def test_grpc_ingest_span_packet_health():
+    """The gRPC listener serves SSF spans, DogStatsD packets and grpc
+    health alongside forward import, like the reference's single
+    stats listener (networking.go:295-358 startGRPCTCP)."""
+    import grpc as grpclib
+
+    from veneur_tpu.core.config import read_config
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.protocol.gen import (dogstatsd_grpc_pb2, health_pb2,
+                                         ssf_pb2)
+    from veneur_tpu.sinks.simple import CaptureSink
+
+    cap = CaptureSink()
+    srv = Server(read_config(data={
+        "grpc_listen_addresses": ["tcp://127.0.0.1:0"],
+        "interval": "10s", "hostname": "g"}), extra_sinks=[cap],
+        extra_span_sinks=[cap])
+    srv.start()
+    chan = grpclib.insecure_channel(f"127.0.0.1:{srv.grpc_ports[0]}")
+    try:
+        # health: "veneur" and "" are SERVING, others unknown
+        check = chan.unary_unary(
+            "/grpc.health.v1.Health/Check",
+            request_serializer=(
+                health_pb2.HealthCheckRequest.SerializeToString),
+            response_deserializer=(
+                health_pb2.HealthCheckResponse.FromString))
+        resp = check(health_pb2.HealthCheckRequest(service="veneur"),
+                     timeout=5)
+        assert resp.status == health_pb2.HealthCheckResponse.SERVING
+        resp = check(health_pb2.HealthCheckRequest(service="nope"),
+                     timeout=5)
+        assert (resp.status ==
+                health_pb2.HealthCheckResponse.SERVICE_UNKNOWN)
+
+        # DogStatsD packet: multi-line body lands in the table
+        send_packet = chan.unary_unary(
+            "/dogstatsd.DogstatsdGRPC/SendPacket",
+            request_serializer=(
+                dogstatsd_grpc_pb2.DogstatsdPacket.SerializeToString),
+            response_deserializer=dogstatsd_grpc_pb2.Empty.FromString)
+        send_packet(dogstatsd_grpc_pb2.DogstatsdPacket(
+            packetBytes=b"grpc.hits:3|c\ngrpc.hits:4|c"), timeout=5)
+        assert srv.stats["received_dogstatsd-grpc"] == 1
+
+        # SSF span with an attached sample: span reaches span sinks,
+        # sample reaches the metric table via ssfmetrics
+        send_span = chan.unary_unary(
+            "/ssf.SSFGRPC/SendSpan",
+            request_serializer=ssf_pb2.SSFSpan.SerializeToString,
+            response_deserializer=dogstatsd_grpc_pb2.Empty.FromString)
+        span = ssf_pb2.SSFSpan(
+            version=0, trace_id=5, id=6, service="svc", name="op",
+            start_timestamp=1_000_000_000, end_timestamp=2_000_000_000)
+        span.metrics.append(ssf_pb2.SSFSample(
+            metric=ssf_pb2.SSFSample.COUNTER, name="grpc.span.ctr",
+            value=2.0, sample_rate=1.0))
+        send_span(span, timeout=5)
+        assert srv.stats["received_ssf-grpc"] == 1
+
+        # span fan-out and sink delivery are both async (span worker
+        # thread; flush pool): wait rather than assert immediately
+        def _wait(pred, timeout=10.0):
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < timeout:
+                if pred():
+                    return True
+                time.sleep(0.02)
+            return pred()
+
+        # 2 packet lines + 1 span-attached sample extracted by
+        # ssfmetrics must be in the table before the swap
+        assert _wait(lambda: srv.stats["metrics_processed"] >= 3)
+        assert _wait(lambda: any(s.name == "op" for s in cap.spans))
+        srv.flush_once()
+        assert _wait(lambda: any(m.name == "grpc.span.ctr"
+                                 for m in cap.metrics))
+        names = {m.name: m for m in cap.metrics}
+        assert names["grpc.hits"].value == 7.0
+        assert names["grpc.span.ctr"].value == 2.0
+        assert any(s.name == "op" for s in cap.spans)
+    finally:
+        chan.close()
+        srv.shutdown()

@@ -86,8 +86,15 @@ def test_per_protocol_receive_counters():
             time.sleep(0.01)
         server.flush_once()
         server.flush_once()
-        per_proto = [x for x in cap.metrics if x.name ==
-                     "veneur.listen.received_per_protocol_total"]
+        # sink delivery is async (flush pool): wait for the counter
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            per_proto = [x for x in cap.metrics if x.name ==
+                         "veneur.listen.received_per_protocol_total"]
+            if any("protocol:dogstatsd-udp" in x.tags
+                   for x in per_proto):
+                break
+            time.sleep(0.02)
         assert any("protocol:dogstatsd-udp" in x.tags
                    for x in per_proto)
     finally:

@@ -95,7 +95,46 @@ class Config:
     flush_file: str = ""  # localfile plugin
     aws_s3_bucket: str = ""
     aws_region: str = ""
+    # SigV4 credentials for the s3 plugin; empty falls back to the
+    # AWS_* env vars, and with neither the plugin spools locally
+    aws_access_key_id: str = ""
+    aws_secret_access_key: str = ""
+    # override for S3-compatible stores (minio, test fakes)
+    aws_s3_endpoint: str = ""
+    # kafka (reference config.go:38-55; the buffer/acks tuning knobs
+    # are deliberately absent — flushes batch per interval here)
     kafka_broker: str = ""
+    kafka_metric_topic: str = "veneur_metrics"
+    kafka_check_topic: str = ""
+    kafka_event_topic: str = ""
+    kafka_span_topic: str = ""
+    kafka_span_serialization_format: str = "protobuf"
+    # datadog span half: local trace agent (config.go:20)
+    datadog_trace_api_address: str = ""
+    # signalfx (config.go:80-93)
+    signalfx_api_key: str = ""
+    signalfx_endpoint_base: str = "https://ingest.signalfx.com"
+    signalfx_flush_max_per_body: int = 5000
+    signalfx_vary_key_by: str = ""
+    signalfx_per_tag_api_keys: dict = field(default_factory=dict)
+    # splunk HEC span sink (config.go:95-104)
+    splunk_hec_address: str = ""
+    splunk_hec_token: str = ""
+    splunk_span_sample_rate: int = 1
+    # newrelic (config.go:63-69)
+    newrelic_insert_key: str = ""
+    newrelic_metric_endpoint: str = "https://metric-api.newrelic.com"
+    newrelic_trace_endpoint: str = "https://trace-api.newrelic.com"
+    newrelic_common_tags: list[str] = field(default_factory=list)
+    # xray (config.go:129-131)
+    xray_address: str = ""
+    xray_sample_percentage: float = 100.0
+    xray_annotation_tags: list[str] = field(default_factory=list)
+    # lightstep (config.go:56-57)
+    lightstep_access_token: str = ""
+    lightstep_collector_host: str = "https://collector.lightstep.com"
+    # falconer: thin grpsink wrapper (config.go:25)
+    falconer_address: str = ""
 
     # tls
     tls_key: str = ""
@@ -149,6 +188,12 @@ class Config:
                   "reader_batch_packets", "tpu_stage_flush_samples"):
             if getattr(self, n) <= 0:
                 problems.append(f"{n} must be positive")
+        if self.kafka_span_serialization_format not in ("protobuf",
+                                                        "json"):
+            problems.append(
+                "kafka_span_serialization_format must be "
+                "'protobuf' or 'json', got "
+                f"{self.kafka_span_serialization_format!r}")
         return problems
 
 
@@ -198,6 +243,14 @@ def _coerce(cls, name: str, raw: str):
         if current and isinstance(current[0], float):
             return [float(x) for x in items]
         return items
+    if isinstance(current, dict):
+        # "k1:v1,k2:v2" (the signalfx per-tag key map shape)
+        out = {}
+        for item in raw.split(","):
+            if item.strip():
+                k, _, v = item.partition(":")
+                out[k.strip()] = v.strip()
+        return out
     return raw
 
 

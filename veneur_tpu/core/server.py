@@ -41,7 +41,7 @@ from veneur_tpu.sinks import base as sinks_base
 from veneur_tpu.sinks.datadog import DatadogMetricSink
 from veneur_tpu.sinks.prometheus import PrometheusRepeaterSink
 from veneur_tpu.sinks.simple import (BlackholeSink, DebugSink,
-                                     LocalFilePlugin, S3ArchivePlugin)
+                                     LocalFilePlugin)
 
 log = logging.getLogger("veneur_tpu.server")
 
@@ -145,13 +145,71 @@ class Server:
         if c.prometheus_repeater_address:
             self.metric_sinks.append(PrometheusRepeaterSink(
                 c.prometheus_repeater_address, c.prometheus_network_type))
+        if c.signalfx_api_key:
+            from veneur_tpu.sinks.signalfx import SignalFxSink
+            self.metric_sinks.append(SignalFxSink(
+                c.signalfx_api_key, endpoint=c.signalfx_endpoint_base,
+                vary_key_by=c.signalfx_vary_key_by,
+                per_tag_api_keys=c.signalfx_per_tag_api_keys,
+                max_per_body=c.signalfx_flush_max_per_body,
+                hostname=c.hostname))
+        if c.newrelic_insert_key:
+            from veneur_tpu.sinks.newrelic import (NewRelicMetricSink,
+                                                   NewRelicSpanSink)
+            common = {k: v for k, _, v in
+                      (t.partition(":") for t in c.newrelic_common_tags)}
+            self.metric_sinks.append(NewRelicMetricSink(
+                c.newrelic_insert_key,
+                endpoint=c.newrelic_metric_endpoint,
+                common_attributes=common, interval=self.interval))
+            self.span_sinks.append(NewRelicSpanSink(
+                c.newrelic_insert_key,
+                endpoint=c.newrelic_trace_endpoint))
+        if c.kafka_broker:
+            from veneur_tpu.sinks.kafka import (KafkaMetricSink,
+                                                KafkaSpanSink)
+            self.metric_sinks.append(KafkaMetricSink(
+                c.kafka_broker, check_topic=c.kafka_check_topic,
+                event_topic=c.kafka_event_topic,
+                metric_topic=c.kafka_metric_topic))
+            if c.kafka_span_topic:
+                self.span_sinks.append(KafkaSpanSink(
+                    c.kafka_broker, span_topic=c.kafka_span_topic,
+                    serialization=c.kafka_span_serialization_format))
+        if c.datadog_trace_api_address:
+            from veneur_tpu.sinks.datadog import DatadogSpanSink
+            self.span_sinks.append(DatadogSpanSink(
+                c.datadog_trace_api_address, hostname=c.hostname))
+        if c.splunk_hec_address and c.splunk_hec_token:
+            from veneur_tpu.sinks.splunk import SplunkSpanSink
+            self.span_sinks.append(SplunkSpanSink(
+                c.splunk_hec_address, c.splunk_hec_token,
+                sample_rate=c.splunk_span_sample_rate,
+                hostname=c.hostname))
+        if c.xray_address:
+            from veneur_tpu.sinks.xray import XRaySpanSink
+            self.span_sinks.append(XRaySpanSink(
+                c.xray_address,
+                sample_percentage=c.xray_sample_percentage,
+                annotation_tags=tuple(c.xray_annotation_tags)))
+        if c.lightstep_access_token:
+            from veneur_tpu.sinks.lightstep import LightStepSpanSink
+            self.span_sinks.append(LightStepSpanSink(
+                c.lightstep_access_token,
+                collector_host=c.lightstep_collector_host))
+        if c.falconer_address:
+            from veneur_tpu.sinks.grpsink import FalconerSpanSink
+            self.span_sinks.append(FalconerSpanSink(c.falconer_address))
         if c.flush_file:
             self.plugins.append(LocalFilePlugin(c.flush_file,
                                                 c.hostname))
         if c.aws_s3_bucket:
-            self.plugins.append(S3ArchivePlugin(
-                c.aws_s3_bucket, spool_dir="s3_spool",
-                hostname=c.hostname, region=c.aws_region))
+            from veneur_tpu.sinks.s3 import S3Plugin
+            self.plugins.append(S3Plugin(
+                c.aws_s3_bucket, hostname=c.hostname,
+                region=c.aws_region, endpoint=c.aws_s3_endpoint,
+                access_key=c.aws_access_key_id,
+                secret_key=c.aws_secret_access_key))
         if c.sentry_dsn:
             # no sentry SDK in this build: honest no-op, loudly
             log.warning("sentry_dsn set but no sentry SDK is "

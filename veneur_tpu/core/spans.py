@@ -106,6 +106,7 @@ class SpanWorker:
 
     def stop(self) -> None:
         self._shutdown.set()
-        self._thread.join(timeout=1.0)
+        if self._thread.is_alive():
+            self._thread.join(timeout=1.0)
         for p in self._pools:
             p.shutdown(wait=False)

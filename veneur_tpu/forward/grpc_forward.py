@@ -171,13 +171,47 @@ class ImportServer:
             futures.ThreadPoolExecutor(max_workers=8),
             options=[("grpc.max_receive_message_length",
                       64 * 1024 * 1024)])
-        handler = grpc.method_handlers_generic_handler(
-            "forwardrpc.Forward",
-            {"SendMetrics": grpc.unary_unary_rpc_method_handler(
-                self._send_metrics,
-                request_deserializer=forward_pb2.MetricList.FromString,
-                response_serializer=empty_pb2.Empty.SerializeToString)})
-        self._grpc.add_generic_rpc_handlers((handler,))
+        from veneur_tpu.protocol.gen import (dogstatsd_grpc_pb2,
+                                             health_pb2, ssf_pb2)
+        self._health_pb2 = health_pb2
+        self._dsd_pb2 = dogstatsd_grpc_pb2
+        # one listener, four services — the reference serves forward
+        # import, SSF spans, DogStatsD packets and grpc health on the
+        # same port (networking.go:295-358 startGRPCTCP)
+        handlers = (
+            grpc.method_handlers_generic_handler(
+                "forwardrpc.Forward",
+                {"SendMetrics": grpc.unary_unary_rpc_method_handler(
+                    self._send_metrics,
+                    request_deserializer=(
+                        forward_pb2.MetricList.FromString),
+                    response_serializer=(
+                        empty_pb2.Empty.SerializeToString))}),
+            grpc.method_handlers_generic_handler(
+                "ssf.SSFGRPC",
+                {"SendSpan": grpc.unary_unary_rpc_method_handler(
+                    self._send_span,
+                    request_deserializer=ssf_pb2.SSFSpan.FromString,
+                    # ssf.Empty — zero fields, empty encoding
+                    response_serializer=lambda _: b"")}),
+            grpc.method_handlers_generic_handler(
+                "dogstatsd.DogstatsdGRPC",
+                {"SendPacket": grpc.unary_unary_rpc_method_handler(
+                    self._send_packet,
+                    request_deserializer=(
+                        dogstatsd_grpc_pb2.DogstatsdPacket.FromString),
+                    response_serializer=lambda _: b"")}),
+            grpc.method_handlers_generic_handler(
+                "grpc.health.v1.Health",
+                {"Check": grpc.unary_unary_rpc_method_handler(
+                    self._health_check,
+                    request_deserializer=(
+                        health_pb2.HealthCheckRequest.FromString),
+                    response_serializer=(
+                        health_pb2.HealthCheckResponse
+                        .SerializeToString))}),
+        )
+        self._grpc.add_generic_rpc_handlers(handlers)
         if credentials is not None:
             self.port = self._grpc.add_secure_port(address, credentials)
         else:
@@ -193,6 +227,30 @@ class ImportServer:
         if dropped:
             core.bump("metrics_dropped", dropped)
         return empty_pb2.Empty()
+
+    def _send_span(self, request, context):
+        """ssf.SSFGRPC/SendSpan (reference networking.go:321
+        grpcStatsServer.SendSpan -> handleSSF)."""
+        from veneur_tpu.protocol import wire
+        self._core.bump("received_ssf-grpc")
+        self._core.handle_ssf(wire.normalize_span(request))
+        return None  # ssf.Empty
+
+    def _send_packet(self, request, context):
+        """dogstatsd.DogstatsdGRPC/SendPacket (reference
+        networking.go:314 SendPacket -> processMetricPacket: the body
+        may hold many newline-separated lines)."""
+        self._core.bump("received_dogstatsd-grpc")
+        self._core.handle_packet(request.packetBytes)
+        return None  # dogstatsd.Empty
+
+    def _health_check(self, request, context):
+        """grpc.health.v1.Health/Check; the reference marks service
+        "veneur" SERVING (networking.go:340)."""
+        pb = self._health_pb2.HealthCheckResponse
+        if request.service in ("", "veneur"):
+            return pb(status=pb.SERVING)
+        return pb(status=pb.SERVICE_UNKNOWN)
 
     def start(self) -> None:
         self._grpc.start()

@@ -98,20 +98,6 @@ def record_batch(records: list[tuple[bytes | None, bytes]],
         ">hiqqqhii", 0, len(records) - 1, timestamp_ms, timestamp_ms,
         -1, -1, -1, len(records)) + recs
     crc = crc32c(after_crc)
-    head = struct.pack(">qi", 0, 4 + 1 + 4 + len(after_crc))
-    return head + struct.pack(">ib", -1, 2)[4:] + \
-        struct.pack(">i", -1) + b"\x02" + struct.pack(">I", crc) + \
-        after_crc
-
-
-# the above sliced struct is awkward; rebuild cleanly:
-def record_batch(records, timestamp_ms):  # noqa: F811
-    recs = b"".join(_record(v, k, i)
-                    for i, (k, v) in enumerate(records))
-    after_crc = struct.pack(
-        ">hiqqqhii", 0, len(records) - 1, timestamp_ms, timestamp_ms,
-        -1, -1, -1, len(records)) + recs
-    crc = crc32c(after_crc)
     # partitionLeaderEpoch(-1) + magic(2) + crc + payload
     tail = struct.pack(">ibI", -1, 2, crc) + after_crc
     # baseOffset + batchLength

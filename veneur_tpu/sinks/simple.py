@@ -1,5 +1,5 @@
 """Simple sinks: blackhole, debug-log, in-memory capture, and the
-localfile / s3-archive plugins.
+localfile plugin.  (The s3 plugin lives in ``sinks/s3.py``.)
 
 - blackhole: test/no-op (reference sinks/blackhole/blackhole.go:12)
 - debug: logs every flushed metric (reference sinks/debug, enabled by
@@ -8,16 +8,11 @@ localfile / s3-archive plugins.
   channel-capture sinks play in server_test.go)
 - localfile plugin: appends flush batches as TSV
   (reference plugins/localfile/localfile.go:32)
-- s3 plugin: TSV-gz archive per flush (reference plugins/s3/s3.go:35);
-  without AWS credentials/SDK in this environment it is a gated stub
-  that writes the same artifact to a local spool directory.
 """
 
 from __future__ import annotations
 
-import gzip
 import logging
-import os
 import time
 
 from veneur_tpu.core.metrics import InterMetric
@@ -104,24 +99,3 @@ class LocalFilePlugin:
             f.write(_tsv_rows(metrics, hostname or self.hostname))
 
 
-class S3ArchivePlugin:
-    """One gzipped TSV object per flush (reference plugins/s3).  With no
-    AWS SDK in the image, objects spool to ``spool_dir`` with the same
-    key layout (<hostname>/<ts>.tsv.gz) for an external shipper."""
-    name = "s3"
-
-    def __init__(self, bucket: str, spool_dir: str, hostname: str = "",
-                 region: str = ""):
-        self.bucket = bucket
-        self.region = region  # recorded for the external shipper
-        self.spool_dir = spool_dir
-        self.hostname = hostname
-
-    def flush(self, metrics: list[InterMetric],
-              hostname: str = "") -> None:
-        host = hostname or self.hostname or "unknown"
-        os.makedirs(os.path.join(self.spool_dir, host), exist_ok=True)
-        key = os.path.join(self.spool_dir, host,
-                           f"{int(time.time() * 1e9)}.tsv.gz")
-        with gzip.open(key, "wt") as f:
-            f.write(_tsv_rows(metrics, host))
