@@ -157,7 +157,7 @@ def test_histogram_roundtrip_preserves_quantiles():
     acc, dropped = apply_metric_list(
         dst, forward_pb2.MetricList(metrics=[m]))
     assert (acc, dropped) == (1, 0)
-    dst.device_step()
+    dst.device_step(final=True)
     import jax.numpy as jnp
     got = np.asarray(tdigest.quantile(
         dst.histo_means, dst.histo_weights,
@@ -181,7 +181,7 @@ def test_set_roundtrip_cardinality():
         rows_to_metric_list([row]).SerializeToString())
     dst = MetricTable(TableConfig())
     apply_metric_list(dst, ml)
-    dst.device_step()
+    dst.device_step(final=True)
     est = float(np.asarray(hll.estimate(dst.hll_regs))[0])
     assert est == pytest.approx(3000, rel=0.05)
 

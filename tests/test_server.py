@@ -231,13 +231,15 @@ def test_malformed_import_item_does_not_wedge_table(make_server):
     resp = json.loads(urllib.request.urlopen(req).read())
     assert resp["accepted"] == 1
     glob.flush_once()  # must not raise
-    assert any(x.name == "good" and x.value == 5.0
-               for x in gcap.metrics)
+    # sink delivery is async (flush pool): wait for it
+    assert _wait(lambda: any(x.name == "good" and x.value == 5.0
+                             for x in gcap.metrics))
     # table still functional afterwards
     _send_udp(glob, b"after:1|c")
     assert _wait(lambda: glob.stats["metrics_processed"] >= 1)
     glob.flush_once()
-    assert any(x.name == "after" for x in gcap.metrics)
+    assert _wait(lambda: any(x.name == "after"
+                             for x in gcap.metrics))
 
 
 def test_slow_sink_does_not_stall_flush_cadence(make_server):

@@ -181,3 +181,33 @@ def test_status_checks_host_side():
                         value=float(sc.status), tags=sc.tags))
     status = t.take_status()
     assert list(status.values())[0][0] == 0.0
+
+
+def test_histo_hot_row_spills_past_plane_width():
+    """One series receiving far more samples than histo_slots in an
+    interval: the plane path spills the excess into the iterative
+    ranked chunking (no recursion), and the digest still sees every
+    sample (weight total and quantiles stay exact-ish)."""
+    from veneur_tpu.core.table import MetricTable, TableConfig
+    from veneur_tpu.ops import tdigest
+
+    rng = np.random.default_rng(7)
+    n = 40_000  # >> histo_slots=64 for row 0
+    t = MetricTable(TableConfig(histo_rows=64, histo_slots=64))
+    rows = np.zeros(n, np.int32)
+    # a second, cool row keeps the batch "dense" so the plane path
+    # is selected (plane bytes < 12n)
+    rows[::4] = 1
+    vals = rng.gamma(2.0, 30.0, n).astype(np.float32)
+    t._histo_stage.append(rows, vals, np.ones(n, np.float32))
+    t.device_step(final=True)
+    stats = np.asarray(t.histo_stats)
+    assert stats[0, 0] == pytest.approx(3 * n / 4)  # weight col
+    assert stats[1, 0] == pytest.approx(n / 4)
+    q = np.asarray(tdigest.quantile(
+        t.histo_means, t.histo_weights,
+        np.asarray([0.5, 0.99], np.float32),
+        t.histo_stats[:, 1], t.histo_stats[:, 2]))
+    exact = np.quantile(vals[rows == 0], [0.5, 0.99])
+    assert q[0, 0] == pytest.approx(exact[0], rel=0.05)
+    assert q[0, 1] == pytest.approx(exact[1], rel=0.05)

@@ -564,7 +564,9 @@ class Server:
             else:
                 good.append(p)
         self.bump("packets_received", len(good))
-        pb = parser.parse(b"\n".join(good))
+        # views into the reader's own parser scratch: consumed fully
+        # (ingest + slow-path sweep) before this reader parses again
+        pb = parser.parse(b"\n".join(good), copy=False)
         with self.lock:
             processed, dropped = self.table.ingest_columns(pb)
             self._maybe_device_step_locked()

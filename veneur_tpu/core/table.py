@@ -49,9 +49,6 @@ from veneur_tpu.utils import hashing, intern
 _counter_dense_step = jax.jit(segment.counter_dense_update,
                               donate_argnums=0)
 _gauge_dense_step = jax.jit(segment.gauge_dense_update, donate_argnums=0)
-_histo_stats_step = jax.jit(segment.histo_stats_update, donate_argnums=0)
-_histo_stats_step_unit = jax.jit(segment.histo_stats_update_unit,
-                                 donate_argnums=0)
 _hll_step_packed = jax.jit(hll.insert_packed, donate_argnums=0)
 # global-tier merge steps (forwarded partial state; duplicates within a
 # batch reduce correctly because every column is an associative scatter)
@@ -91,6 +88,11 @@ class TableConfig:
     compression: float = 100.0
     histo_slots: int = 512  # max samples per row per merge call
     compact_threshold: float = 0.75
+    # histo samples accumulate across device steps and merge in ONE
+    # densify+cluster at the swap (or when this many are staged): the
+    # merge is two device sorts, so running it per reader batch did
+    # 10x the sort work for the same digests
+    histo_merge_samples: int = 4 << 20
 
 
 @dataclass
@@ -534,7 +536,11 @@ class MetricTable:
             self._gauge_dirty = True
         hn = int(meta[0])
         if hn:
-            self._histo_stage.append(hr[:hn], hv[:hn], hw[:hn])
+            # copy: the slices view n-sized scratch, and staging now
+            # holds them until the swap — a view would pin 12 bytes
+            # per parsed LINE for the interval, not per histo sample
+            self._histo_stage.append(hr[:hn].copy(), hv[:hn].copy(),
+                                     hw[:hn].copy())
         sn = int(meta[1])
         if sn:
             self._set_pos_rows.append(sr[:sn])
@@ -636,7 +642,7 @@ class MetricTable:
     # ------------------------------------------------------------------
     # device step
 
-    def device_step(self) -> None:
+    def device_step(self, final: bool = False) -> None:
         """Push all staged samples to the device as batched updates.
 
         Counters and gauges are pre-combined on host into dense per-row
@@ -644,7 +650,12 @@ class MetricTable:
         is associative addition and gauge merge is last-write), so the
         h2d transfer is O(rows) not O(samples).  Histo values must ship
         per-sample (the digest needs the distribution); sets ship 8
-        packed bytes per member."""
+        packed bytes per member.
+
+        Histo/digest staging is only flushed when ``final`` (the swap)
+        or past ``histo_merge_samples`` — the digest merge costs two
+        device sorts regardless of batch size, so per-step merging
+        multiplies sort work by the number of steps per interval."""
         c = self.config
         self._staged_n = 0
         if self._counter_dirty:
@@ -662,13 +673,15 @@ class MetricTable:
             self._gauge_mask.fill(0)
             self._gauge_dirty = False
 
-        batch = self._histo_stage.take()
-        if batch is not None:
-            self._histo_device_step(*batch, with_stats=True)
+        if final or len(self._histo_stage) >= c.histo_merge_samples:
+            batch = self._histo_stage.take()
+            if batch is not None:
+                self._histo_device_step(*batch, with_stats=True)
 
-        batch = self._digest_stage.take()
-        if batch is not None:
-            self._histo_device_step(*batch, with_stats=False)
+        if final or len(self._digest_stage) >= c.histo_merge_samples:
+            batch = self._digest_stage.take()
+            if batch is not None:
+                self._histo_device_step(*batch, with_stats=False)
 
         if self._set_rows or self._set_pos_rows:
             parts_rows, parts_pos = [], []
@@ -721,70 +734,168 @@ class MetricTable:
     def _histo_device_step(self, rows: np.ndarray, vals: np.ndarray,
                            wts: np.ndarray,
                            with_stats: bool = True) -> None:
-        """Histo ingest: local stats scatter + t-digest merge.  The
-        digest merge densifies at most ``histo_slots`` samples per row
-        per call, so heavy rows are split across multiple calls by
-        within-row rank (vectorized on host).  ``with_stats=False`` for
+        """Histo ingest: ONE fused device pass per batch — ranked
+        scatter into dense planes, local aggregates folded as plane
+        reductions, k-scale cluster into the digests
+        (tdigest.ingest_ranked).  The within-row rank comes from a host
+        O(n) counter pass (native vtpu_rank), so the device never
+        argsorts the sample batch.  Rows exceeding ``histo_slots``
+        samples split across calls by rank.  ``with_stats=False`` for
         imported centroids, whose stats arrive via the stat-row path."""
         c = self.config
         # unit-weight batches (no client sample-rate — the common case)
         # skip shipping the weights column entirely
         unit = bool(np.all(wts == 1.0))
-        b = _bucket_len(len(rows))
-        rows_dev = jnp.asarray(_pad_np(rows, b, c.histo_rows))
-        vals_dev = jnp.asarray(_pad_np(vals, b, 0.0))
-        if with_stats:
-            if unit:
-                self.histo_stats = _histo_stats_step_unit(
-                    self.histo_stats, rows_dev, vals_dev)
-            else:
-                self.histo_stats = _histo_stats_step(
-                    self.histo_stats, rows_dev, vals_dev,
-                    jnp.asarray(_pad_np(wts, b, 0.0)))
-
-        # densify drops samples past ``histo_slots`` per row per call,
-        # so batches where some row exceeds it must be split by
-        # within-row rank.  The rank computation needs a host argsort
-        # (~1s for 10M rows on one core) — skip it when the per-row max
-        # (one cheap bincount) already fits.
-        counts = np.bincount(rows) if len(rows) else np.zeros(1, np.int64)
-        if int(counts.max(initial=0)) <= c.histo_slots:
-            self._digest_merge(rows, vals, wts, unit,
-                               rows_dev=rows_dev, vals_dev=vals_dev)
+        if with_stats and self._lib is not None and len(rows):
+            handled, spill = self._histo_plane_step(rows, vals, wts,
+                                                    unit)
+            if handled:
+                if spill is None:
+                    return
+                # hot rows past the plane width fall through to the
+                # ranked path, which chunks ITERATIVELY (a recursive
+                # plane retry would strip only `width` samples of the
+                # hot row per level — quadratic work and a stack bomb)
+                rows, vals, wts = spill
+        rank, max_count = self._rank(rows)
+        if max_count <= c.histo_slots:
+            self._digest_merge(rows, vals, wts, rank, unit, with_stats)
             return
-
-        order = np.argsort(rows, kind="stable")
-        sorted_rows = rows[order]
-        first = np.ones(len(rows), dtype=bool)
-        first[1:] = sorted_rows[1:] != sorted_rows[:-1]
-        start = np.maximum.accumulate(
-            np.where(first, np.arange(len(rows)), 0))
-        rank = np.arange(len(rows)) - start
         chunk_of = rank // c.histo_slots
         n_chunks = int(chunk_of.max()) + 1 if len(rows) else 0
         for ci in range(n_chunks):
-            sel = order[chunk_of == ci]
-            self._digest_merge(rows[sel], vals[sel], wts[sel], unit)
+            sel = np.nonzero(chunk_of == ci)[0]
+            self._digest_merge(rows[sel], vals[sel], wts[sel],
+                               rank[sel] - ci * c.histo_slots, unit,
+                               with_stats)
 
-    def _digest_merge(self, rows, vals, wts, unit,
-                      rows_dev=None, vals_dev=None) -> None:
+    def _histo_plane_step(self, rows, vals, wts, unit):
+        """Host-densified plane ingest (native vtpu_dense_plane +
+        tdigest.ingest_plane*): ships R*W*4 plane bytes instead of
+        12 bytes/sample.  Returns (handled, spill): handled=False when
+        the batch is too sparse for the plane to be the smaller
+        transfer (the ranked path takes over); spill holds samples of
+        rows past the plane width — the CALLER routes them through the
+        iterative ranked chunking."""
+        import ctypes as ct
+        c = self.config
+        n = len(rows)
+        rows = np.ascontiguousarray(rows, np.int32)
+        counts_full = np.bincount(rows, minlength=c.histo_rows)
+        width = 8
+        while width < min(int(counts_full.max(initial=0)),
+                          c.histo_slots):
+            width <<= 1
+        width = min(width, c.histo_slots)
+        planes = 1 if unit else 2
+        if c.histo_rows * width * 4 * planes > 12 * n:
+            return False, None
+        f32p = ct.POINTER(ct.c_float)
+        i32p = ct.POINTER(ct.c_int32)
+        vals = np.ascontiguousarray(vals, np.float32)
+        plane_v = np.zeros((c.histo_rows, width), np.float32)
+        plane_w = (None if unit else
+                   np.zeros((c.histo_rows, width), np.float32))
+        counts = np.zeros(c.histo_rows, np.int32)
+        ov_rows = np.empty(n, np.int32)
+        ov_vals = np.empty(n, np.float32)
+        if unit:
+            wts_p = ov_wts_p = None
+            ov_wts = None
+        else:
+            wts = np.ascontiguousarray(wts, np.float32)
+            wts_p = wts.ctypes.data_as(f32p)
+            ov_wts = np.empty(n, np.float32)
+            ov_wts_p = ov_wts.ctypes.data_as(f32p)
+        spill = self._lib.vtpu_dense_plane(
+            rows.ctypes.data_as(i32p),
+            vals.ctypes.data_as(f32p), wts_p, n,
+            c.histo_rows, width,
+            plane_v.ctypes.data_as(f32p),
+            plane_w.ctypes.data_as(f32p) if plane_w is not None
+            else None,
+            counts.ctypes.data_as(i32p),
+            ov_rows.ctypes.data_as(i32p),
+            ov_vals.ctypes.data_as(f32p), ov_wts_p)
+        if unit:
+            (self.histo_means, self.histo_weights,
+             self.histo_stats) = tdigest.ingest_plane_unit(
+                self.histo_means, self.histo_weights,
+                self.histo_stats, jnp.asarray(counts),
+                jnp.asarray(plane_v), compression=c.compression)
+        else:
+            (self.histo_means, self.histo_weights,
+             self.histo_stats) = tdigest.ingest_plane(
+                self.histo_means, self.histo_weights,
+                self.histo_stats, jnp.asarray(plane_v),
+                jnp.asarray(plane_w), compression=c.compression)
+        if spill:
+            return True, (
+                ov_rows[:spill].copy(), ov_vals[:spill].copy(),
+                np.ones(spill, np.float32) if unit
+                else ov_wts[:spill].copy())
+        return True, None
+
+    def _rank(self, rows: np.ndarray) -> tuple[np.ndarray, int]:
+        """Within-row occurrence rank + max per-row count."""
+        n = len(rows)
+        rows = np.ascontiguousarray(rows, np.int32)
+        if self._lib is not None:
+            import ctypes as ct
+            i32p = ct.POINTER(ct.c_int32)
+            counts = np.zeros(self.config.histo_rows, np.int32)
+            rank = np.empty(n, np.int32)
+            self._lib.vtpu_rank(
+                rows.ctypes.data_as(i32p), n,
+                self.config.histo_rows,
+                counts.ctypes.data_as(i32p),
+                rank.ctypes.data_as(i32p))
+            return rank, int(counts.max(initial=0))
+        order = np.argsort(rows, kind="stable")
+        sorted_rows = rows[order]
+        first = np.ones(n, dtype=bool)
+        first[1:] = sorted_rows[1:] != sorted_rows[:-1]
+        start = np.maximum.accumulate(
+            np.where(first, np.arange(n), 0))
+        rank = np.empty(n, np.int32)
+        rank[order] = np.arange(n) - start
+        return rank, int(rank.max(initial=-1)) + 1
+
+    def _digest_merge(self, rows, vals, wts, rank, unit,
+                      with_stats) -> None:
         c = self.config
         b = _bucket_len(len(rows))
-        if rows_dev is None:
-            rows_dev = jnp.asarray(_pad_np(rows, b, c.histo_rows))
-            vals_dev = jnp.asarray(_pad_np(vals, b, 0.0))
-        if unit:
+        rows_dev = jnp.asarray(_pad_np(rows, b, c.histo_rows))
+        vals_dev = jnp.asarray(_pad_np(vals, b, 0.0))
+        rank_dev = jnp.asarray(_pad_np(rank, b, 0))
+        slots = min(c.histo_slots, b)
+        if with_stats:
+            if unit:
+                (self.histo_means, self.histo_weights,
+                 self.histo_stats) = tdigest.ingest_ranked_unit(
+                    self.histo_means, self.histo_weights,
+                    self.histo_stats, rows_dev, rank_dev, vals_dev,
+                    slots=slots, compression=c.compression)
+            else:
+                (self.histo_means, self.histo_weights,
+                 self.histo_stats) = tdigest.ingest_ranked(
+                    self.histo_means, self.histo_weights,
+                    self.histo_stats, rows_dev, rank_dev, vals_dev,
+                    jnp.asarray(_pad_np(wts, b, 0.0)),
+                    slots=slots, compression=c.compression)
+        elif unit:
             self.histo_means, self.histo_weights = \
-                tdigest.add_samples_unit(
+                tdigest.add_samples_ranked_unit(
                     self.histo_means, self.histo_weights, rows_dev,
-                    vals_dev, slots=min(c.histo_slots, b),
+                    rank_dev, vals_dev, slots=slots,
                     compression=c.compression)
         else:
-            self.histo_means, self.histo_weights = tdigest.add_samples(
-                self.histo_means, self.histo_weights, rows_dev,
-                vals_dev, jnp.asarray(_pad_np(wts, b, 0.0)),
-                slots=min(c.histo_slots, b),
-                compression=c.compression)
+            self.histo_means, self.histo_weights = \
+                tdigest.add_samples_ranked(
+                    self.histo_means, self.histo_weights, rows_dev,
+                    rank_dev, vals_dev,
+                    jnp.asarray(_pad_np(wts, b, 0.0)),
+                    slots=slots, compression=c.compression)
 
     # ------------------------------------------------------------------
     # flush boundary
@@ -792,7 +903,7 @@ class MetricTable:
     def swap(self) -> Snapshot:
         """End the interval: push remaining staging, hand the device
         arrays to the caller, re-seed fresh state, maybe compact."""
-        self.device_step()
+        self.device_step(final=True)
         # the native ingest marks touched[] but defers last_gen (gen is
         # constant within an interval, so one vectorized stamp here is
         # equivalent to stamping per batch)

@@ -89,6 +89,13 @@ def load() -> ctypes.CDLL | None:
         lib.vtpu_index_count.argtypes = [vp]
         lib.vtpu_index_lookup.restype = None
         lib.vtpu_index_lookup.argtypes = [vp, u64p, i64, i32p]
+        lib.vtpu_rank.restype = None
+        lib.vtpu_rank.argtypes = [i32p, i64, ctypes.c_int32, i32p,
+                                  i32p]
+        lib.vtpu_dense_plane.restype = i64
+        lib.vtpu_dense_plane.argtypes = [
+            i32p, f32p, f32p, i64, ctypes.c_int32, ctypes.c_int32,
+            f32p, f32p, i32p, i32p, f32p, f32p]
         lib.vtpu_ingest.restype = None
         lib.vtpu_ingest.argtypes = [
             vp, u64p, u8p, f64p, u64p, f32p, i64, i64p, i64, i64,

@@ -540,4 +540,56 @@ void vtpu_ingest(
   meta[5] += gn;
 }
 
+// Within-row occurrence rank: rank[i] = number of earlier samples with
+// the same row id.  One O(n) pass with a per-row counter — replaces
+// the device-side argsort in the t-digest densify (a 1M-element
+// bitonic sort costs ~0.6s on the device; this pass is ~5ms on host).
+// counts must be zeroed, length n_rows; out-of-range rows get rank 0.
+void vtpu_rank(const int32_t* rows, int64_t n, int32_t n_rows,
+               int32_t* counts, int32_t* rank) {
+  for (int64_t i = 0; i < n; i++) {
+    int32_t r = rows[i];
+    if (r < 0 || r >= n_rows) {
+      rank[i] = 0;
+      continue;
+    }
+    rank[i] = counts[r]++;
+  }
+}
+
+// Densify a histo sample batch directly into a host (n_rows, width)
+// value plane (plus optional weight plane), one O(n) counting pass.
+// The device then receives the PLANE (R*width*4 bytes) instead of
+// 12 bytes/sample — on a narrow host<->device link the plane is the
+// smaller transfer whenever the batch is dense — and skips the
+// scatter: occupancy is derivable from counts.  Samples beyond
+// ``width`` for a row spill to the ov_* arrays for a follow-up call.
+// plane_v/plane_w and counts must be zeroed by the caller; returns
+// the spill count.  Out-of-range rows are dropped (counted upstream).
+int64_t vtpu_dense_plane(const int32_t* rows, const float* vals,
+                         const float* wts,  // null => unit weights
+                         int64_t n, int32_t n_rows, int32_t width,
+                         float* plane_v, float* plane_w,  // w nullable
+                         int32_t* counts,
+                         int32_t* ov_rows, float* ov_vals,
+                         float* ov_wts) {
+  int64_t spill = 0;
+  for (int64_t i = 0; i < n; i++) {
+    int32_t r = rows[i];
+    if (r < 0 || r >= n_rows) continue;
+    int32_t c = counts[r];
+    if (c >= width) {
+      ov_rows[spill] = r;
+      ov_vals[spill] = vals[i];
+      if (wts) ov_wts[spill] = wts[i];
+      spill++;
+      continue;
+    }
+    plane_v[(int64_t)r * width + c] = vals[i];
+    if (wts) plane_w[(int64_t)r * width + c] = wts[i];
+    counts[r] = c + 1;
+  }
+  return spill;
+}
+
 }  // extern "C"
