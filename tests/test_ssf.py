@@ -248,3 +248,59 @@ def test_empty_ssf_dropped(ssf_server):
     sock.sendto(bad.SerializeToString(),
                 ("127.0.0.1", server.ssf_ports[0]))
     assert _wait(lambda: server.stats.get("empty_ssf", 0) >= 1)
+
+
+def test_emit_cli_ssf_mode(ssf_server):
+    """veneur-emit -ssf sends a span datagram whose samples land as
+    metrics (reference cmd/veneur-emit SSF mode)."""
+    from veneur_tpu.cli import emit
+
+    server, cap, scap = ssf_server
+    rc = emit.main([
+        "-hostport", f"udp://127.0.0.1:{server.ssf_ports[0]}",
+        "-name", "emit.ssf.ctr", "-count", "4",
+        "-tag", "who:emit", "-ssf",
+        "-span-service", "emitsvc"])
+    assert rc == 0
+    assert _wait(lambda: server.stats.get("received_ssf-udp", 0) >= 1)
+    assert _wait(lambda: any(s.service == "emitsvc"
+                             for s in scap.spans))
+    server.flush_once()
+    assert _wait(lambda: any(m.name == "emit.ssf.ctr" and m.value == 4
+                             for m in cap.metrics))
+
+
+def test_emit_cli_grpc_modes():
+    """veneur-emit -grpc covers both DogstatsdGRPC packets and (with
+    -ssf) SSFGRPC spans."""
+    import pytest as _pytest
+    _pytest.importorskip("grpc")
+    from veneur_tpu.cli import emit
+    from veneur_tpu.core.config import read_config
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.sinks.simple import CaptureSink
+
+    cap = CaptureSink()
+    server = Server(read_config(data={
+        "grpc_listen_addresses": ["tcp://127.0.0.1:0"],
+        "interval": "10s", "hostname": "g"}), extra_sinks=[cap])
+    server.start()
+    try:
+        hostport = f"127.0.0.1:{server.grpc_ports[0]}"
+        assert emit.main(["-hostport", hostport, "-name",
+                          "emit.grpc.ctr", "-count", "2",
+                          "-grpc"]) == 0
+        assert server.stats["received_dogstatsd-grpc"] == 1
+        assert emit.main(["-hostport", hostport, "-name",
+                          "emit.grpc.span", "-timing", "12.5",
+                          "-ssf", "-grpc"]) == 0
+        assert server.stats["received_ssf-grpc"] == 1
+        assert _wait(lambda: server.stats["metrics_processed"] >= 2)
+        server.flush_once()
+        assert _wait(lambda: any(m.name == "emit.grpc.ctr"
+                                 for m in cap.metrics))
+        assert _wait(lambda: any(
+            m.name.startswith("emit.grpc.span")
+            for m in cap.metrics))
+    finally:
+        server.shutdown()

@@ -72,6 +72,114 @@ def run_bench(sock, datagram: bool, count: int, names: int,
     return time.perf_counter() - start
 
 
+def _build_span(args, samples, tags: dict, start_ns: int,
+                end_ns: int):
+    """SSFSpan wrapping the requested samples (and/or command timing),
+    the shape the reference's -ssf mode produces."""
+    from veneur_tpu.protocol.gen import ssf_pb2
+    span = ssf_pb2.SSFSpan(
+        trace_id=args.trace_id or random.getrandbits(63),
+        id=random.getrandbits(63),
+        parent_id=args.parent_span_id,
+        service=args.span_service,
+        name=args.span_name or args.name or "veneur-emit",
+        start_timestamp=start_ns, end_timestamp=end_ns,
+        indicator=args.indicator, error=args.error)
+    span.metrics.extend(samples)
+    for k, v in tags.items():
+        span.tags[k] = v
+    return span
+
+
+def _emit_ssf_or_grpc(args) -> int:
+    """-ssf / -grpc sends: SSF span datagrams, or gRPC unary calls to
+    the server's DogstatsdGRPC / SSFGRPC services."""
+    from veneur_tpu.trace import metrics as tm
+
+    if args.name is None and not args.command:
+        print("need -name (or -command)", file=sys.stderr)
+        return 1
+    # open/validate the transport BEFORE running -command, so a bad
+    # hostport can't execute a side-effecting command and then lose
+    # its metric and exit code
+    sock = None
+    if not args.grpc:
+        sock, datagram = _open(args.hostport)
+        if not datagram:
+            print("-ssf needs a datagram transport (udp/unixgram)",
+                  file=sys.stderr)
+            return 1
+
+    rc = 0
+    tags = {k: v for k, _, v in (t.partition(":") for t in args.tag)}
+    samples = []
+    if args.count is not None:
+        samples.append(tm.count(args.name, args.count, tags,
+                                sample_rate=args.rate))
+    if args.gauge is not None:
+        samples.append(tm.gauge(args.name, args.gauge, tags))
+    if args.timing is not None:
+        samples.append(tm.timing(args.name, args.timing / 1000.0,
+                                 tags, sample_rate=args.rate))
+    if args.set is not None:
+        samples.append(tm.set_sample(args.name, args.set, tags))
+    start_ns = time.time_ns()
+    command_ms = None
+    if args.command:
+        t0 = time.perf_counter()
+        rc = subprocess.call(args.command)
+        command_ms = (time.perf_counter() - t0) * 1000.0
+        samples.append(tm.timing(args.name or "command.duration",
+                                 command_ms / 1000.0, tags))
+    end_ns = time.time_ns()
+
+    if args.grpc and not args.ssf:
+        # plain statsd lines over DogstatsdGRPC.SendPacket.  Rate
+        # applies only to counters/timers, matching the plain path.
+        import grpc as grpclib
+
+        from veneur_tpu.protocol.gen import dogstatsd_grpc_pb2 as dpb
+        lines = []
+        for kind, val, rate in (("c", args.count, args.rate),
+                                ("g", args.gauge, 1.0),
+                                ("ms", args.timing, args.rate),
+                                ("s", args.set, 1.0)):
+            if val is not None:
+                lines.append(build_line(args.name, val, kind,
+                                        args.tag, rate))
+        if command_ms is not None:
+            lines.append(build_line(
+                args.name or "command.duration",
+                round(command_ms, 3), "ms", args.tag))
+        chan = grpclib.insecure_channel(args.hostport)
+        send = chan.unary_unary(
+            "/dogstatsd.DogstatsdGRPC/SendPacket",
+            request_serializer=(
+                dpb.DogstatsdPacket.SerializeToString),
+            response_deserializer=dpb.Empty.FromString)
+        send(dpb.DogstatsdPacket(packetBytes=b"\n".join(lines)),
+             timeout=10)
+        chan.close()
+        return rc
+
+    span = _build_span(args, samples, tags, start_ns, end_ns)
+    if args.grpc:
+        import grpc as grpclib
+
+        from veneur_tpu.protocol.gen import dogstatsd_grpc_pb2 as dpb
+        from veneur_tpu.protocol.gen import ssf_pb2
+        chan = grpclib.insecure_channel(args.hostport)
+        send = chan.unary_unary(
+            "/ssf.SSFGRPC/SendSpan",
+            request_serializer=ssf_pb2.SSFSpan.SerializeToString,
+            response_deserializer=dpb.Empty.FromString)
+        send(span, timeout=10)
+        chan.close()
+    else:
+        sock.send(span.SerializeToString())
+    return rc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="veneur-emit")
     ap.add_argument("-hostport", required=True)
@@ -87,7 +195,21 @@ def main(argv=None) -> int:
     ap.add_argument("-bench-count", type=int)
     ap.add_argument("-bench-names", type=int, default=1000)
     ap.add_argument("-bench-type", default="c")
+    # SSF / gRPC modes (reference cmd/veneur-emit -ssf and gRPC flags)
+    ap.add_argument("-ssf", action="store_true",
+                    help="send as an SSF span with attached samples")
+    ap.add_argument("-grpc", action="store_true",
+                    help="send over gRPC (DogstatsdGRPC / SSFGRPC)")
+    ap.add_argument("-span-service", default="veneur-emit")
+    ap.add_argument("-span-name", default="")
+    ap.add_argument("-trace-id", type=int, default=0)
+    ap.add_argument("-parent-span-id", type=int, default=0)
+    ap.add_argument("-indicator", action="store_true")
+    ap.add_argument("-error", action="store_true")
     args = ap.parse_args(argv)
+
+    if args.ssf or args.grpc:
+        return _emit_ssf_or_grpc(args)
 
     sock, datagram = _open(args.hostport)
 
