@@ -174,7 +174,7 @@ def test_set_roundtrip_cardinality():
     src = MetricTable(TableConfig())
     for mem in members:
         src.ingest(dsd.Sample(name="uniq", type=dsd.SET, value=mem))
-    src.device_step()
+    src.device_step(final=True)
     regs = np.asarray(src.hll_regs)[0]
     row = ForwardRow(_meta("uniq", dsd.SET), "set", regs=regs)
     ml = forward_pb2.MetricList.FromString(

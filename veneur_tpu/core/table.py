@@ -50,6 +50,7 @@ _counter_dense_step = jax.jit(segment.counter_dense_update,
                               donate_argnums=0)
 _gauge_dense_step = jax.jit(segment.gauge_dense_update, donate_argnums=0)
 _hll_step_packed = jax.jit(hll.insert_packed, donate_argnums=0)
+_hll_union_plane = jax.jit(hll.union, donate_argnums=0)
 # global-tier merge steps (forwarded partial state; duplicates within a
 # batch reduce correctly because every column is an associative scatter)
 _histo_stats_merge = jax.jit(segment.merge_histo_stats, donate_argnums=0)
@@ -88,10 +89,11 @@ class TableConfig:
     compression: float = 100.0
     histo_slots: int = 512  # max samples per row per merge call
     compact_threshold: float = 0.75
-    # histo samples accumulate across device steps and merge in ONE
-    # densify+cluster at the swap (or when this many are staged): the
-    # merge is two device sorts, so running it per reader batch did
-    # 10x the sort work for the same digests
+    # histo AND set samples accumulate across device steps and flush
+    # in ONE device pass at the swap (or when this many are staged):
+    # per-reader-batch digest merges did 10x the cluster work for the
+    # same digests, and whole-interval set batches dedup into a
+    # register plane (one h2d plane beats 8 bytes/member)
     histo_merge_samples: int = 4 << 20
 
 
@@ -543,8 +545,10 @@ class MetricTable:
                                      hw[:hn].copy())
         sn = int(meta[1])
         if sn:
-            self._set_pos_rows.append(sr[:sn])
-            self._set_pos.append(sp[:sn])
+            # copy: sr/sp are n-sized per-call scratch and set staging
+            # now holds entries until the swap (see _histo_stage note)
+            self._set_pos_rows.append(sr[:sn].copy())
+            self._set_pos.append(sp[:sn].copy())
         self._staged_n += processed - dropped
         return processed, dropped
 
@@ -648,14 +652,15 @@ class MetricTable:
         Counters and gauges are pre-combined on host into dense per-row
         vectors (duplicate rows collapse — legal because counter merge
         is associative addition and gauge merge is last-write), so the
-        h2d transfer is O(rows) not O(samples).  Histo values must ship
-        per-sample (the digest needs the distribution); sets ship 8
-        packed bytes per member.
+        h2d transfer is O(rows) not O(samples).  Histo values ship as
+        a host-densified value plane when dense enough, else
+        per-sample; sets ship either a host-folded register plane or
+        8 packed bytes per member (whichever is smaller).
 
-        Histo/digest staging is only flushed when ``final`` (the swap)
-        or past ``histo_merge_samples`` — the digest merge costs two
-        device sorts regardless of batch size, so per-step merging
-        multiplies sort work by the number of steps per interval."""
+        Histo/digest AND set staging only flush when ``final`` (the
+        swap) or past ``histo_merge_samples`` — per-step digest merges
+        multiply cluster work by the number of steps per interval, and
+        whole-interval set batches dedup into the register plane."""
         c = self.config
         self._staged_n = 0
         if self._counter_dirty:
@@ -683,7 +688,10 @@ class MetricTable:
             if batch is not None:
                 self._histo_device_step(*batch, with_stats=False)
 
-        if self._set_rows or self._set_pos_rows:
+        staged_sets = (len(self._set_rows) +
+                       sum(len(r) for r in self._set_pos_rows))
+        if (staged_sets and
+                (final or staged_sets >= c.histo_merge_samples)):
             parts_rows, parts_pos = [], []
             if self._set_rows:
                 idx, rank = hashing.hash_members(self._set_members)
@@ -694,13 +702,14 @@ class MetricTable:
                 parts_rows.extend(self._set_pos_rows)
                 parts_pos.extend(self._set_pos)
                 self._set_pos_rows, self._set_pos = [], []
-            rows = np.concatenate(parts_rows)
-            pos = np.concatenate(parts_pos)
-            b = _bucket_len(len(rows))
-            self.hll_regs = _hll_step_packed(
-                self.hll_regs,
-                jnp.asarray(_pad_np(rows, b, c.set_rows)),
-                jnp.asarray(_pad_np(pos, b, 0)))
+            srows = np.concatenate(parts_rows)
+            spos = np.concatenate(parts_pos)
+            if not self._hll_plane_step(srows, spos):
+                b = _bucket_len(len(srows))
+                self.hll_regs = _hll_step_packed(
+                    self.hll_regs,
+                    jnp.asarray(_pad_np(srows, b, c.set_rows)),
+                    jnp.asarray(_pad_np(spos, b, 0)))
 
         if self._stats_import_rows:
             rows = np.asarray(self._stats_import_rows, np.int32)
@@ -835,6 +844,31 @@ class MetricTable:
                 np.ones(spill, np.float32) if unit
                 else ov_wts[:spill].copy())
         return True, None
+
+    def _hll_plane_step(self, rows: np.ndarray, pos: np.ndarray
+                        ) -> bool:
+        """Fold the interval's packed member positions into a host
+        register plane (native vtpu_hll_plane) and union it on device
+        with one elementwise max — ships R*16384 plane bytes instead
+        of 8 bytes/member.  Returns False when the batch is small
+        enough that the packed scatter is the smaller transfer."""
+        import ctypes as ct
+        c = self.config
+        n = len(rows)
+        if (self._lib is None or
+                c.set_rows * hll.M > 8 * n):
+            return False
+        rows = np.ascontiguousarray(rows, np.int32)
+        pos = np.ascontiguousarray(pos, np.int32)
+        plane = np.zeros((c.set_rows, hll.M), np.uint8)
+        i32p = ct.POINTER(ct.c_int32)
+        self._lib.vtpu_hll_plane(
+            rows.ctypes.data_as(i32p), pos.ctypes.data_as(i32p), n,
+            c.set_rows, hll.M,
+            plane.ctypes.data_as(ct.POINTER(ct.c_uint8)))
+        self.hll_regs = _hll_union_plane(self.hll_regs,
+                                         jnp.asarray(plane))
+        return True
 
     def _rank(self, rows: np.ndarray) -> tuple[np.ndarray, int]:
         """Within-row occurrence rank + max per-row count."""

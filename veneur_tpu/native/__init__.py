@@ -96,6 +96,9 @@ def load() -> ctypes.CDLL | None:
         lib.vtpu_dense_plane.argtypes = [
             i32p, f32p, f32p, i64, ctypes.c_int32, ctypes.c_int32,
             f32p, f32p, i32p, i32p, f32p, f32p]
+        lib.vtpu_hll_plane.restype = None
+        lib.vtpu_hll_plane.argtypes = [
+            i32p, i32p, i64, ctypes.c_int32, ctypes.c_int32, u8p]
         lib.vtpu_ingest.restype = None
         lib.vtpu_ingest.argtypes = [
             vp, u64p, u8p, f64p, u64p, f32p, i64, i64p, i64, i64,

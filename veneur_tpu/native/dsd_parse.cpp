@@ -592,4 +592,23 @@ int64_t vtpu_dense_plane(const int32_t* rows, const float* vals,
   return spill;
 }
 
+// Fold packed HLL member positions ((reg_idx << 6) | rank) into a
+// host (n_rows, m) register plane with byte-max — the whole
+// interval's set traffic then ships as ONE m-byte plane per row
+// instead of 8 bytes per member, and the device union is an
+// elementwise max instead of a scatter.  plane must be zeroed.
+void vtpu_hll_plane(const int32_t* rows, const int32_t* packed,
+                    int64_t n, int32_t n_rows, int32_t m,
+                    uint8_t* plane) {
+  for (int64_t i = 0; i < n; i++) {
+    int32_t r = rows[i];
+    if (r < 0 || r >= n_rows) continue;
+    int32_t idx = packed[i] >> 6;
+    uint8_t rank = (uint8_t)(packed[i] & 0x3F);
+    if (idx < 0 || idx >= m) continue;
+    uint8_t* p = plane + (int64_t)r * m + idx;
+    if (*p < rank) *p = rank;
+  }
+}
+
 }  // extern "C"
