@@ -211,3 +211,39 @@ def test_histo_hot_row_spills_past_plane_width():
     exact = np.quantile(vals[rows == 0], [0.5, 0.99])
     assert q[0, 0] == pytest.approx(exact[0], rel=0.05)
     assert q[0, 1] == pytest.approx(exact[1], rel=0.05)
+
+
+def test_stale_import_stats_do_not_leak_across_intervals():
+    """Interval N imports a forwarded digest; interval N+1 gets only
+    raw samples.  N+1's snapshot must NOT re-contain N's imported
+    stats (lazy state reinit freshens all histo planes together)."""
+    from veneur_tpu.core.table import MetricTable, TableConfig
+    from veneur_tpu.ops import segment
+
+    t = MetricTable(TableConfig())
+    stats = np.asarray([10.0, 1.0, 9.0, 50.0, 2.0], np.float32)
+    assert t.import_histo("lat", "timer", (), stats,
+                          np.asarray([5.0], np.float32),
+                          np.asarray([10.0], np.float32))
+    t.device_step(final=True)
+    snap1 = t.swap()
+    assert np.asarray(snap1.histo_import_stats)[0, 0] == 10.0
+
+    # interval N+1: raw samples only
+    t._histo_stage.append(np.zeros(4, np.int32),
+                          np.asarray([1, 2, 3, 4], np.float32),
+                          np.ones(4, np.float32))
+    t.device_step(final=True)
+    snap2 = t.swap()
+    # import plane is fresh zeros; local stats hold only the 4 samples
+    assert np.asarray(snap2.histo_import_stats)[0, 0] == 0.0
+    assert np.asarray(snap2.histo_stats)[0, 0] == 4.0
+    # and the reverse: an import-only interval must not resurrect the
+    # previous interval's local samples
+    assert t.import_histo("lat", "timer", (), stats,
+                          np.asarray([5.0], np.float32),
+                          np.asarray([10.0], np.float32))
+    t.device_step(final=True)
+    snap3 = t.swap()
+    assert np.asarray(snap3.histo_stats)[0, 0] == 0.0
+    assert np.asarray(snap3.histo_import_stats)[0, 0] == 10.0

@@ -287,15 +287,43 @@ class MetricTable:
 
         self._init_state()
 
+    _KINDS = ("counter", "gauge", "histo", "hll")
+
     def _init_state(self):
+        self._fresh: set = set()
+        for kind in self._KINDS:
+            self._alloc_state(kind)
+
+    def _alloc_state(self, kind: str) -> None:
         c = self.config
-        self.counters = segment.empty_counter_state(c.counter_rows)
-        self.gauges = segment.empty_gauge_state(c.gauge_rows)
-        self.histo_stats = segment.empty_histo_stats(c.histo_rows)
-        self.histo_import_stats = segment.empty_histo_stats(c.histo_rows)
-        self.histo_means, self.histo_weights = tdigest.empty_state(
-            c.histo_rows, self.capacity)
-        self.hll_regs = hll.empty_state(c.set_rows)
+        if kind == "counter":
+            self.counters = segment.empty_counter_state(c.counter_rows)
+        elif kind == "gauge":
+            self.gauges = segment.empty_gauge_state(c.gauge_rows)
+        elif kind == "histo":
+            # ALL FOUR histo planes freshen as one kind: the flusher
+            # reads local + import stats under one touched gate, so a
+            # split freshness would let a stale import plane from a
+            # prior interval leak into every later flush
+            self.histo_stats = segment.empty_histo_stats(c.histo_rows)
+            self.histo_import_stats = segment.empty_histo_stats(
+                c.histo_rows)
+            self.histo_means, self.histo_weights = tdigest.empty_state(
+                c.histo_rows, self.capacity)
+        elif kind == "hll":
+            self.hll_regs = hll.empty_state(c.set_rows)
+
+    def _ensure_fresh(self, kind: str) -> None:
+        """Lazy per-type state reinit.  After a swap the old planes
+        belong to the snapshot; a type is only given NEW zeroed planes
+        when something actually touches it — per-kernel dispatch on
+        the tunnel link costs ~10ms, so re-zeroing every state family
+        every interval dominated sparse intervals.  Alloc BEFORE
+        discarding from _fresh so an allocation failure can't leave
+        the table aliasing (and later donating) a snapshot's plane."""
+        if kind in self._fresh:
+            self._alloc_state(kind)
+            self._fresh.discard(kind)
 
     # ------------------------------------------------------------------
     # ingest
@@ -664,12 +692,14 @@ class MetricTable:
         c = self.config
         self._staged_n = 0
         if self._counter_dirty:
+            self._ensure_fresh("counter")
             self.counters = _counter_dense_step(
                 self.counters, self._counter_dense.astype(np.float32))
             self._counter_dense.fill(0.0)
             self._counter_dirty = False
 
         if self._gauge_dirty:
+            self._ensure_fresh("gauge")
             # .copy(): the h2d transfer is async and the staging buffer
             # is mutated by the very next ingest
             self.gauges = _gauge_dense_step(
@@ -705,6 +735,7 @@ class MetricTable:
             srows = np.concatenate(parts_rows)
             spos = np.concatenate(parts_pos)
             if not self._hll_plane_step(srows, spos):
+                self._ensure_fresh("hll")
                 b = _bucket_len(len(srows))
                 self.hll_regs = _hll_step_packed(
                     self.hll_regs,
@@ -720,6 +751,7 @@ class MetricTable:
             b = _bucket_len(len(rows), wide=True)
             padded = np.zeros((b, vals.shape[1]), np.float32)
             padded[:len(vals)] = vals
+            self._ensure_fresh("histo")
             self.histo_import_stats = _histo_stats_merge(
                 self.histo_import_stats,
                 jnp.asarray(_pad_np(rows, b, c.histo_rows)),
@@ -735,6 +767,7 @@ class MetricTable:
             b = _bucket_len(len(rows), wide=True)
             padded = np.zeros((b, regs.shape[1]), np.uint8)
             padded[:len(regs)] = regs
+            self._ensure_fresh("hll")
             self.hll_regs = _hll_merge_rows(
                 self.hll_regs,
                 jnp.asarray(_pad_np(rows, b, c.set_rows)),
@@ -826,6 +859,7 @@ class MetricTable:
             counts.ctypes.data_as(i32p),
             ov_rows.ctypes.data_as(i32p),
             ov_vals.ctypes.data_as(f32p), ov_wts_p)
+        self._ensure_fresh("histo")
         if unit:
             (self.histo_means, self.histo_weights,
              self.histo_stats) = tdigest.ingest_plane_unit(
@@ -866,6 +900,7 @@ class MetricTable:
             rows.ctypes.data_as(i32p), pos.ctypes.data_as(i32p), n,
             c.set_rows, hll.M,
             plane.ctypes.data_as(ct.POINTER(ct.c_uint8)))
+        self._ensure_fresh("hll")
         self.hll_regs = _hll_union_plane(self.hll_regs,
                                          jnp.asarray(plane))
         return True
@@ -898,6 +933,7 @@ class MetricTable:
     def _digest_merge(self, rows, vals, wts, rank, unit,
                       with_stats) -> None:
         c = self.config
+        self._ensure_fresh("histo")
         b = _bucket_len(len(rows))
         rows_dev = jnp.asarray(_pad_np(rows, b, c.histo_rows))
         vals_dev = jnp.asarray(_pad_np(vals, b, 0.0))
@@ -968,7 +1004,12 @@ class MetricTable:
                 "set": self.set_idx.overflow,
             },
         )
-        self._init_state()
+        # the old planes belong to the snapshot now; fresh ones are
+        # allocated lazily on first touch (see _ensure_fresh) — a
+        # snapshot of an untouched type keeps referencing the pristine
+        # plane, which is never donated because the first touch of the
+        # NEXT interval allocates a new one before any donating update
+        self._fresh = set(self._KINDS)
         self.gen += 1
         compacted = False
         for idx in (self.counter_idx, self.gauge_idx, self.histo_idx,
