@@ -327,3 +327,40 @@ def test_quitquitquit_disabled_by_default():
         assert not server._shutdown.is_set()
     finally:
         server.shutdown()
+
+
+def test_einhorn_socket_adoption(monkeypatch, tmp_path, make_server):
+    """http_address: einhorn@0 adopts a pre-bound listening socket
+    from the EINHORN_FD_0 env var and acks the master over its
+    control socket (reference README 'Einhorn Usage')."""
+    import json
+    import socket as socketlib
+    import urllib.request
+
+    lsock = socketlib.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(8)
+    port = lsock.getsockname()[1]
+
+    ctrl = socketlib.socket(socketlib.AF_UNIX,
+                            socketlib.SOCK_STREAM)
+    ctrl_path = str(tmp_path / "einhorn.sock")
+    ctrl.bind(ctrl_path)
+    ctrl.listen(1)
+    ctrl.settimeout(10)  # a missing ack should fail, not hang
+
+    monkeypatch.setenv("EINHORN_FD_0", str(lsock.fileno()))
+    monkeypatch.setenv("EINHORN_SOCK_PATH", ctrl_path)
+    srv, _ = make_server(http_address="einhorn@0", interval="10s")
+    try:
+        conn, _ = ctrl.accept()
+        ack = json.loads(conn.recv(4096).decode())
+        assert ack["command"] == "worker:ack"
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthcheck",
+            timeout=5).read()
+        assert body == b"ok"
+    finally:
+        srv.shutdown()
+        ctrl.close()
+        lsock.close()

@@ -776,13 +776,53 @@ class Server:
                 else:
                     self.send_error(404)
 
-        self._httpd = http.server.ThreadingHTTPServer(
-            (host or "127.0.0.1", int(port)), Handler)
+        if address.startswith("einhorn@"):
+            # adopt the listening socket einhorn inherited to us
+            # (reference README 'Einhorn Usage': http_address
+            # einhorn@0 via goji/bind) and ACK the master so it stops
+            # routing to the old worker
+            from veneur_tpu.protocol.addr import parse_addr
+            _, _, fd_idx, _ = parse_addr(address)
+            fd = int(os.environ[f"EINHORN_FD_{fd_idx}"])
+            sock = socket.fromfd(fd, socket.AF_INET,
+                                 socket.SOCK_STREAM)
+            self._httpd = http.server.ThreadingHTTPServer(
+                sock.getsockname()[:2], Handler,
+                bind_and_activate=False)
+            # TCPServer.__init__ created a placeholder socket even
+            # with bind_and_activate=False: close it before adopting
+            self._httpd.socket.close()
+            self._httpd.socket = sock
+            # bind_and_activate=False skipped server_bind, which is
+            # what fills in the name/port attributes
+            (self._httpd.server_name,
+             self._httpd.server_port) = sock.getsockname()[:2]
+            self._einhorn_ack()
+        else:
+            self._httpd = http.server.ThreadingHTTPServer(
+                (host or "127.0.0.1", int(port)), Handler)
         self.http_port = self._httpd.server_port
         t = threading.Thread(target=self._httpd.serve_forever,
                              daemon=True, name="http")
         t.start()
         self._threads.append(t)
+
+    def _einhorn_ack(self) -> None:
+        """Send the worker ack over einhorn's control socket (the
+        einhorn worker protocol; goji/bind does the same on adopt)."""
+        path = os.environ.get("EINHORN_SOCK_PATH")
+        if not path:
+            return
+        try:
+            c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            c.settimeout(5.0)  # a wedged master must not hang startup
+            c.connect(path)
+            c.sendall((json.dumps(
+                {"command": "worker:ack", "pid": os.getpid()})
+                + "\n").encode())
+            c.close()
+        except OSError as e:
+            log.warning("einhorn ack failed: %s", e)
 
     # ------------------------------------------------------------------
     # flush

@@ -229,9 +229,10 @@ int64_t vtpu_parse_batch(
     while (sec < n) {
       // sec points at '|'
       int64_t s0 = sec + 1;
-      int64_t s1 = s0;
-      while (s1 < n && line[s1] != '|') s1++;
       if (s0 >= n) { bad = true; break; }
+      const uint8_t* sp = (const uint8_t*)memchr(
+          line + s0, '|', (size_t)(n - s0));
+      int64_t s1 = sp ? (int64_t)(sp - line) : n;
       if (line[s0] == '@') {
         if (!parse_value(line + s0 + 1, s1 - s0 - 1, &rate) ||
             !(rate > 0.0 && rate <= 1.0)) {
@@ -241,16 +242,18 @@ int64_t vtpu_parse_batch(
       } else if (line[s0] == '#') {
         int64_t t = s0 + 1;
         while (t <= s1) {
-          int64_t e = t;
-          while (e < s1 && line[e] != ',') e++;
+          const uint8_t* cp2 = (const uint8_t*)memchr(
+              line + t, ',', (size_t)(s1 - t > 0 ? s1 - t : 0));
+          int64_t e = cp2 ? (int64_t)(cp2 - line) : s1;
           int64_t L = e - t;
           if (L > 0) {
             // scope magic tags: prefix match as the reference does
-            // (parser.go:397-407)
-            if (L >= 15 &&
+            // (parser.go:397-407); first-byte guard keeps the memcmp
+            // off the per-tag hot path
+            if (line[t] == 'v' && L >= 15 &&
                 memcmp(line + t, "veneurlocalonly", 15) == 0) {
               sc = 1;
-            } else if (L >= 16 &&
+            } else if (line[t] == 'v' && L >= 16 &&
                        memcmp(line + t, "veneurglobalonly", 16) == 0) {
               sc = 2;
             } else if (ntags < kMaxTags) {

@@ -10,7 +10,12 @@ from urllib.parse import urlparse
 
 
 def parse_addr(addr: str) -> tuple[str, str, int, str]:
-    """-> (scheme, host, port, path).  path is set for unix sockets."""
+    """-> (scheme, host, port, path).  path is set for unix sockets.
+    ``einhorn@N`` adopts inherited file descriptor N from an einhorn
+    socket manager (reference README 'Einhorn Usage': goji/bind's
+    einhorn handling for http_address)."""
+    if addr.startswith("einhorn@"):
+        return "einhorn", "", int(addr.split("@", 1)[1]), ""
     u = urlparse(addr)
     if u.scheme in ("udp", "tcp"):
         if u.port is None and ":" not in (u.netloc or ""):
