@@ -50,7 +50,7 @@ def _block(table):
         jax.block_until_ready(arr)
 
 
-STEADY_INTERVALS = 5
+STEADY_INTERVALS = 7
 FLUSH_LAG = 2  # intervals a flush readback may trail its swap
 
 
@@ -89,23 +89,43 @@ def _run_config(bufs, flush_launch, **table_kw):
     flush_launch(table.swap())()
     _block(table)
 
-    t0 = time.perf_counter()
+    per_interval = []
     total = 0
     pending: deque = deque()
     outs = []
+    t0 = time.perf_counter()
     for _ in range(STEADY_INTERVALS):
+        ti = time.perf_counter()
         total += _ingest_interval(table, bufs, parser)
         pending.append(flush_launch(table.swap()))
         while len(pending) > FLUSH_LAG:
             outs.append(pending.popleft()())
+        per_interval.append(time.perf_counter() - ti)
     while pending:
         outs.append(pending.popleft()())
     _block(table)
     dt = time.perf_counter() - t0
+    return _interval_result(total, dt, per_interval, cold), outs[-1]
+
+
+def _interval_result(total, dt, per_interval, cold):
+    """Headline rate = samples / MEDIAN readback-bearing interval: the
+    tunnel-attached device link has multi-second service hiccups that
+    land on one interval and would misreport steady-state capability
+    by 2-3x run to run; the median is robust to them.  The first
+    FLUSH_LAG intervals never pop a readback inside their timed window
+    (the pipeline is still filling), so they are structurally cheap
+    and excluded from the median; every interval still shows in
+    interval_seconds."""
+    n = len(per_interval)
+    steady = sorted(per_interval[FLUSH_LAG:]) or sorted(per_interval)
+    med = steady[len(steady) // 2]
     return {"samples": total, "seconds": round(dt, 4),
-            "samples_per_sec": round(total / dt, 1),
-            "intervals": STEADY_INTERVALS,
-            "cold_interval_seconds": round(cold, 4)}, outs[-1]
+            "samples_per_sec": round(total / n / med, 1),
+            "mean_samples_per_sec": round(total / dt, 1),
+            "interval_seconds": [round(x, 4) for x in per_interval],
+            "intervals": n,
+            "cold_interval_seconds": round(cold, 4)}
 
 
 def _async_np(*arrs):
@@ -223,14 +243,17 @@ def bench_timers() -> dict:
     flush_launch(table.swap())()
     _block(table)
 
-    t0 = time.perf_counter()
+    per_interval = []
     pending: deque = deque()
     quant = None
+    t0 = time.perf_counter()
     for _ in range(STEADY_INTERVALS):
+        ti = time.perf_counter()
         one_ingest(table)
         pending.append(flush_launch(table.swap()))
         while len(pending) > FLUSH_LAG:
             quant = pending.popleft()()
+        per_interval.append(time.perf_counter() - ti)
     while pending:
         quant = pending.popleft()()
     _block(table)
@@ -247,14 +270,13 @@ def bench_timers() -> dict:
             errs[p].append(abs(quant[s, qi] - exact) /
                            max(abs(exact), 1e-9))
     total = n * STEADY_INTERVALS
-    return {"samples": total, "seconds": round(dt, 4),
-            "samples_per_sec": round(total / dt, 1),
-            "intervals": STEADY_INTERVALS,
-            "cold_interval_seconds": round(cold, 4),
-            "p50_err_mean": float(np.mean(errs[0.5])),
-            "p90_err_mean": float(np.mean(errs[0.9])),
-            "p99_err_mean": float(np.mean(errs[0.99])),
-            "p99_err_max": float(np.max(errs[0.99]))}
+    res = _interval_result(total, dt, per_interval, cold)
+    res.update({
+        "p50_err_mean": float(np.mean(errs[0.5])),
+        "p90_err_mean": float(np.mean(errs[0.9])),
+        "p99_err_mean": float(np.mean(errs[0.99])),
+        "p99_err_max": float(np.max(errs[0.99]))})
+    return res
 
 
 def bench_sets() -> dict:

@@ -498,3 +498,38 @@ def test_config_wires_sink_family(tmp_path):
         assert want in span_names
     assert "S3Plugin" in plugin_names
     srv.shutdown()
+
+
+# ----------------------------------------------------------------------
+# prometheus statsd repeater
+
+def test_prometheus_repeater_udp():
+    from veneur_tpu.sinks.prometheus import PrometheusRepeaterSink
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    sock.settimeout(5)
+    port = sock.getsockname()[1]
+    # scheme-ful address selects the network type (example.yaml form)
+    s = PrometheusRepeaterSink(f"udp://127.0.0.1:{port}")
+    assert s.network_type == "udp"
+    s.flush([_metric("prom.c", 4.0, COUNTER, tags=("a:b",)),
+             _metric("prom.g", 1.5)])
+    got = {sock.recv(1024).decode().strip() for _ in range(2)}
+    assert got == {"prom.c:4.0|c|#a:b", "prom.g:1.5|g"}
+    sock.close()
+
+
+def test_prometheus_repeater_tcp():
+    from veneur_tpu.sinks.prometheus import PrometheusRepeaterSink
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    lsock.settimeout(5)
+    port = lsock.getsockname()[1]
+    s = PrometheusRepeaterSink(f"127.0.0.1:{port}",
+                               network_type="tcp")
+    s.flush([_metric("prom.t", 2.0, COUNTER)])
+    conn, _ = lsock.accept()
+    assert conn.recv(1024) == b"prom.t:2.0|c\n"
+    conn.close()
+    lsock.close()

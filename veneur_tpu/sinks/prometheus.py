@@ -24,8 +24,20 @@ class PrometheusRepeaterSink(SinkBase):
 
     def __init__(self, repeater_address: str, network_type: str = "tcp"):
         super().__init__()
-        host, _, port = repeater_address.rpartition(":")
-        self.addr = (host or "127.0.0.1", int(port))
+        # accept scheme-ful addresses (udp://host:port, the
+        # example.yaml form) — the scheme selects network_type
+        if "://" in repeater_address:
+            from veneur_tpu.protocol.addr import parse_addr
+            scheme, host, port, _ = parse_addr(repeater_address)
+            if scheme != network_type and network_type != "tcp":
+                log.warning(
+                    "prometheus repeater scheme %s overrides "
+                    "prometheus_network_type %s", scheme, network_type)
+            network_type = scheme
+        else:
+            host, _, port = repeater_address.rpartition(":")
+            port = int(port)
+        self.addr = (host or "127.0.0.1", port)
         if network_type not in ("tcp", "udp"):
             raise ValueError(f"bad network type {network_type}")
         self.network_type = network_type
