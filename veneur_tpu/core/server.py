@@ -51,6 +51,12 @@ class Server:
                  extra_plugins: list | None = None,
                  extra_span_sinks: list | None = None):
         self.config = config
+        if config.compile_cache_dir:
+            # before the table below triggers the first jit compiles;
+            # restarts then hit the on-disk cache (the fast half of
+            # the watchdog's crash-and-restart model)
+            from veneur_tpu.utils import compile_cache
+            compile_cache.enable(config.compile_cache_dir)
         self.interval = config.interval_seconds()
         self.is_local = config.is_local()
         self.table = MetricTable(TableConfig(
