@@ -247,3 +247,70 @@ def test_stale_import_stats_do_not_leak_across_intervals():
     snap3 = t.swap()
     assert np.asarray(snap3.histo_stats)[0, 0] == 0.0
     assert np.asarray(snap3.histo_import_stats)[0, 0] == 10.0
+
+
+def test_compaction_and_overflow_at_scale():
+    """Churn 3 generations of 40k-series populations through a
+    64k-row table: overflow counts the drops exactly, compaction
+    reclaims expired series, and survivors' values stay intact —
+    the 100k-cardinality regime the reference runs in production,
+    not a toy size."""
+    from veneur_tpu.core.table import MetricTable, TableConfig
+    from veneur_tpu.protocol import columnar
+
+    parser = columnar.ColumnarParser()
+    if not parser.available:
+        pytest.skip("native parser unavailable")
+    rows = 1 << 16
+    t = MetricTable(TableConfig(counter_rows=rows,
+                                compact_threshold=0.75))
+    per_gen = 40_000
+    for gen in range(3):
+        free = rows - t.counter_idx.occupancy()
+        expected_drop = max(0, per_gen - free)
+        lines = [f"churn.g{gen}.s{i}:1|c".encode()
+                 for i in range(per_gen)]
+        pb = parser.parse(b"\n".join(lines), copy=False)
+        p, d = t.ingest_columns(pb)
+        assert p == per_gen  # every sample parsed and attempted
+        assert d == expected_drop  # drops counted exactly, not lost
+        snap = t.swap()
+        live = int(snap.counter_touched.sum())
+        total = float(np.asarray(snap.counters).sum())
+        # every ACCEPTED sample of this interval is in the snapshot
+        assert total == p - d
+        assert live == p - d
+        assert t.counter_idx.occupancy() <= rows
+    # gen0 fit entirely; gen1 dropped the post-occupancy excess; by
+    # gen2 compaction (occupancy crossed 0.75*rows at the gen1 swap)
+    # had expired the stale generations and everything fit again
+    assert expected_drop == 0 and d == 0
+
+
+def test_histo_plane_half_step_width_exact():
+    """A batch whose max per-row count lands in a 1.5-step width
+    bucket (10 -> width 12, not a power of two): the host plane and
+    device kernels must be width-agnostic — exact conservation and
+    correct quantiles."""
+    from veneur_tpu.core.table import MetricTable, TableConfig
+    from veneur_tpu.ops import tdigest
+
+    t = MetricTable(TableConfig(histo_rows=512))
+    if t._lib is None:
+        pytest.skip("native unavailable")
+    n_rows, per = 500, 10
+    rows = np.repeat(np.arange(n_rows, dtype=np.int32), per)
+    vals = np.tile(np.arange(per, dtype=np.float32) * 10.0, n_rows)
+    t._histo_stage.append(rows, vals, np.ones(len(rows), np.float32))
+    t.device_step(final=True)
+    stats = np.asarray(t.histo_stats)
+    assert (stats[:n_rows, 0] == per).all()       # weight
+    assert (stats[:n_rows, 1] == 0.0).all()       # min
+    assert (stats[:n_rows, 2] == 90.0).all()      # max
+    assert (stats[:n_rows, 3] == 450.0).all()     # sum
+    q = np.asarray(tdigest.quantile(
+        t.histo_means, t.histo_weights,
+        np.asarray([0.5], np.float32),
+        t.histo_stats[:, 1], t.histo_stats[:, 2]))
+    assert q[:n_rows, 0] == pytest.approx(
+        np.full(n_rows, 45.0), abs=5.0)

@@ -824,11 +824,12 @@ class MetricTable:
         n = len(rows)
         rows = np.ascontiguousarray(rows, np.int32)
         counts_full = np.bincount(rows, minlength=c.histo_rows)
-        width = 8
-        while width < min(int(counts_full.max(initial=0)),
-                          c.histo_slots):
-            width <<= 1
-        width = min(width, c.histo_slots)
+        # 1.5-step width ladder (not pure pow2): the plane is h2d
+        # bytes, and e.g. 1100 samples/row fits a 1536 plane — 25%
+        # less transfer than 2048
+        width = min(_bucket_len(int(counts_full.max(initial=0)),
+                                wide=True),
+                    c.histo_slots)
         planes = 1 if unit else 2
         if c.histo_rows * width * 4 * planes > 12 * n:
             return False, None
