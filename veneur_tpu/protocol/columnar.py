@@ -40,15 +40,23 @@ SCOPE_CODES = ("", "local", "global")  # index = wire scope code
 @dataclass
 class ParsedBatch:
     """Struct-of-arrays view over one parsed buffer.  ``buf`` backs the
-    offset columns; slices of it re-parse via the slow path."""
+    offset columns; slices of it re-parse via the slow path.
+
+    DEFINEDNESS CONTRACT (mirrors vtpu_parse_batch): only
+    ``type_code``, ``line_off`` and ``line_len`` are defined for EVERY
+    entry.  For metric lines (type_code <= CODE_SET) ``key_hash``,
+    ``weight`` and ``scope`` are defined; ``value`` only for non-sets
+    and ``member_hash`` only for sets.  Event/service-check/error
+    entries leave the other columns as UNINITIALIZED scratch — always
+    mask by type_code before reading."""
     buf: bytes
     n: int
-    key_hash: np.ndarray    # u64[n]
+    key_hash: np.ndarray    # u64[n] (metric lines)
     type_code: np.ndarray   # u8[n]
-    value: np.ndarray       # f64[n]
+    value: np.ndarray       # f64[n] (metric lines except sets)
     member_hash: np.ndarray  # u64[n] (sets only)
-    weight: np.ndarray      # f32[n] = 1/rate
-    scope: np.ndarray       # u8[n]
+    weight: np.ndarray      # f32[n] = 1/rate (metric lines)
+    scope: np.ndarray       # u8[n] (metric lines)
     line_off: np.ndarray    # i64[n]
     line_len: np.ndarray    # i32[n]
 
