@@ -44,14 +44,13 @@ inline uint64_t fmix64(uint64_t h) {
   return h;
 }
 
-// Identity hash over an assembled key payload: FNV-style but folding
-// 8 little-endian bytes per multiply (the byte-serial loop's 3-cycle
-// dependent multiply per byte dominated parse time), tail
-// zero-padded, length mixed in so padding can't collide, fmix64
-// finalizer.  MUST stay bit-identical to key_hash64 in
-// veneur_tpu/utils/hashing.py — the slow-path row allocator and this
-// fast path must agree on every key.
-inline uint64_t block_hash(const uint8_t* p, size_t n) {
+// FNV-style fold of 8 little-endian bytes per multiply (the
+// byte-serial loop's 3-cycle dependent multiply per byte dominated
+// parse time), tail zero-padded, length mixed in so padding can't
+// collide.  No finalizer — the identity hash combines folds and
+// fmix64s at the end.  MUST stay bit-identical to _fold64 in
+// veneur_tpu/utils/hashing.py.
+inline uint64_t fold64(const uint8_t* p, size_t n) {
   uint64_t h = kFnvOffset;
   size_t i = 0;
   for (; i + 8 <= n; i += 8) {
@@ -64,9 +63,15 @@ inline uint64_t block_hash(const uint8_t* p, size_t n) {
     memcpy(&c, p + i, n - i);
     h = (h ^ c) * kFnvPrime;
   }
-  h ^= (uint64_t)n;
-  return fmix64(h);
+  return h ^ (uint64_t)n;
 }
+
+// Series-identity hash constants (must match utils/hashing.py):
+// key = fmix64( fold64(name) ^ fmix64(type*C1 ^ scope*C2 + tagsum) )
+// where tagsum = sum of fmix64(fold64(tag)) — commutative, so tag
+// ORDER is irrelevant without any sort or assembly buffer.
+constexpr uint64_t kKeyTypeMult = 0x9E3779B97F4A7C15ULL;
+constexpr uint64_t kKeyScopeMult = 0xC2B2AE3D27D4EB4FULL;
 
 // Fast float parse over a byte slice.  Handles [+-]digits[.digits] with
 // an exact digit accumulator; falls back to strtod for exponents and
@@ -124,17 +129,6 @@ slow: {
   }
 }
 
-struct Slice { const uint8_t* p; int64_t n; };
-
-inline int cmp_slice(const Slice& a, const Slice& b) {
-  int64_t n = a.n < b.n ? a.n : b.n;
-  int c = memcmp(a.p, b.p, (size_t)n);
-  if (c) return c;
-  return a.n < b.n ? -1 : (a.n > b.n ? 1 : 0);
-}
-
-constexpr int kMaxTags = 64;
-
 }  // namespace
 
 extern "C" {
@@ -147,7 +141,11 @@ enum : uint8_t {
 
 // Parse newline-separated DogStatsD lines from buf[0:len].
 // All output arrays must have capacity >= the number of lines.
-// Returns the number of lines written.
+// Returns the number of lines written, or, when capacity runs out
+// mid-buffer, -(total nonempty lines in buf) so the caller can grow
+// its scratch and retry — counting lines up front cost more than the
+// parse itself (bytes.count on a 75MB batch was ~60ms; the rare
+// retry is free in steady state because reader batches are bounded).
 int64_t vtpu_parse_batch(
     const uint8_t* buf, int64_t len,
     uint64_t* key_hash, uint8_t* type_code, double* value,
@@ -155,7 +153,7 @@ int64_t vtpu_parse_batch(
     int64_t* line_off, int32_t* line_len, int64_t max_lines) {
   int64_t out = 0;
   int64_t pos = 0;
-  while (pos < len && out < max_lines) {
+  while (pos < len) {
     const uint8_t* nl =
         (const uint8_t*)memchr(buf + pos, '\n', (size_t)(len - pos));
     const int64_t eol = nl ? (int64_t)(nl - buf) : len;
@@ -164,6 +162,18 @@ int64_t vtpu_parse_batch(
     int64_t start = pos;
     pos = eol + 1;
     if (n == 0) continue;
+    if (out >= max_lines) {
+      // scratch too small: finish counting nonempty lines and signal
+      int64_t total = out + 1;
+      while (pos < len) {
+        const uint8_t* nl2 = (const uint8_t*)memchr(
+            buf + pos, '\n', (size_t)(len - pos));
+        const int64_t eol2 = nl2 ? (int64_t)(nl2 - buf) : len;
+        if (eol2 > pos) total++;
+        pos = eol2 + 1;
+      }
+      return -total;
+    }
 
     line_off[out] = start;
     line_len[out] = (int32_t)n;
@@ -218,13 +228,14 @@ int64_t vtpu_parse_batch(
       continue;
     }
 
-    // optional sections
+    // optional sections.  Tags accumulate into a commutative identity
+    // sum as they are scanned — no tag array, no sort, no assembly
+    // (that stage was half the per-line cost of the payload-hash
+    // design), and no tag-count cap.
     double rate = 1.0;
-    Slice tags[kMaxTags];
-    int ntags = 0;
+    uint64_t tagsum = 0;
     uint8_t sc = 0;
     bool bad = false;
-    bool too_many_tags = false;
     int64_t sec = type_end;
     while (sec < n) {
       // sec points at '|'
@@ -256,12 +267,8 @@ int64_t vtpu_parse_batch(
             } else if (line[t] == 'v' && L >= 16 &&
                        memcmp(line + t, "veneurglobalonly", 16) == 0) {
               sc = 2;
-            } else if (ntags < kMaxTags) {
-              tags[ntags].p = line + t;
-              tags[ntags].n = L;
-              ntags++;
             } else {
-              too_many_tags = true;
+              tagsum += fmix64(fold64(line + t, (size_t)L));
             }
           }
           t = e + 1;
@@ -272,13 +279,7 @@ int64_t vtpu_parse_batch(
       }
       sec = s1;
     }
-    if (bad || too_many_tags) {
-      // too_many_tags falls back to the (unbounded) Python parser so
-      // behavior matches, just slower
-      type_code[out++] = T_ERROR;
-      continue;
-    }
-    if (tc == T_GAUGE && rate != 1.0) {
+    if (bad || (tc == T_GAUGE && rate != 1.0)) {
       type_code[out++] = T_ERROR;
       continue;
     }
@@ -298,42 +299,10 @@ int64_t vtpu_parse_batch(
     }
     weight[out] = (float)(1.0 / rate);
     scope[out] = sc;
-
-    // identity hash over name \0 type \0 sorted-tags \0 scope —
-    // insertion sort on slices (tag lists are tiny)
-    for (int i = 1; i < ntags; i++) {
-      Slice key = tags[i];
-      int j = i - 1;
-      while (j >= 0 && cmp_slice(tags[j], key) > 0) {
-        tags[j + 1] = tags[j];
-        j--;
-      }
-      tags[j + 1] = key;
-    }
-    // assemble the payload (name \0 type \0 sorted-tags \0 scope —
-    // the reference's MetricKey identity triple) and block-hash it
-    size_t need = (size_t)colon + 5 + (ntags ? (size_t)ntags - 1 : 0);
-    for (int i = 0; i < ntags; i++) need += (size_t)tags[i].n;
-    uint8_t paystack[1024];
-    std::vector<uint8_t> payheap;
-    uint8_t* pay = paystack;
-    if (need > sizeof(paystack)) {
-      payheap.resize(need);
-      pay = payheap.data();
-    }
-    size_t pn = (size_t)colon;
-    memcpy(pay, line, pn);
-    pay[pn++] = 0;
-    pay[pn++] = tc;
-    pay[pn++] = 0;
-    for (int i = 0; i < ntags; i++) {
-      if (i) pay[pn++] = ',';
-      memcpy(pay + pn, tags[i].p, (size_t)tags[i].n);
-      pn += (size_t)tags[i].n;
-    }
-    pay[pn++] = 0;
-    pay[pn++] = sc;
-    key_hash[out] = block_hash(pay, pn);
+    key_hash[out] = fmix64(
+        fold64(line, (size_t)colon) ^
+        fmix64((((uint64_t)tc * kKeyTypeMult) ^
+                ((uint64_t)sc * kKeyScopeMult)) + tagsum));
     type_code[out] = tc;
     out++;
   }
@@ -486,7 +455,21 @@ void vtpu_ingest(
   int64_t hn = meta[0], sn = meta[1], mn = 0;
   int64_t processed = 0, cn = 0, gn = 0;
   const int64_t total = subset_n >= 0 ? subset_n : n;
+  const uint64_t pmask = (uint64_t)t->cap - 1;
   for (int64_t j = 0; j < total; j++) {
+    // probe prefetch ~16 lines ahead: at 100k+ cardinality the index
+    // is DRAM-resident and the probe stall dominated this loop
+    const int64_t ja = j + 16;
+    if (ja < total) {
+      const int64_t ia = subset_n >= 0 ? subset[ja] : ja;
+      // keys[] is uninitialized scratch for non-metric lines (see the
+      // parser's definedness contract) — filter before reading
+      if (types[ia] <= T_SET) {
+        const uint64_t slot = canon_key(keys[ia]) & pmask;
+        __builtin_prefetch(&t->keys[slot]);
+        __builtin_prefetch(&t->vals[slot]);
+      }
+    }
     const int64_t i = subset_n >= 0 ? subset[j] : j;
     const uint8_t tc = types[i];
     if (tc > T_SET) continue;
