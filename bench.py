@@ -71,16 +71,42 @@ def _ingest_interval(table, bufs, parser):
     return total
 
 
+def _steady_loop(one_ingest, one_launch, finalize=None):
+    """STEADY_INTERVALS timed intervals.  ``one_launch()`` runs in the
+    timed loop (device dispatch + async host copies, returning a
+    result closure); the closure is consumed on a 1-thread flusher
+    pool — the real server's flush readbacks run on its flusher
+    thread and overlap the readers' next interval, and the blocked
+    d2h wait releases the GIL so ingest continues.  Backpressure
+    stays honest: at most FLUSH_LAG flushes in flight, so a pipeline
+    that can't keep up stalls the timed loop; the final drain is
+    also inside the timed window."""
+    from concurrent.futures import ThreadPoolExecutor
+    per_interval = []
+    outs = []
+    pending: deque = deque()
+    with ThreadPoolExecutor(1) as pool:
+        t0 = time.perf_counter()
+        for _ in range(STEADY_INTERVALS):
+            ti = time.perf_counter()
+            one_ingest()
+            pending.append(pool.submit(one_launch()))
+            while len(pending) > FLUSH_LAG:
+                outs.append(pending.popleft().result())
+            per_interval.append(time.perf_counter() - ti)
+        while pending:
+            outs.append(pending.popleft().result())
+        if finalize is not None:
+            finalize()  # outstanding device work stays in the window
+        dt = time.perf_counter() - t0
+    return per_interval, dt, outs
+
+
 def _run_config(bufs, flush_launch, **table_kw):
-    """Cold interval (compiles + row allocation), then
-    STEADY_INTERVALS timed intervals with each interval's flush
-    readback allowed to trail by up to FLUSH_LAG intervals of ingest —
-    how the real server runs (flush tasks go to a pool; the next
-    tick's ingest never waits on readback; the tunnel's d2h latency
-    hides behind subsequent parse work).  Every flush result is still
-    produced and consumed inside the timed region.  ``flush_launch``
-    must dispatch device work + async host copies and return a closure
-    producing the flush result."""
+    """Cold interval (compiles + row allocation), then the timed
+    steady loop (see _steady_loop).  ``flush_launch`` must dispatch
+    device work + async host copies and return a closure producing
+    the flush result."""
     from veneur_tpu.protocol import columnar
     parser = columnar.ColumnarParser()
     table = _mk_table(**table_kw)
@@ -96,23 +122,16 @@ def _run_config(bufs, flush_launch, **table_kw):
     flush_launch(table.swap())()
     _block(table)
 
-    per_interval = []
-    total = 0
-    pending: deque = deque()
-    outs = []
-    t0 = time.perf_counter()
-    for _ in range(STEADY_INTERVALS):
-        ti = time.perf_counter()
-        total += _ingest_interval(table, bufs, parser)
-        pending.append(flush_launch(table.swap()))
-        while len(pending) > FLUSH_LAG:
-            outs.append(pending.popleft()())
-        per_interval.append(time.perf_counter() - ti)
-    while pending:
-        outs.append(pending.popleft()())
-    _block(table)
-    dt = time.perf_counter() - t0
-    return _interval_result(total, dt, per_interval, cold), outs[-1]
+    total_box = [0]
+
+    def one_ingest():
+        total_box[0] += _ingest_interval(table, bufs, parser)
+
+    per_interval, dt, outs = _steady_loop(
+        one_ingest, lambda: flush_launch(table.swap()),
+        finalize=lambda: _block(table))
+    return (_interval_result(total_box[0], dt, per_interval, cold),
+            outs[-1])
 
 
 def _interval_result(total, dt, per_interval, cold):
@@ -250,21 +269,10 @@ def bench_timers() -> dict:
     flush_launch(table.swap())()
     _block(table)
 
-    per_interval = []
-    pending: deque = deque()
-    quant = None
-    t0 = time.perf_counter()
-    for _ in range(STEADY_INTERVALS):
-        ti = time.perf_counter()
-        one_ingest(table)
-        pending.append(flush_launch(table.swap()))
-        while len(pending) > FLUSH_LAG:
-            quant = pending.popleft()()
-        per_interval.append(time.perf_counter() - ti)
-    while pending:
-        quant = pending.popleft()()
-    _block(table)
-    dt = time.perf_counter() - t0
+    per_interval, dt, outs = _steady_loop(
+        lambda: one_ingest(table), lambda: flush_launch(table.swap()),
+        finalize=lambda: _block(table))
+    quant = outs[-1]
 
     errs = {0.5: [], 0.9: [], 0.99: []}
     check = rng.choice(n_series, min(200, n_series), replace=False)

@@ -40,21 +40,22 @@ import numpy as np
 from veneur_tpu import native
 from veneur_tpu.ops import hll, segment, tdigest
 from veneur_tpu.protocol import columnar, dogstatsd as dsd
-from veneur_tpu.utils import hashing, intern
+from veneur_tpu.utils import hashing, intern, jitopts
 
-# jitted, state-donating update steps.  Counters and gauges take
+# jitted update steps (donation policy: utils/jitopts).  Counters
+# and gauges take
 # host-precombined dense vectors (np.bincount / last-write collapse):
 # over the tunnel-attached TPU the h2d link is the bottleneck, so a
 # batch ships as R floats instead of 12 bytes/sample.
 _counter_dense_step = jax.jit(segment.counter_dense_update,
-                              donate_argnums=0)
-_gauge_dense_step = jax.jit(segment.gauge_dense_update, donate_argnums=0)
-_hll_step_packed = jax.jit(hll.insert_packed, donate_argnums=0)
-_hll_union_plane = jax.jit(hll.union, donate_argnums=0)
+                              donate_argnums=jitopts.donate(0))
+_gauge_dense_step = jax.jit(segment.gauge_dense_update, donate_argnums=jitopts.donate(0))
+_hll_step_packed = jax.jit(hll.insert_packed, donate_argnums=jitopts.donate(0))
+_hll_union_plane = jax.jit(hll.union, donate_argnums=jitopts.donate(0))
 # global-tier merge steps (forwarded partial state; duplicates within a
 # batch reduce correctly because every column is an associative scatter)
-_histo_stats_merge = jax.jit(segment.merge_histo_stats, donate_argnums=0)
-_hll_merge_rows = jax.jit(hll.merge_rows, donate_argnums=0)
+_histo_stats_merge = jax.jit(segment.merge_histo_stats, donate_argnums=jitopts.donate(0))
+_hll_merge_rows = jax.jit(hll.merge_rows, donate_argnums=jitopts.donate(0))
 
 _MIN_BUCKET = 256
 _MIN_BUCKET_WIDE = 8  # for batches whose rows are whole planes

@@ -43,6 +43,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from veneur_tpu.utils import jitopts
+
 Array = jax.Array
 
 DEFAULT_COMPRESSION = 100.0
@@ -129,10 +131,10 @@ def _merge_impl(means: Array, weights: Array, new_means: Array,
     return out_m, out_w
 
 
-# Ingest path: state buffers are consumed every tick, so donate them.
+# Ingest path (donation policy: utils/jitopts).
 merge_batch = partial(
     jax.jit(_merge_impl, static_argnames=("compression",),
-            donate_argnums=(0, 1)),
+            donate_argnums=jitopts.donate(0, 1)),
     compression=DEFAULT_COMPRESSION)
 
 # Union path (global tier): callers typically still need both inputs
@@ -169,7 +171,7 @@ def densify(row_ids: Array, values: Array, weights: Array, num_rows: int,
 
 
 @partial(jax.jit, static_argnames=("slots", "compression"),
-         donate_argnums=(0, 1))
+         donate_argnums=jitopts.donate(0, 1))
 def add_samples(means: Array, weights: Array, row_ids: Array,
                 values: Array, sample_weights: Array,
                 slots: int = 256,
@@ -187,7 +189,7 @@ def add_samples(means: Array, weights: Array, row_ids: Array,
 
 
 @partial(jax.jit, static_argnames=("slots", "compression"),
-         donate_argnums=(0, 1))
+         donate_argnums=jitopts.donate(0, 1))
 def add_samples_ranked(means: Array, weights: Array, row_ids: Array,
                        ranks: Array, values: Array,
                        sample_weights: Array, slots: int = 256,
@@ -208,7 +210,7 @@ def add_samples_ranked(means: Array, weights: Array, row_ids: Array,
 
 
 @partial(jax.jit, static_argnames=("slots", "compression"),
-         donate_argnums=(0, 1))
+         donate_argnums=jitopts.donate(0, 1))
 def add_samples_ranked_unit(means: Array, weights: Array,
                             row_ids: Array, ranks: Array,
                             values: Array, slots: int = 256,
@@ -247,7 +249,7 @@ def _stats_from_dense(stats: Array, dense_v: Array, dense_w: Array
 
 
 @partial(jax.jit, static_argnames=("slots", "compression"),
-         donate_argnums=(0, 1, 2))
+         donate_argnums=jitopts.donate(0, 1, 2))
 def ingest_ranked(means: Array, weights: Array, stats: Array,
                   row_ids: Array, ranks: Array, values: Array,
                   sample_weights: Array, slots: int = 256,
@@ -269,7 +271,7 @@ def ingest_ranked(means: Array, weights: Array, stats: Array,
 
 
 @partial(jax.jit, static_argnames=("slots", "compression"),
-         donate_argnums=(0, 1, 2))
+         donate_argnums=jitopts.donate(0, 1, 2))
 def ingest_ranked_unit(means: Array, weights: Array, stats: Array,
                        row_ids: Array, ranks: Array, values: Array,
                        slots: int = 256,
@@ -288,7 +290,7 @@ def ingest_ranked_unit(means: Array, weights: Array, stats: Array,
 
 
 @partial(jax.jit, static_argnames=("compression",),
-         donate_argnums=(0, 1, 2))
+         donate_argnums=jitopts.donate(0, 1, 2))
 def ingest_plane_unit(means: Array, weights: Array, stats: Array,
                       counts: Array, dense_v: Array,
                       compression: float = DEFAULT_COMPRESSION
@@ -310,7 +312,7 @@ def ingest_plane_unit(means: Array, weights: Array, stats: Array,
 
 
 @partial(jax.jit, static_argnames=("compression",),
-         donate_argnums=(0, 1, 2))
+         donate_argnums=jitopts.donate(0, 1, 2))
 def ingest_plane(means: Array, weights: Array, stats: Array,
                  dense_v: Array, dense_w: Array,
                  compression: float = DEFAULT_COMPRESSION
@@ -324,7 +326,7 @@ def ingest_plane(means: Array, weights: Array, stats: Array,
 
 
 @partial(jax.jit, static_argnames=("slots", "compression"),
-         donate_argnums=(0, 1))
+         donate_argnums=jitopts.donate(0, 1))
 def add_samples_unit(means: Array, weights: Array, row_ids: Array,
                      values: Array, slots: int = 256,
                      compression: float = DEFAULT_COMPRESSION

@@ -43,6 +43,8 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+
+from veneur_tpu.utils import jitopts
 import numpy as np
 from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -231,7 +233,7 @@ def make_update_step(mesh: Mesh, cfg: ShardedConfig):
     mapped = shard_map(step, mesh=mesh,
                        in_specs=(state_specs, batch_specs()),
                        out_specs=state_specs, check_rep=False)
-    return jax.jit(mapped, donate_argnums=0)
+    return jax.jit(mapped, donate_argnums=jitopts.donate(0))
 
 
 def make_merge_step(mesh: Mesh, cfg: ShardedConfig):
