@@ -305,10 +305,14 @@ def bench_sets() -> dict:
             for i in range(0, n, chunk)]
 
     def flush_launch(snap):
-        est = hll.estimate(snap.hll_regs)
-        _async_np(est)
         live = snap.set_touched[:len(snap.set_meta)]
         nmeta = len(snap.set_meta)
+        if snap.host_only_sets:
+            # device-free set interval: estimate on the flusher thread
+            return lambda: hll.estimate_np(
+                snap.hll_host_plane)[:nmeta][live]
+        est = hll.estimate(snap.hll_regs)
+        _async_np(est)
         return lambda: np.asarray(est)[:nmeta][live]
 
     res, got = _run_config(bufs, flush_launch, set_rows=1024)

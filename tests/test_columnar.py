@@ -163,9 +163,9 @@ def test_ingest_columns_equals_slow_ingest(parser):
     for k in fh:
         np.testing.assert_allclose(fh[k], sh[k], rtol=1e-5)
     # HLL registers identical (same member hashes -> same registers)
-    fregs = {m.name: np.asarray(fsnap.hll_regs)[r]
+    fregs = {m.name: fsnap.set_registers()[r]
              for r, m in enumerate(fsnap.set_meta)}
-    sregs = {m.name: np.asarray(ssnap.hll_regs)[r]
+    sregs = {m.name: ssnap.set_registers()[r]
              for r, m in enumerate(ssnap.set_meta)}
     assert set(fregs) == set(sregs)
     for k in fregs:

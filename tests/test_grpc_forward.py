@@ -174,8 +174,7 @@ def test_set_roundtrip_cardinality():
     src = MetricTable(TableConfig())
     for mem in members:
         src.ingest(dsd.Sample(name="uniq", type=dsd.SET, value=mem))
-    src.device_step(final=True)
-    regs = np.asarray(src.hll_regs)[0]
+    regs = src.swap().set_registers()[0]
     row = ForwardRow(_meta("uniq", dsd.SET), "set", regs=regs)
     ml = forward_pb2.MetricList.FromString(
         rows_to_metric_list([row]).SerializeToString())

@@ -314,3 +314,63 @@ def test_histo_plane_half_step_width_exact():
         t.histo_stats[:, 1], t.histo_stats[:, 2]))
     assert q[:n_rows, 0] == pytest.approx(
         np.full(n_rows, 45.0), abs=5.0)
+
+
+def test_set_host_plane_device_free_interval():
+    """Raw set traffic folds into the host register plane: the device
+    registers stay untouched, and the host estimate matches the device
+    estimator's result for the same members."""
+    import jax.numpy as jnp
+
+    from veneur_tpu.ops import hll
+
+    t = MetricTable(TableConfig(set_rows=8))
+    for i in range(5000):
+        t.ingest(dsd.Sample(name="u", type=dsd.SET,
+                            value=f"m{i}".encode()))
+    snap = t.swap()
+    assert snap.hll_host_plane is not None
+    assert not snap.hll_device_touched
+    # device plane untouched (still all zeros)
+    assert int(np.asarray(snap.hll_regs).max()) == 0
+    host_est = float(hll.estimate_np(snap.hll_host_plane)[0])
+    dev_est = float(np.asarray(
+        hll.estimate(jnp.asarray(snap.hll_host_plane)))[0])
+    assert host_est == pytest.approx(dev_est, rel=1e-5)
+    assert host_est == pytest.approx(5000, rel=0.05)
+
+
+def test_set_mixed_raw_and_import_interval_unions():
+    """An interval with BOTH raw members and an imported register
+    plane: set_registers() AND the flusher's emitted estimate must
+    cover the union of the two (the flusher's mixed branch unions the
+    host plane into the device registers before estimating)."""
+    from veneur_tpu.ops import hll
+
+    other = MetricTable(TableConfig(set_rows=8))
+    for i in range(1000):
+        other.ingest(dsd.Sample(name="u", type=dsd.SET,
+                                value=f"import-{i}".encode()))
+    imported = other.swap().set_registers()[0]
+
+    t = MetricTable(TableConfig(set_rows=8))
+    for i in range(1000):
+        t.ingest(dsd.Sample(name="u", type=dsd.SET,
+                            value=f"raw-{i}".encode()))
+    assert t.import_set("u", (), imported)
+    snap = t.swap()
+    assert snap.hll_device_touched
+    est = float(hll.estimate_np(snap.set_registers())[0])
+    assert est == pytest.approx(2000, rel=0.05)
+    # flusher global tier: raw members and the import share the row
+    # (same name/tags/scope), so ONE emitted gauge covers the union
+    res = Flusher(is_local=False).flush(snap)
+    emitted = [m for m in res.metrics if m.name == "u"]
+    assert len(emitted) == 1
+    assert emitted[0].value == pytest.approx(2000, rel=0.05)
+    # flusher local tier: the mixed registers forward, not emit
+    res_local = Flusher(is_local=True).flush(snap)
+    fwd = [f for f in res_local.forward if f.meta.name == "u"]
+    assert fwd and any(
+        float(hll.estimate_np(f.regs[None])[0]) == pytest.approx(
+            2000, rel=0.05) for f in fwd)

@@ -195,14 +195,30 @@ class Flusher:
             fwd = [int(r) for r in set_rows
                    if self._forwardable(snap.set_meta[r], always=True)]
             pre["set_fwd"] = fwd
-            if fwd:
-                idx, _ = _pad_idx(fwd)
-                devs["fwd_regs"] = _gather_rows(snap.hll_regs, idx)
             fwd_set = set(fwd)
-            if any(int(r) not in fwd_set and
-                   self._emit_local(snap.set_meta[r])
-                   for r in set_rows):
-                devs["ests"] = hll.estimate(snap.hll_regs)
+            need_est = any(int(r) not in fwd_set and
+                           self._emit_local(snap.set_meta[r])
+                           for r in set_rows)
+            if snap.host_only_sets:
+                # whole interval's set state lives on host: estimate
+                # and gather forward rows with zero device round trips
+                if fwd:
+                    pre["fwd_regs"] = snap.hll_host_plane[
+                        np.asarray(fwd, np.int64)]
+                if need_est:
+                    pre["ests"] = hll.estimate_np(snap.hll_host_plane)
+            else:
+                regs = snap.hll_regs
+                if snap.hll_host_plane is not None:
+                    # rare mixed interval (raw traffic + imports):
+                    # union the host plane in once, then read on device
+                    regs = hll.union(regs,
+                                     jnp.asarray(snap.hll_host_plane))
+                if fwd:
+                    idx, _ = _pad_idx(fwd)
+                    devs["fwd_regs"] = _gather_rows(regs, idx)
+                if need_est:
+                    devs["ests"] = hll.estimate(regs)
         pre.update(jax.device_get(devs))
         return pre
 

@@ -96,6 +96,13 @@ class TableConfig:
     # same digests, and whole-interval set batches dedup into a
     # register plane (one h2d plane beats 8 bytes/member)
     histo_merge_samples: int = 4 << 20
+    # raw set samples fold into a HOST register plane (16 KiB/row)
+    # when the plane fits this bound; past it (very high set-row
+    # configs) they scatter to the device as before.  The host plane
+    # makes the single-node set path device-free: the flusher
+    # estimates from it directly unless global-tier imports also
+    # landed in the device registers (see flusher._prepare)
+    host_set_plane_max_bytes: int = 64 << 20
 
 
 @dataclass
@@ -220,7 +227,32 @@ class Snapshot:
     hll_regs: Any
     set_meta: list[RowMeta]
     set_touched: np.ndarray
+    # host-folded raw-set registers for the interval (None when the
+    # plane exceeded host_set_plane_max_bytes) and whether anything
+    # (imports, oversized-plane scatters) touched the DEVICE registers
+    hll_host_plane: np.ndarray | None = None
+    hll_device_touched: bool = False
     overflow: dict[str, int] = field(default_factory=dict)
+
+    @property
+    def host_only_sets(self) -> bool:
+        """True when the interval's entire set state is the host
+        plane — the single definition the flusher and bench dispatch
+        on to skip the device for set reads."""
+        return (self.hll_host_plane is not None and
+                not self.hll_device_touched)
+
+    def set_registers(self) -> np.ndarray:
+        """Effective HLL registers for the interval as a host array:
+        the host-folded plane unioned with any device-resident state
+        (global-tier import merges).  Reads the device plane back only
+        when it was actually touched."""
+        if self.host_only_sets:
+            return self.hll_host_plane
+        regs = np.asarray(self.hll_regs)
+        if self.hll_host_plane is not None:
+            regs = np.maximum(regs, self.hll_host_plane)
+        return regs
 
 
 class MetricTable:
@@ -279,6 +311,11 @@ class MetricTable:
         self._stats_import_vals: list[np.ndarray] = []
         self._set_import_rows: list[int] = []
         self._set_import_regs: list[np.ndarray] = []
+
+        # host register plane for raw set traffic (lazy; see
+        # TableConfig.host_set_plane_max_bytes) + device-touch flag
+        self._hll_host_plane: np.ndarray | None = None
+        self._hll_device_touched = False
 
         self.status: dict[tuple, tuple[float, str, tuple[str, ...]]] = {}
         # O(1) staged-sample counter (``staged()`` must be callable per
@@ -735,8 +772,13 @@ class MetricTable:
                 self._set_pos_rows, self._set_pos = [], []
             srows = np.concatenate(parts_rows)
             spos = np.concatenate(parts_pos)
-            if not self._hll_plane_step(srows, spos):
+            if c.set_rows * hll.M <= c.host_set_plane_max_bytes:
+                # device-free path: fold into the host plane; the
+                # flusher estimates/forwards from it directly
+                self._hll_host_fold(srows, spos)
+            elif not self._hll_plane_step(srows, spos):
                 self._ensure_fresh("hll")
+                self._hll_device_touched = True
                 b = _bucket_len(len(srows))
                 self.hll_regs = _hll_step_packed(
                     self.hll_regs,
@@ -762,6 +804,7 @@ class MetricTable:
             rows = np.asarray(self._set_import_rows, np.int32)
             regs = np.stack(self._set_import_regs)
             self._set_import_rows, self._set_import_regs = [], []
+            self._hll_device_touched = True
             # wide rows (16 KiB each): small bucket floor, padding a
             # 256-row plane for one import would cost 4 MiB of
             # host->device bandwidth per flush
@@ -881,6 +924,31 @@ class MetricTable:
                 else ov_wts[:spill].copy())
         return True, None
 
+    def _hll_host_fold(self, rows: np.ndarray, pos: np.ndarray) -> None:
+        """Fold packed member positions into the persistent host
+        register plane for this interval — no device dispatch at all
+        (see TableConfig.host_set_plane_max_bytes)."""
+        c = self.config
+        if self._hll_host_plane is None:
+            self._hll_host_plane = np.zeros((c.set_rows, hll.M),
+                                            np.uint8)
+        rows = np.ascontiguousarray(rows, np.int32)
+        pos = np.ascontiguousarray(pos, np.int32)
+        if self._lib is not None:
+            import ctypes as ct
+            i32p = ct.POINTER(ct.c_int32)
+            self._lib.vtpu_hll_plane(
+                rows.ctypes.data_as(i32p), pos.ctypes.data_as(i32p),
+                len(rows), c.set_rows, hll.M,
+                self._hll_host_plane.ctypes.data_as(
+                    ct.POINTER(ct.c_uint8)))
+            return
+        idx = pos >> 6
+        rank = (pos & 0x3F).astype(np.uint8)
+        live = (rows >= 0) & (rows < c.set_rows)
+        np.maximum.at(self._hll_host_plane,
+                      (rows[live], idx[live]), rank[live])
+
     def _hll_plane_step(self, rows: np.ndarray, pos: np.ndarray
                         ) -> bool:
         """Fold the interval's packed member positions into a host
@@ -903,6 +971,7 @@ class MetricTable:
             c.set_rows, hll.M,
             plane.ctypes.data_as(ct.POINTER(ct.c_uint8)))
         self._ensure_fresh("hll")
+        self._hll_device_touched = True
         self.hll_regs = _hll_union_plane(self.hll_regs,
                                          jnp.asarray(plane))
         return True
@@ -999,6 +1068,8 @@ class MetricTable:
             hll_regs=self.hll_regs,
             set_meta=list(self.set_idx.meta),
             set_touched=self.set_idx.touched.copy(),
+            hll_host_plane=self._hll_host_plane,
+            hll_device_touched=self._hll_device_touched,
             overflow={
                 "counter": self.counter_idx.overflow,
                 "gauge": self.gauge_idx.overflow,
@@ -1006,6 +1077,9 @@ class MetricTable:
                 "set": self.set_idx.overflow,
             },
         )
+        # the host set plane belongs to the snapshot now
+        self._hll_host_plane = None
+        self._hll_device_touched = False
         # the old planes belong to the snapshot now; fresh ones are
         # allocated lazily on first touch (see _ensure_fresh) — a
         # snapshot of an untouched type keeps referencing the pristine

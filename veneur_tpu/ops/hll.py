@@ -86,6 +86,37 @@ def merge_rows(regs: Array, row_ids: Array, incoming: Array) -> Array:
     return regs.at[row_ids].max(incoming, mode="drop")
 
 
+def estimate_np(plane) -> "np.ndarray":
+    """LogLog-Beta estimate over a HOST register plane (u8[R, M]) —
+    the same formula as ``estimate``, evaluated with numpy.
+
+    Exists for the narrow-device-link regime: when an interval's set
+    traffic was folded entirely into the host staging plane (see
+    MetricTable._hll_host_fold) there is nothing device-resident to
+    merge with, and shipping 16 KiB/row over a tunneled link just to
+    run a row reduction costs more than the reduction.  The device
+    ``estimate`` remains the path whenever registers live in HBM
+    (global-tier imports, multi-chip meshes)."""
+    import numpy as np
+    ez = (plane == 0).sum(axis=-1).astype(np.float64)
+    # exp2(-rank) via a 64-entry table: ranks are <= 51 for p=14.
+    # Row-chunked so the float64 temp stays ~8 MiB regardless of
+    # plane size (one-shot lut[plane] would spike 8x the plane).
+    lut = np.exp2(-np.arange(64, dtype=np.float64))
+    inv_sum = np.empty(plane.shape[0], np.float64)
+    step = max(1, (8 << 20) // (M * 8))
+    for i in range(0, plane.shape[0], step):
+        inv_sum[i:i + step] = lut[plane[i:i + step]].sum(axis=-1)
+    zl = np.log(ez + 1.0)
+    beta = _BETA14[0] * ez
+    zp = zl.copy()
+    for c in _BETA14[1:]:
+        beta = beta + c * zp
+        zp = zp * zl
+    return (_ALPHA * M * (M - ez) / (inv_sum + beta)).astype(
+        np.float32)
+
+
 def estimate(regs: Array) -> Array:
     """LogLog-Beta cardinality estimate per row -> f32[R].
 
