@@ -374,3 +374,65 @@ def test_set_mixed_raw_and_import_interval_unions():
     assert fwd and any(
         float(hll.estimate_np(f.regs[None])[0]) == pytest.approx(
             2000, rel=0.05) for f in fwd)
+
+
+def test_histo_plane_stats_exact_with_f16_values():
+    """The plane path ships f16 values when the range allows, but the
+    emitted min/max/sum/count come from the host's exact-f32 stats
+    pass — bit-equal to the true extremes, spills included."""
+    rng = np.random.default_rng(11)
+    n = 60_000
+    t = MetricTable(TableConfig(histo_rows=32, histo_slots=4096))
+    rows = (np.arange(n) % 16).astype(np.int32)
+    rows[: n // 2] = 0  # hot row 0 forces width trimming + spill
+    vals = rng.uniform(0.001, 5.0e4, n).astype(np.float32)
+    t._histo_stage.append(rows, vals, np.ones(n, np.float32))
+    t.device_step(final=True)
+    from veneur_tpu.ops import segment
+    stats = np.asarray(t.histo_stats)
+    for r in range(16):
+        sel = vals[rows == r]
+        assert stats[r, segment.STAT_WEIGHT] == len(sel)
+        assert stats[r, segment.STAT_MIN] == np.float32(sel.min())
+        assert stats[r, segment.STAT_MAX] == np.float32(sel.max())
+        assert stats[r, segment.STAT_SUM] == pytest.approx(
+            float(sel.sum()), rel=1e-5)
+    # digest still covers every sample despite width trimming
+    w = np.asarray(t.histo_weights)
+    assert float(w.sum()) == pytest.approx(n)
+
+
+def test_hot_row_flood_preclusters_on_host():
+    """A single series flooding far past histo_slots*4 in one batch
+    must NOT issue hundreds of sequential device merges: the host
+    pre-clusters with the same k-scale, the digest sees the full
+    weight, stats stay exact, and quantiles hold accuracy."""
+    from veneur_tpu.ops import segment, tdigest
+
+    rng = np.random.default_rng(13)
+    n = 120_000
+    t = MetricTable(TableConfig(histo_rows=1 << 14, histo_slots=128))
+    rows = np.zeros(n, np.int32)  # sparse table -> ranked path
+    vals = rng.gamma(2.0, 30.0, n).astype(np.float32)
+    calls = {"n": 0}
+    orig = t._digest_merge
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    t._digest_merge = counting
+    t._histo_stage.append(rows, vals, np.ones(n, np.float32))
+    t.device_step(final=True)
+    assert calls["n"] <= 4  # pre-cluster, not n/slots=937 dispatches
+    stats = np.asarray(t.histo_stats)
+    assert stats[0, segment.STAT_WEIGHT] == pytest.approx(n)
+    assert stats[0, segment.STAT_MIN] == np.float32(vals.min())
+    assert stats[0, segment.STAT_MAX] == np.float32(vals.max())
+    q = np.asarray(tdigest.quantile(
+        t.histo_means, t.histo_weights,
+        np.asarray([0.5, 0.99], np.float32),
+        t.histo_stats[:, 1], t.histo_stats[:, 2]))
+    for qi, p in enumerate((0.5, 0.99)):
+        exact = float(np.quantile(vals, p))
+        assert q[0, qi] == pytest.approx(exact, rel=0.02), (p, q[0, qi])

@@ -56,6 +56,9 @@ _hll_union_plane = jax.jit(hll.union, donate_argnums=jitopts.donate(0))
 # batch reduce correctly because every column is an associative scatter)
 _histo_stats_merge = jax.jit(segment.merge_histo_stats, donate_argnums=jitopts.donate(0))
 _hll_merge_rows = jax.jit(hll.merge_rows, donate_argnums=jitopts.donate(0))
+# elementwise fold of host-computed per-row batch aggregates (see
+# _host_stats_fold); identity-filled untouched rows need no mask
+_histo_stats_fold = jax.jit(tdigest._combine_row_stats)
 
 _MIN_BUCKET = 256
 _MIN_BUCKET_WIDE = 8  # for batches whose rows are whole planes
@@ -841,9 +844,25 @@ class MetricTable:
                 # hot rows past the plane width fall through to the
                 # ranked path, which chunks ITERATIVELY (a recursive
                 # plane retry would strip only `width` samples of the
-                # hot row per level — quadratic work and a stack bomb)
+                # hot row per level — quadratic work and a stack bomb).
+                # The plane step's host stats pass already counted the
+                # spilled samples, so they re-enter digest-only.
                 rows, vals, wts = spill
+                with_stats = False
         rank, max_count = self._rank(rows)
+        if max_count > c.histo_slots * 4:
+            # hot-row flood: a chunked ranked loop would issue
+            # max_count/slots sequential device merges (a 400k-sample
+            # series = ~800 dispatches per flush — enough queue depth
+            # to wedge a tunneled device link).  Pre-cluster on host
+            # with the same k-scale math instead: any flood becomes
+            # <= capacity weighted centroids per row, one merge.
+            if with_stats:
+                self._host_stats_fold(rows, vals, wts)
+                with_stats = False
+            rows, vals, wts = self._host_precluster(rows, vals, wts)
+            unit = False
+            rank, max_count = self._rank(rows)
         if max_count <= c.histo_slots:
             self._digest_merge(rows, vals, wts, rank, unit, with_stats)
             return
@@ -855,35 +874,131 @@ class MetricTable:
                                rank[sel] - ci * c.histo_slots, unit,
                                with_stats)
 
+    def _host_stats_fold(self, rows, vals, wts) -> None:
+        """Fold a batch's per-row local aggregates into the device
+        stats plane from HOST-computed exact values (numpy bincount
+        reductions) — used when the batch bypasses the plane step but
+        is about to be pre-clustered, which would corrupt min/max."""
+        c = self.config
+        rows = np.ascontiguousarray(rows, np.int64)
+        batch = np.zeros((c.histo_rows, segment.HISTO_STAT_COLS),
+                         np.float32)
+        batch[:, segment.STAT_MIN] = segment.STAT_MIN_EMPTY
+        batch[:, segment.STAT_MAX] = segment.STAT_MAX_EMPTY
+        R = c.histo_rows
+        batch[:, segment.STAT_WEIGHT] = np.bincount(
+            rows, weights=wts, minlength=R)[:R]
+        batch[:, segment.STAT_SUM] = np.bincount(
+            rows, weights=vals * wts, minlength=R)[:R]
+        nz = vals != 0
+        batch[:, segment.STAT_RSUM] = np.bincount(
+            rows[nz], weights=wts[nz] / vals[nz], minlength=R)[:R]
+        np.minimum.at(batch[:, segment.STAT_MIN], rows, vals)
+        np.maximum.at(batch[:, segment.STAT_MAX], rows, vals)
+        self._ensure_fresh("histo")
+        self.histo_stats = _histo_stats_fold(
+            self.histo_stats, jnp.asarray(batch))
+
+    def _host_precluster(self, rows, vals, wts
+                         ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Collapse a sample batch into <= capacity weighted centroids
+        per row using the SAME k-scale clustering as the device merge
+        (ops/tdigest._merge_impl): sort by (row, value), exact q from
+        the within-row cumulative weight, cluster = floor(k(q)-k(0)),
+        weighted mean per cluster.  The device then merges centroids —
+        a centroid IS a weighted sample — so accuracy matches feeding
+        the raw batch through the same scale."""
+        c = self.config
+        delta = tdigest._SCALE_MULT * c.compression
+        cap = self.capacity
+        rows = np.ascontiguousarray(rows, np.int64)
+        order = np.lexsort((vals, rows))
+        r = rows[order]
+        v = np.ascontiguousarray(vals, np.float64)[order]
+        w = np.ascontiguousarray(wts, np.float64)[order]
+        cw = np.cumsum(w)
+        first = np.ones(len(r), bool)
+        first[1:] = r[1:] != r[:-1]
+        base = np.maximum.accumulate(np.where(first, cw - w, 0.0))
+        totals = np.bincount(r, weights=w)[r]
+        q_left = (cw - w - base) / np.maximum(totals, 1e-30)
+        k0 = delta / (2.0 * np.pi) * np.arcsin(-1.0)
+        k = (delta / (2.0 * np.pi) *
+             np.arcsin(np.clip(2.0 * q_left - 1.0, -1.0, 1.0)) - k0)
+        cl = np.clip(np.floor(k).astype(np.int64), 0, cap - 1)
+        key = r * cap + cl
+        uniq, inv = np.unique(key, return_inverse=True)
+        cw_sum = np.bincount(inv, weights=w)
+        cwv = np.bincount(inv, weights=w * v)
+        return ((uniq // cap).astype(np.int32),
+                (cwv / np.maximum(cw_sum, 1e-30)).astype(np.float32),
+                cw_sum.astype(np.float32))
+
     def _histo_plane_step(self, rows, vals, wts, unit):
         """Host-densified plane ingest (native vtpu_dense_plane +
-        tdigest.ingest_plane*): ships R*W*4 plane bytes instead of
-        12 bytes/sample.  Returns (handled, spill): handled=False when
-        the batch is too sparse for the plane to be the smaller
-        transfer (the ranked path takes over); spill holds samples of
-        rows past the plane width — the CALLER routes them through the
-        iterative ranked chunking."""
+        tdigest.ingest_plane_pre*): ships a dense value plane instead
+        of 12 bytes/sample.  Three transfer reductions compose here:
+
+        - width targets the 99.5th-percentile row count (ladder-
+          rounded), not the max — the few hotter rows spill to the
+          ranked path instead of padding every row to the hot one;
+        - per-row local aggregates are accumulated on host in exact
+          f32 over ALL samples (including spills) by the same native
+          pass, so
+        - the value plane can ship as float16 when the batch's range
+          fits: digest means absorb the ~0.05% quantization, while
+          min/max/sum stay exact.
+
+        Returns (handled, spill): handled=False when the batch is too
+        sparse for the plane to be the smaller transfer (the ranked
+        path takes over); spill holds samples of rows past the plane
+        width — the CALLER routes them digest-only (stats already
+        counted)."""
         import ctypes as ct
         c = self.config
         n = len(rows)
         rows = np.ascontiguousarray(rows, np.int32)
+        vals = np.ascontiguousarray(vals, np.float32)
         counts_full = np.bincount(rows, minlength=c.histo_rows)
-        # 1.5-step width ladder (not pure pow2): the plane is h2d
-        # bytes, and e.g. 1100 samples/row fits a 1536 plane — 25%
-        # less transfer than 2048
-        width = min(_bucket_len(int(counts_full.max(initial=0)),
-                                wide=True),
-                    c.histo_slots)
+        occupied = counts_full[counts_full > 0]
+        if not len(occupied):
+            return True, None
+        w_hi = int(occupied.max())
+        w_p99 = int(np.percentile(occupied, 99.5))
+        # width at 128-lane granularity around the p99.5 row count
+        # (compile-cache variants bounded by histo_slots/128); the
+        # coarse 1.5-step ladder only caps via the max row
+        width = min(max(128, -(-w_p99 // 128) * 128),
+                    _bucket_len(w_hi, wide=True), c.histo_slots)
+        # f16 plane only for unit-weight batches whose nonzero values
+        # all sit in f16's NORMAL range: rel. quantization there is
+        # 2^-11 (~0.05%), while subnormals (<6.1e-5) would quantize at
+        # percent-level and weights (1/rate, up to 1e5+) could
+        # overflow to inf.  Stats stay exact either way.  The range
+        # scan is skipped for weighted batches (always f32 there).
+        f16 = False
+        if unit:
+            av = np.abs(vals)
+            vmax = float(av.max(initial=0.0))
+            nz = av[av > 0]
+            vmin_nz = float(nz.min()) if len(nz) else 1.0
+            f16 = vmax < 6.0e4 and vmin_nz >= 6.2e-5
+        vbytes = 2 if f16 else 4
         planes = 1 if unit else 2
-        if c.histo_rows * width * 4 * planes > 12 * n:
+        if c.histo_rows * width * vbytes * planes > 12 * n:
             return False, None
         f32p = ct.POINTER(ct.c_float)
         i32p = ct.POINTER(ct.c_int32)
-        vals = np.ascontiguousarray(vals, np.float32)
         plane_v = np.zeros((c.histo_rows, width), np.float32)
         plane_w = (None if unit else
                    np.zeros((c.histo_rows, width), np.float32))
         counts = np.zeros(c.histo_rows, np.int32)
+        # f64 batch-stat accumulators (see vtpu_dense_plane); rounded
+        # to f32 once, after accumulation
+        batch_stats = np.zeros((c.histo_rows, segment.HISTO_STAT_COLS),
+                               np.float64)
+        batch_stats[:, segment.STAT_MIN] = segment.STAT_MIN_EMPTY
+        batch_stats[:, segment.STAT_MAX] = segment.STAT_MAX_EMPTY
         ov_rows = np.empty(n, np.int32)
         ov_vals = np.empty(n, np.float32)
         if unit:
@@ -903,20 +1018,26 @@ class MetricTable:
             else None,
             counts.ctypes.data_as(i32p),
             ov_rows.ctypes.data_as(i32p),
-            ov_vals.ctypes.data_as(f32p), ov_wts_p)
+            ov_vals.ctypes.data_as(f32p), ov_wts_p,
+            batch_stats.ctypes.data_as(ct.POINTER(ct.c_double)))
+        batch_stats = batch_stats.astype(np.float32)
+        if f16:
+            plane_v = plane_v.astype(np.float16)
         self._ensure_fresh("histo")
         if unit:
             (self.histo_means, self.histo_weights,
-             self.histo_stats) = tdigest.ingest_plane_unit(
+             self.histo_stats) = tdigest.ingest_plane_pre_unit(
                 self.histo_means, self.histo_weights,
-                self.histo_stats, jnp.asarray(counts),
-                jnp.asarray(plane_v), compression=c.compression)
+                self.histo_stats, jnp.asarray(batch_stats),
+                jnp.asarray(counts), jnp.asarray(plane_v),
+                compression=c.compression)
         else:
             (self.histo_means, self.histo_weights,
-             self.histo_stats) = tdigest.ingest_plane(
+             self.histo_stats) = tdigest.ingest_plane_pre(
                 self.histo_means, self.histo_weights,
-                self.histo_stats, jnp.asarray(plane_v),
-                jnp.asarray(plane_w), compression=c.compression)
+                self.histo_stats, jnp.asarray(batch_stats),
+                jnp.asarray(plane_v), jnp.asarray(plane_w),
+                compression=c.compression)
         if spill:
             return True, (
                 ov_rows[:spill].copy(), ov_vals[:spill].copy(),

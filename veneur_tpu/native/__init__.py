@@ -95,7 +95,7 @@ def load() -> ctypes.CDLL | None:
         lib.vtpu_dense_plane.restype = i64
         lib.vtpu_dense_plane.argtypes = [
             i32p, f32p, f32p, i64, ctypes.c_int32, ctypes.c_int32,
-            f32p, f32p, i32p, i32p, f32p, f32p]
+            f32p, f32p, i32p, i32p, f32p, f32p, f64p]
         lib.vtpu_hll_plane.restype = None
         lib.vtpu_hll_plane.argtypes = [
             i32p, i32p, i64, ctypes.c_int32, ctypes.c_int32, u8p]

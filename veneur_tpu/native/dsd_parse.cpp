@@ -552,27 +552,47 @@ void vtpu_rank(const int32_t* rows, int64_t n, int32_t n_rows,
 // ``width`` for a row spill to the ov_* arrays for a follow-up call.
 // plane_v/plane_w and counts must be zeroed by the caller; returns
 // the spill count.  Out-of-range rows are dropped (counted upstream).
+//
+// out_stats (nullable): f64[n_rows, 5] per-row batch aggregates
+// (weight, min, max, sum, reciprocal-sum — the Histo sampler's local
+// stats, reference samplers/samplers.go:484-494) accumulated here in
+// full f32 precision over EVERY sample of the batch (including ones
+// that spill), so the value plane itself may then ship at reduced
+// precision without corrupting the emitted min/max/sum.  Caller
+// pre-fills columns: weight/sum/rsum 0, min +F32_MAX, max -F32_MAX.
 int64_t vtpu_dense_plane(const int32_t* rows, const float* vals,
                          const float* wts,  // null => unit weights
                          int64_t n, int32_t n_rows, int32_t width,
                          float* plane_v, float* plane_w,  // w nullable
                          int32_t* counts,
                          int32_t* ov_rows, float* ov_vals,
-                         float* ov_wts) {
+                         float* ov_wts, double* out_stats) {
   int64_t spill = 0;
   for (int64_t i = 0; i < n; i++) {
     int32_t r = rows[i];
     if (r < 0 || r >= n_rows) continue;
+    const float v = vals[i];
+    const float w = wts ? wts[i] : 1.0f;
+    if (out_stats) {
+      // f64 accumulators: sequential f32 sums drift ~eps*running_sum
+      // per add on hot rows (and an f32 count saturates at 2^24)
+      double* st = out_stats + (int64_t)r * 5;
+      st[0] += w;
+      if (v < st[1]) st[1] = v;
+      if (v > st[2]) st[2] = v;
+      st[3] += (double)v * w;
+      if (v != 0.0f) st[4] += (double)w / v;
+    }
     int32_t c = counts[r];
     if (c >= width) {
       ov_rows[spill] = r;
-      ov_vals[spill] = vals[i];
-      if (wts) ov_wts[spill] = wts[i];
+      ov_vals[spill] = v;
+      if (wts) ov_wts[spill] = w;
       spill++;
       continue;
     }
-    plane_v[(int64_t)r * width + c] = vals[i];
-    if (wts) plane_w[(int64_t)r * width + c] = wts[i];
+    plane_v[(int64_t)r * width + c] = v;
+    if (wts) plane_w[(int64_t)r * width + c] = w;
     counts[r] = c + 1;
   }
   return spill;
