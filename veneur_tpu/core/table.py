@@ -58,7 +58,8 @@ _histo_stats_merge = jax.jit(segment.merge_histo_stats, donate_argnums=jitopts.d
 _hll_merge_rows = jax.jit(hll.merge_rows, donate_argnums=jitopts.donate(0))
 # elementwise fold of host-computed per-row batch aggregates (see
 # _host_stats_fold); identity-filled untouched rows need no mask
-_histo_stats_fold = jax.jit(tdigest._combine_row_stats)
+_histo_stats_fold = jax.jit(tdigest._combine_row_stats,
+                            donate_argnums=jitopts.donate(0))
 
 _MIN_BUCKET = 256
 _MIN_BUCKET_WIDE = 8  # for batches whose rows are whole planes
@@ -732,14 +733,18 @@ class MetricTable:
         whole-interval set batches dedup into the register plane."""
         c = self.config
         self._staged_n = 0
-        if self._counter_dirty:
+        # counters/gauges are DENSE per-row interval accumulators —
+        # nothing grows with sample count — so their single O(R) ship
+        # happens once, at the swap, not per device step (mid-interval
+        # ships doubled the h2d bytes for zero benefit)
+        if self._counter_dirty and final:
             self._ensure_fresh("counter")
             self.counters = _counter_dense_step(
                 self.counters, self._counter_dense.astype(np.float32))
             self._counter_dense.fill(0.0)
             self._counter_dirty = False
 
-        if self._gauge_dirty:
+        if self._gauge_dirty and final:
             self._ensure_fresh("gauge")
             # .copy(): the h2d transfer is async and the staging buffer
             # is mutated by the very next ingest
