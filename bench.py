@@ -1,4 +1,4 @@
-"""Benchmark harness: BASELINE configs 0-3 on the attached device.
+"""Benchmark harness: BASELINE configs 0-4 on the attached device.
 
 Measures the aggregation pipeline the way the reference's benchmark
 suite does (worker ingest BenchmarkWork worker_test.go:506, flush
@@ -323,6 +323,117 @@ def bench_sets() -> dict:
     return res
 
 
+def bench_global_merge() -> dict:
+    """Config 4: the global tier's merge — 64 locals each forwarding
+    256 timer digests (128 raw samples behind each) + 64 set sketches
+    per interval (the role of reference importsrv/server.go:102
+    SendMetrics + worker.go:438 ImportMetricGRPC).  Measures
+    end-to-end from serialized reference-compatible MetricList protos
+    through decode, import staging, device merge and
+    quantile/estimate readout; reported as items/sec where an item is
+    one forwarded digest or sketch."""
+    from veneur_tpu.core.table import MetricTable, TableConfig
+    from veneur_tpu.forward.grpc_forward import (apply_metric_list,
+                                                 rows_to_metric_list)
+    from veneur_tpu.forward.gen import forward_pb2
+    from veneur_tpu.ops import hll as hll_ops, tdigest
+    from veneur_tpu.protocol import dogstatsd as dsd
+    import jax
+    import jax.numpy as jnp
+
+    n_locals = 8 if QUICK else 64
+    # per-local series counts sized so one interval is ~20k items at
+    # 64 locals — enough to saturate the merge path without letting a
+    # degraded device-link day blow the bench's wall-clock budget
+    n_histo, n_sets = 256, 64
+    samples_per_digest = 128
+    rng = np.random.default_rng(4)
+
+    # build each local's forwarded state once (serialized protos —
+    # the wire bytes a Go local would send)
+    src = MetricTable(TableConfig(histo_rows=n_histo,
+                                  set_rows=n_sets,
+                                  histo_slots=2048,
+                                  histo_merge_samples=1 << 30))
+    # allocate the series rows (the flusher forwards only rows with
+    # meta), then stage the raw volume behind them
+    for i in range(n_histo):
+        src.ingest(dsd.Sample(name=f"fwd.lat.{i}", type=dsd.TIMER,
+                              value=1.0))
+    rows = np.repeat(np.arange(n_histo, dtype=np.int32),
+                     samples_per_digest)
+    vals = rng.gamma(2.0, 30.0, len(rows)).astype(np.float32)
+    src._histo_stage.append(rows, vals, np.ones(len(rows), np.float32))
+    for i in range(n_sets * 40):
+        src.ingest(dsd.Sample(name=f"uniq.{i % n_sets}",
+                              type=dsd.SET, value=f"m{i}".encode()))
+    from veneur_tpu.core.flusher import Flusher
+    res = Flusher(is_local=True).flush(src.swap())
+    # every local forwards the same series — the worst-case (full row
+    # contention) and the realistic one: a fleet forwards the same
+    # metric names
+    wire = rows_to_metric_list(res.forward).SerializeToString()
+    wire_lists = [wire] * n_locals
+
+    qs_dev = jnp.asarray(np.asarray([0.5, 0.9, 0.99], np.float32))
+
+    @jax.jit
+    def _readout(stats, means, weights, regs):
+        q = tdigest.quantile(means, weights, qs_dev,
+                             stats[:, 1], stats[:, 2])
+        return q, hll_ops.estimate(regs)
+
+    dst = MetricTable(TableConfig(histo_rows=n_histo * 2,
+                                  set_rows=n_sets * 2,
+                                  histo_slots=2048,
+                                  histo_merge_samples=1 << 30))
+
+    def one_interval():
+        total = 0
+        for wire in wire_lists:
+            ml = forward_pb2.MetricList.FromString(wire)
+            acc, _ = apply_metric_list(dst, ml)
+            total += acc
+            dst.device_step()
+        return total
+
+    def flush_launch(snap):
+        # forwarded stat rows land in the IMPORT stats plane (the
+        # local-sample plane stays empty on a pure global node), so
+        # the quantile anchors read from there
+        q, est = _readout(snap.histo_import_stats, snap.histo_means,
+                          snap.histo_weights, snap.hll_regs)
+        _async_np(q, est)
+        return lambda: (np.asarray(q), np.asarray(est))
+
+    t0 = time.perf_counter()
+    one_interval()
+    flush_launch(dst.swap())()
+    _block(dst)
+    cold = time.perf_counter() - t0
+    one_interval()
+    flush_launch(dst.swap())()
+    _block(dst)
+
+    total_box = [0]
+
+    def one_ingest():
+        total_box[0] += one_interval()
+
+    per_interval, dt, outs = _steady_loop(
+        one_ingest, lambda: flush_launch(dst.swap()),
+        finalize=lambda: _block(dst))
+    q, est = outs[-1]
+    res_d = _interval_result(total_box[0], dt, per_interval, cold)
+    # every digest item re-merges raw_per_digest-equivalent samples
+    res_d["items"] = res_d.pop("samples")
+    res_d["items_per_sec"] = res_d.pop("samples_per_sec")
+    res_d["mean_items_per_sec"] = res_d.pop("mean_samples_per_sec")
+    res_d["locals"] = n_locals
+    res_d["quantile_rows_read"] = int(np.isfinite(q).all(axis=1).sum())
+    return res_d
+
+
 def main() -> None:
     t_start = time.time()
     configs = {}
@@ -330,6 +441,7 @@ def main() -> None:
     configs["1_cardinality_100k"] = bench_cardinality()
     configs["2_timers_10k_series"] = bench_timers()
     configs["3_sets_1m_uniques"] = bench_sets()
+    configs["4_global_merge_64_locals"] = bench_global_merge()
 
     headline = configs["0_counters_1k_names"]["samples_per_sec"]
     target = 10_000_000.0
