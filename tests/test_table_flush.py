@@ -436,3 +436,76 @@ def test_hot_row_flood_preclusters_on_host():
     for qi, p in enumerate((0.5, 0.99)):
         exact = float(np.quantile(vals, p))
         assert q[0, qi] == pytest.approx(exact, rel=0.02), (p, q[0, qi])
+
+
+def test_set_import_duplicate_rows_fold_before_shipping():
+    """64 locals forwarding the same set series: the import planes
+    fold by register-max on host into one row before the device merge,
+    and the union still covers every local's members."""
+    from veneur_tpu.ops import hll
+
+    planes = []
+    for loc in range(8):
+        src = MetricTable(TableConfig(set_rows=8))
+        for i in range(300):
+            src.ingest(dsd.Sample(name="u", type=dsd.SET,
+                                  value=f"l{loc}-m{i}".encode()))
+        planes.append(src.swap().set_registers()[0])
+
+    dst = MetricTable(TableConfig(set_rows=8))
+    for p in planes:
+        assert dst.import_set("u", (), p)
+    snap = dst.swap()
+    est = float(hll.estimate_np(snap.set_registers())[0])
+    assert est == pytest.approx(8 * 300, rel=0.05)
+
+
+def test_import_centroid_batches_precluster_on_host():
+    """64 forwarded digests for ONE series in an interval (the fleet
+    case): the stats-free centroid batch exceeds the digest capacity,
+    pre-clusters on host, reaches the device as a single bounded
+    merge, and quantiles stay accurate with total weight conserved."""
+    from veneur_tpu.ops import segment, tdigest
+
+    rng = np.random.default_rng(17)
+    all_vals = []
+    fwd = []
+    for loc in range(64):
+        src = MetricTable(TableConfig(histo_rows=8, histo_slots=512,
+                                      histo_merge_samples=1 << 30))
+        vals = rng.gamma(2.0, 30.0, 500).astype(np.float32)
+        all_vals.append(vals)
+        for v in vals[:1]:
+            src.ingest(dsd.Sample(name="lat", type=dsd.TIMER,
+                                  value=float(v)))
+        src._histo_stage.append(
+            np.zeros(len(vals) - 1, np.int32), vals[1:],
+            np.ones(len(vals) - 1, np.float32))
+        res = Flusher(is_local=True).flush(src.swap())
+        fwd.append([f for f in res.forward if f.kind == "histo"][0])
+
+    dst = MetricTable(TableConfig(histo_rows=8, histo_slots=512,
+                                  histo_merge_samples=1 << 30))
+    calls = {"n": 0}
+    orig = dst._digest_merge
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    dst._digest_merge = counting
+    for f in fwd:
+        assert dst.import_histo("lat", dsd.TIMER, (), f.stats,
+                                f.means, f.weights)
+    snap = dst.swap()
+    assert calls["n"] <= 2  # preclustered, not 64x160/slots chunks
+    exact = np.sort(np.concatenate(all_vals))
+    stats = np.asarray(snap.histo_import_stats)
+    assert stats[0, segment.STAT_WEIGHT] == pytest.approx(len(exact))
+    q = np.asarray(tdigest.quantile(
+        snap.histo_means, snap.histo_weights,
+        np.asarray([0.5, 0.99], np.float32),
+        stats[:, 1], stats[:, 2]))
+    for qi, p in enumerate((0.5, 0.99)):
+        assert q[0, qi] == pytest.approx(
+            float(np.quantile(exact, p)), rel=0.03), (p, q[0, qi])

@@ -813,6 +813,19 @@ class MetricTable:
             regs = np.stack(self._set_import_regs)
             self._set_import_rows, self._set_import_regs = [], []
             self._hll_device_touched = True
+            # a fleet of locals forwards the SAME series: fold
+            # duplicate target rows by register-max on host first, so
+            # K received planes ship as U unique rows (64 locals x
+            # 16 KiB/plane was ~64x the necessary transfer)
+            if len(rows) > 1:
+                order = np.argsort(rows, kind="stable")
+                r_s = rows[order]
+                starts = np.nonzero(np.concatenate(
+                    [[True], r_s[1:] != r_s[:-1]]))[0]
+                if len(starts) < len(rows):
+                    regs = np.maximum.reduceat(regs[order], starts,
+                                               axis=0)
+                    rows = r_s[starts]
             # wide rows (16 KiB each): small bucket floor, padding a
             # 256-row plane for one import would cost 4 MiB of
             # host->device bandwidth per flush
@@ -855,13 +868,18 @@ class MetricTable:
                 rows, vals, wts = spill
                 with_stats = False
         rank, max_count = self._rank(rows)
-        if max_count > c.histo_slots * 4:
-            # hot-row flood: a chunked ranked loop would issue
-            # max_count/slots sequential device merges (a 400k-sample
-            # series = ~800 dispatches per flush — enough queue depth
-            # to wedge a tunneled device link).  Pre-cluster on host
-            # with the same k-scale math instead: any flood becomes
-            # <= capacity weighted centroids per row, one merge.
+        # Host pre-cluster (same k-scale math as the device merge)
+        # when a row's batch exceeds what the digest keeps anyway:
+        # raw-sample floods past histo_slots*4 (a 400k-sample series
+        # would otherwise issue ~800 chunked device merges — enough
+        # queue depth to wedge a tunneled device link), and
+        # stats-free centroid batches (global-tier imports, plane
+        # spills) past the digest capacity — a fleet's forwarded
+        # digests collapse to <= capacity clusters per row on host,
+        # cutting the shipped batch ~5x and the merge to one call.
+        precluster_at = (c.histo_slots * 4 if with_stats
+                         else max(self.capacity, c.histo_slots))
+        if max_count > precluster_at:
             if with_stats:
                 self._host_stats_fold(rows, vals, wts)
                 with_stats = False
