@@ -33,7 +33,11 @@ Array = jax.Array
 HISTO_STAT_COLS = 5
 STAT_WEIGHT, STAT_MIN, STAT_MAX, STAT_SUM, STAT_RSUM = range(HISTO_STAT_COLS)
 
-_F32_MAX = jnp.float32(jnp.finfo(jnp.float32).max)
+# plain Python float, NOT jnp.float32(...): a module-scope device
+# scalar would initialize the JAX backend at import time, which hangs
+# config validation / CLI help paths whenever the device link is
+# down.  Weak-typed float constants fold into f32 kernels identically.
+_F32_MAX = float(jnp.finfo(jnp.float32).max)
 
 # Untouched-row sentinels for the min/max columns — the role of the
 # reference's math.Inf(+1)/math.Inf(-1) initialisation
