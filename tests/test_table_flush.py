@@ -509,3 +509,29 @@ def test_import_centroid_batches_precluster_on_host():
     for qi, p in enumerate((0.5, 0.99)):
         assert q[0, qi] == pytest.approx(
             float(np.quantile(exact, p)), rel=0.03), (p, q[0, qi])
+
+
+def test_full_pipeline_without_native_library(monkeypatch):
+    """With no C++ library (no toolchain), the table must fall back to
+    pure-numpy staging/fold paths with identical semantics: slow-path
+    ingest, numpy rank, host HLL fold via np.maximum.at."""
+    from veneur_tpu import native
+
+    monkeypatch.setattr(native, "load", lambda: None)
+    t = MetricTable(TableConfig(counter_rows=16, gauge_rows=16,
+                                histo_rows=16, set_rows=8))
+    assert t._lib is None
+    ingest_lines(t, [b"hits:2|c", b"hits:3|c", b"temp:7|g"])
+    for v in range(200):
+        t.ingest(dsd.parse_metric(f"lat:{v}|ms".encode()))
+    for i in range(300):
+        t.ingest(dsd.parse_metric(f"users:u{i}|s".encode()))
+    res = Flusher(is_local=False, percentiles=(0.5,),
+                  aggregates=("count", "max")).flush(t.swap())
+    m = by_name(res.metrics)
+    assert m["hits"].value == 5.0
+    assert m["temp"].value == 7.0
+    assert m["lat.count"].value == 200.0
+    assert m["lat.max"].value == 199.0
+    assert m["lat.50percentile"].value == pytest.approx(99.5, rel=0.02)
+    assert m["users"].value == pytest.approx(300, rel=0.05)
