@@ -323,3 +323,35 @@ def test_grpc_ingest_span_packet_health():
     finally:
         chan.close()
         srv.shutdown()
+
+
+def test_wire_fixture_regression():
+    """Checked-in serialized MetricList (the reference's
+    regression_test.go strategy): decoding the frozen wire bytes must
+    keep producing the same aggregates — guards against accidental
+    proto-schema or codec drift between rounds."""
+    import base64
+    import os
+
+    from veneur_tpu.core.flusher import Flusher
+    from veneur_tpu.ops import hll as hll_ops
+
+    path = os.path.join(os.path.dirname(__file__), "testdata",
+                        "forward_fixture.b64")
+    wire = base64.b64decode(open(path, "rb").read())
+    ml = forward_pb2.MetricList.FromString(wire)
+    assert len(ml.metrics) == 4
+    dst = MetricTable(TableConfig(histo_rows=8, set_rows=8))
+    acc, dropped = apply_metric_list(dst, ml)
+    assert (acc, dropped) == (4, 0)
+    res = Flusher(is_local=False, percentiles=(0.5,),
+                  aggregates=("count",)).flush(dst.swap())
+    m = {x.name: x for x in res.metrics}
+    assert m["fix.total"].value == 7.0
+    assert m["fix.depth"].value == 3.5
+    # import-only histo rows emit percentiles ONLY — their aggregates
+    # were already emitted by the local tier (samplers.go:530 gate)
+    assert "fix.lat.count" not in m
+    assert m["fix.lat.50percentile"].value == pytest.approx(
+        52.87, rel=0.05)  # frozen digest's p50 for seed 42
+    assert m["fix.users"].value == pytest.approx(250, rel=0.05)
