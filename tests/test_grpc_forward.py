@@ -334,7 +334,6 @@ def test_wire_fixture_regression():
     import os
 
     from veneur_tpu.core.flusher import Flusher
-    from veneur_tpu.ops import hll as hll_ops
 
     path = os.path.join(os.path.dirname(__file__), "testdata",
                         "forward_fixture.b64")

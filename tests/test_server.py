@@ -364,3 +364,25 @@ def test_einhorn_socket_adoption(monkeypatch, tmp_path, make_server):
         srv.shutdown()
         ctrl.close()
         lsock.close()
+
+
+def test_udp_burst_drained_in_batches(make_server):
+    """A burst of datagrams lands through the native recvmmsg drain:
+    every packet is received, counted, and aggregated; oversize
+    datagrams in the burst are rejected whole (not truncated into
+    plausible-but-wrong lines)."""
+    server, cap = make_server(metric_max_length=64)
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    addr = ("127.0.0.1", server.statsd_ports[0])
+    for i in range(400):
+        sock.sendto(b"burst:1|c", addr)
+    sock.sendto(b"big:" + b"9" * 100 + b"|c", addr)  # oversize
+    sock.close()
+    assert _wait(lambda: server.stats.get("packets_received", 0)
+                 + server.stats.get("packet_errors", 0) >= 401,
+                 timeout=8.0)
+    server.flush_once()
+    m = {x.name: x for x in cap.metrics}
+    assert m["burst"].value == 400.0
+    assert "big" not in m
+    assert server.stats["packet_errors"] >= 1

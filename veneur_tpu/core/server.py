@@ -536,6 +536,22 @@ class Server:
         if not parser.available:
             parser = None
         max_batch = self.config.reader_batch_packets
+        # native bulk drain: one recvmmsg syscall per batch instead of
+        # one recv + bytes object per packet (see vtpu_recv_drain);
+        # the first read stays blocking in Python so shutdown and
+        # socket errors surface normally
+        from veneur_tpu import native as native_mod
+        lib = native_mod.load() if parser is not None else None
+        drain_buf = None
+        has_drain = lib is not None and hasattr(lib, "vtpu_recv_drain")
+        if has_drain:
+            import ctypes as _ct
+            drain_cap = max(1, min(max_batch, 512)) * (bufsize + 1)
+            drain_buf = np.empty(drain_cap, np.uint8)
+            drain_ptr = drain_buf.ctypes.data_as(
+                _ct.POINTER(_ct.c_uint8))
+            drain_n = _ct.c_int32(0)
+            drain_over = _ct.c_int32(0)
         while not self._shutdown.is_set():
             try:
                 data = sock.recv(bufsize)
@@ -548,20 +564,48 @@ class Server:
                 self.bump(f"received_{proto}")
                 continue
             batch = [data]
-            try:
-                while len(batch) < max_batch:
-                    more = sock.recv(bufsize, socket.MSG_DONTWAIT)
-                    if more:  # empty datagrams are silently ignored,
-                        batch.append(more)  # as on the blocking path
-            except (BlockingIOError, OSError):
-                pass
-            self.handle_packet_batch(batch, parser)
-            self.bump(f"received_{proto}", len(batch))
+            n_pkts = 1
+            drained = None
+            if drain_buf is not None:
+                # max_len = metric_max_length: a datagram one byte
+                # over must MSG_TRUNC so the drain rejects it, as the
+                # blocking path's length check would
+                nbytes = lib.vtpu_recv_drain(
+                    sock.fileno(), drain_ptr, drain_buf.nbytes,
+                    min(max_batch - 1, 512), bufsize - 1, drain_n,
+                    drain_over)
+                if nbytes:
+                    drained = drain_buf[:nbytes].tobytes()
+                    n_pkts += int(drain_n.value)
+                if drain_over.value:
+                    # received but rejected: both counters move, as on
+                    # the blocking path
+                    n_pkts += int(drain_over.value)
+                    self.bump("packet_errors", int(drain_over.value))
+            else:
+                # no drain (library without the symbol, e.g. a stale
+                # cached .so): per-packet non-blocking sweep
+                try:
+                    while len(batch) < max_batch:
+                        more = sock.recv(bufsize, socket.MSG_DONTWAIT)
+                        if more:  # empty datagrams silently ignored,
+                            batch.append(more)  # as on blocking path
+                except (BlockingIOError, OSError):
+                    pass
+                n_pkts = len(batch)
+            self.handle_packet_batch(
+                batch, parser, drained=drained,
+                drained_pkts=int(drain_n.value) if drained else 0)
+            self.bump(f"received_{proto}", n_pkts)
 
-    def handle_packet_batch(self, packets: list[bytes],
-                            parser) -> None:
+    def handle_packet_batch(self, packets: list[bytes], parser,
+                            drained: bytes | None = None,
+                            drained_pkts: int = 0) -> None:
         """Columnar ingest of many datagrams: one native parse, one
-        table lock, one stats round."""
+        table lock, one stats round.  ``drained`` is a pre-validated
+        newline-joined chunk from the native recvmmsg drain (each
+        datagram already bounded/oversize-rejected in C), so it skips
+        the per-packet length check."""
         errors = 0
         good = []
         for p in packets:
@@ -569,7 +613,9 @@ class Server:
                 errors += 1
             else:
                 good.append(p)
-        self.bump("packets_received", len(good))
+        self.bump("packets_received", len(good) + drained_pkts)
+        if drained is not None:
+            good.append(drained)
         # views into the reader's own parser scratch: consumed fully
         # (ingest + slow-path sweep) before this reader parses again
         pb = parser.parse(b"\n".join(good), copy=False)

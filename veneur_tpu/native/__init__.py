@@ -75,6 +75,10 @@ def load() -> ctypes.CDLL | None:
             u8p, i64, u64p, u8p, f64p, u64p, f32p, u8p, i64p, i32p, i64]
         lib.vtpu_hash_members.restype = None
         lib.vtpu_hash_members.argtypes = [u8p, i64p, i64p, i64, u64p]
+        lib.vtpu_recv_drain.restype = i64
+        lib.vtpu_recv_drain.argtypes = [
+            ctypes.c_int32, u8p, i64, ctypes.c_int32, ctypes.c_int32,
+            i32p, i32p]
         vp = ctypes.c_void_p
         lib.vtpu_index_new.restype = vp
         lib.vtpu_index_new.argtypes = [i64]

@@ -25,6 +25,10 @@
 #include <cmath>
 #include <vector>
 
+#ifdef __linux__
+#include <sys/socket.h>
+#endif
+
 namespace {
 
 constexpr uint64_t kFnvOffset = 14695981039346656037ULL;
@@ -307,6 +311,70 @@ int64_t vtpu_parse_batch(
     out++;
   }
   return out;
+}
+
+// Non-blocking bulk datagram drain: one recvmmsg syscall pulls up to
+// max_msgs datagrams straight into ``out`` (iovecs at a fixed
+// max_len+1 stride), then an in-place forward compaction joins them
+// with newlines for the columnar parser.  Replaces the per-packet
+// recv loop whose ~1-2us/packet of syscall + bytes-object overhead
+// capped a reader near 500k packets/s.  Returns bytes written (0 =
+// nothing pending); *n_msgs gets the datagram count.  The caller's
+// BLOCKING first read stays in Python for shutdown responsiveness.
+int64_t vtpu_recv_drain(int32_t fd, uint8_t* out, int64_t out_cap,
+                        int32_t max_msgs, int32_t max_len,
+                        int32_t* n_msgs, int32_t* n_oversize) {
+#ifndef __linux__
+  // recvmmsg is Linux-only; elsewhere the caller's blocking loop
+  // handles every packet (the rest of the library still builds)
+  (void)fd; (void)out; (void)out_cap; (void)max_msgs; (void)max_len;
+  *n_msgs = 0;
+  *n_oversize = 0;
+  return 0;
+#else
+  constexpr int kMax = 512;
+  if (max_msgs > kMax) max_msgs = kMax;
+  const int64_t stride = (int64_t)max_len + 1;
+  if ((int64_t)max_msgs * stride > out_cap) {
+    max_msgs = (int32_t)(out_cap / stride);
+  }
+  *n_msgs = 0;
+  *n_oversize = 0;
+  if (max_msgs <= 0) return 0;
+  struct mmsghdr hdrs[kMax];
+  struct iovec iovs[kMax];
+  memset(hdrs, 0, sizeof(struct mmsghdr) * (size_t)max_msgs);
+  for (int i = 0; i < max_msgs; i++) {
+    iovs[i].iov_base = out + (int64_t)i * stride;
+    iovs[i].iov_len = (size_t)max_len;
+    hdrs[i].msg_hdr.msg_iov = &iovs[i];
+    hdrs[i].msg_hdr.msg_iovlen = 1;
+  }
+  int got = recvmmsg(fd, hdrs, (unsigned)max_msgs, MSG_DONTWAIT,
+                     nullptr);
+  if (got <= 0) return 0;  // EAGAIN/err: blocking loop handles it
+  // forward compaction: write_ptr never passes a source start because
+  // sum(len_j + 1) <= i * stride.  Datagrams past max_len arrive
+  // MSG_TRUNC-flagged and are REJECTED whole (the reference drops
+  // oversize packets, server.go:1254; a truncated tail line could
+  // otherwise parse as a valid wrong value).
+  int64_t w = 0;
+  int kept = 0;
+  for (int i = 0; i < got; i++) {
+    if (hdrs[i].msg_hdr.msg_flags & MSG_TRUNC) {
+      (*n_oversize)++;
+      continue;
+    }
+    const int64_t len = hdrs[i].msg_len;
+    if (len == 0) continue;
+    memmove(out + w, out + (int64_t)i * stride, (size_t)len);
+    w += len;
+    out[w++] = '\n';
+    kept++;
+  }
+  *n_msgs = kept;
+  return w;
+#endif  // __linux__
 }
 
 // Vectorized member hasher for HLL set values arriving via the slow
