@@ -241,3 +241,60 @@ def test_touched_persists_across_intervals(parser):
     assert float(np.asarray(s2.gauges)[0]) == 7.0
     # last_gen advanced -> compaction at gen 2 keeps the series
     assert int(t.gauge_idx.last_gen[0]) == 1
+
+
+def test_native_parser_fuzz_agreement(parser):
+    """Randomized cross-validation: over thousands of arbitrary lines
+    (mutated valid metrics, random printable junk, raw binary), every
+    line the NATIVE parser accepts must also parse in the Python
+    reference parser with the same type, value, weight, scope and
+    identity hash — and the native side must never crash or hang."""
+    rng = np.random.default_rng(1234)
+    valid_stems = [b"name:1|c", b"a.b:3.5|ms|#x:1,y:2",
+                   b"s:m|s", b"g:-2|g", b"h:9|h|@0.5|#t:1"]
+    lines = []
+    for i in range(3000):
+        kind = i % 3
+        if kind == 0:  # mutate a valid line
+            base = bytearray(valid_stems[i % len(valid_stems)])
+            for _ in range(rng.integers(1, 4)):
+                pos = rng.integers(0, len(base))
+                base[pos] = rng.integers(32, 127)
+            lines.append(bytes(base))
+        elif kind == 1:  # random printable
+            n = int(rng.integers(1, 40))
+            lines.append(bytes(rng.integers(32, 127, n,
+                                            dtype=np.uint8)))
+        else:  # raw binary (no newline: that's the framing delimiter)
+            n = int(rng.integers(1, 40))
+            raw = rng.integers(0, 256, n, dtype=np.uint8)
+            raw[raw == 10] = 11
+            lines.append(bytes(raw))
+    pb = parser.parse(b"\n".join(lines))
+    assert pb.n == len(lines)  # nothing generated is empty
+    checked = 0
+    for i in range(pb.n):
+        line = pb.line(i)
+        tc = int(pb.type_code[i])
+        if tc > columnar.CODE_SET:
+            # rejected/slow-path natively: the inverse direction —
+            # Python must NOT accept what the native parser rejects
+            # (over-rejection silently drops valid metrics)
+            if tc == columnar.CODE_ERROR:
+                with pytest.raises(dsd.ParseError):
+                    dsd.parse_metric(line)
+            continue
+        s = dsd.parse_metric(line)  # must NOT raise for accepted lines
+        assert TYPE_CODES[s.type] == tc, line
+        assert SCOPE_CODES[s.scope] == int(pb.scope[i]), line
+        assert float(pb.weight[i]) == pytest.approx(
+            1.0 / s.sample_rate, rel=1e-6), line
+        if s.type != dsd.SET:
+            assert float(pb.value[i]) == pytest.approx(
+                float(s.value), rel=1e-9, abs=1e-12), line
+        expect = hashing.key_hash64(
+            s.name, TYPE_CODES[s.type], s.tags,
+            SCOPE_CODES[s.scope])
+        assert int(pb.key_hash[i]) == expect, line
+        checked += 1
+    assert checked > 100  # mutations keep plenty of valid lines

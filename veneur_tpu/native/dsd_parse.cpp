@@ -203,6 +203,13 @@ int64_t vtpu_parse_batch(
     const uint8_t* cp = (const uint8_t*)memchr(line, ':', (size_t)n);
     const int64_t colon = cp ? (int64_t)(cp - line) : -1;
     if (colon <= 0) { type_code[out++] = T_ERROR; continue; }
+    // a '|' before the colon means the first pipe-section has no
+    // name:value pair — the reference splits on '|' FIRST and rejects
+    // such lines (samplers/parser.go:307), so must we
+    if (memchr(line, '|', (size_t)colon) != nullptr) {
+      type_code[out++] = T_ERROR;
+      continue;
+    }
     const uint8_t* pp = (const uint8_t*)memchr(
         line + colon + 1, '|', (size_t)(n - colon - 1));
     const int64_t pipe1 = pp ? (int64_t)(pp - line) : -1;
@@ -255,6 +262,10 @@ int64_t vtpu_parse_batch(
           break;
         }
       } else if (line[s0] == '#') {
+        // a later '#' section REPLACES tags and scope (the reference
+        // overwrites tags per section; last one wins)
+        tagsum = 0;
+        sc = 0;
         int64_t t = s0 + 1;
         while (t <= s1) {
           const uint8_t* cp2 = (const uint8_t*)memchr(
