@@ -166,3 +166,27 @@ def test_scope_tag_prefix_form():
 def test_bad_timestamp_is_parse_error(bad):
     with pytest.raises(dsd.ParseError):
         dsd.parse_line(bad)
+
+
+def test_event_and_check_parsers_never_crash_on_fuzz():
+    """Random mutations of event/service-check lines must either parse
+    or raise ParseError — never any other exception (the per-line slow
+    path runs on live traffic)."""
+    import numpy as np
+
+    from veneur_tpu.protocol import dogstatsd as dsd
+
+    rng = np.random.default_rng(77)
+    stems = [b"_e{5,4}:title|text|#a:1", b"_sc|db.up|0|m:fine",
+             b"_e{2,2}:ab|cd|d:123|h:x|p:low|t:err",
+             b"_sc|svc|1|d:5|#x:1,y:2|m:msg"]
+    for i in range(2000):
+        base = bytearray(stems[i % len(stems)])
+        for _ in range(rng.integers(1, 5)):
+            pos = rng.integers(0, len(base))
+            base[pos] = rng.integers(32, 127)
+        line = bytes(base)
+        try:
+            dsd.parse_line(line)
+        except dsd.ParseError:
+            pass

@@ -304,3 +304,24 @@ def test_emit_cli_grpc_modes():
             for m in cap.metrics))
     finally:
         server.shutdown()
+
+
+def test_ssf_frame_decode_never_crashes_on_fuzz():
+    """Garbage framed-SSF streams must produce clean protocol errors,
+    never arbitrary exceptions — the stream listener feeds this from
+    untrusted sockets."""
+    import numpy as np
+
+    from veneur_tpu.protocol import wire
+
+    import io
+
+    rng = np.random.default_rng(99)
+    for i in range(500):
+        n = int(rng.integers(0, 64))
+        blob = bytes(rng.integers(0, 256, n, dtype=np.uint8))
+        try:
+            wire.read_ssf(io.BytesIO(blob))
+        except (wire.FramingError, wire.SSFParseError, ValueError,
+                EOFError):
+            pass
