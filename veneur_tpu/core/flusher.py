@@ -72,6 +72,19 @@ def _histo_readout(stats, imp, means, weights, qs):
 
 
 @jax.jit
+def _histo_readout_rows(stats, imp, means, weights, qs, idx):
+    """_histo_readout restricted to a padded row-index slice: both the
+    readback bytes and the quantile kernel's batched sort scale with
+    the touched-row count instead of the table capacity."""
+    st = stats[idx]
+    comb = _combine_stats(st, imp[idx])
+    qvals = tdigest._quantile(means[idx], weights[idx], qs,
+                              comb[:, segment.STAT_MIN],
+                              comb[:, segment.STAT_MAX])
+    return st, comb, qvals
+
+
+@jax.jit
 def _gather_rows(plane, idx):
     """Compact selected rows on device before readback — d2h over the
     tunnel is ~10 MB/s, so reading a full register/centroid plane to
@@ -149,10 +162,31 @@ class Flusher:
         but async copies overlap to a single latency."""
         devs: dict = {}
         pre: dict = {}
+        expand: list = []  # (dev_key, out_key, rows, full shape)
+
+        def _plane_readback(key, plane, touched, meta_len):
+            """Read back only the TOUCHED rows when they are sparse:
+            a 256k-row counter plane is ~1 MB of d2h per flush at
+            ~4 MB/s tunnel bandwidth, but the touched slice usually
+            is not.  The gathered values are re-scattered into a
+            full-size host array so consumers index by absolute row
+            either way."""
+            rows = np.nonzero(touched[:meta_len])[0]
+            total = plane.shape[0]
+            if len(rows) * 2 >= total:
+                devs[key] = plane
+                return
+            idx, _ = _pad_idx(rows)
+            devs[key + "_g"] = _gather_rows(plane, idx)
+            expand.append((key + "_g", key, rows, plane.shape))
+
         if snap.counter_meta and snap.counter_touched.any():
-            devs["counters"] = snap.counters
+            _plane_readback("counters", snap.counters,
+                            snap.counter_touched,
+                            len(snap.counter_meta))
         if snap.gauge_meta and snap.gauge_touched.any():
-            devs["gauges"] = snap.gauges
+            _plane_readback("gauges", snap.gauges, snap.gauge_touched,
+                            len(snap.gauge_meta))
 
         histo_rows = np.nonzero(
             snap.histo_touched[:len(snap.histo_meta)])[0]
@@ -168,18 +202,47 @@ class Flusher:
             need_q = bool(all_pcts) and (
                 emit_pcts or "median" in self.aggregates or
                 any_local_scope)
-            if need_q:
-                qs = np.asarray(all_pcts, np.float32)
-                comb, qvals = _histo_readout(
-                    snap.histo_stats, snap.histo_import_stats,
-                    snap.histo_means, snap.histo_weights,
-                    jnp.asarray(qs))
-                devs["qvals"] = qvals
+            sparse = len(histo_rows) * 2 < snap.histo_stats.shape[0]
+            if sparse:
+                # slice the touched rows on device FIRST: the stat
+                # planes and the quantile kernel (a batched sort over
+                # every digest row) then cost O(touched), and the d2h
+                # readback shrinks the same way
+                idx, _ = _pad_idx(histo_rows)
+                if need_q:
+                    qs = np.asarray(all_pcts, np.float32)
+                    st_g, comb_g, qvals_g = _histo_readout_rows(
+                        snap.histo_stats, snap.histo_import_stats,
+                        snap.histo_means, snap.histo_weights,
+                        jnp.asarray(qs), idx)
+                    devs["qvals_g"] = qvals_g
+                    expand.append(("qvals_g", "qvals", histo_rows,
+                                   (snap.histo_stats.shape[0],
+                                    len(all_pcts))))
+                else:
+                    st_g = _gather_rows(snap.histo_stats, idx)
+                    comb_g = _combine_stats(
+                        st_g, _gather_rows(snap.histo_import_stats,
+                                           idx))
+                devs["stats_g"] = st_g
+                devs["comb_g"] = comb_g
+                shape5 = (snap.histo_stats.shape[0],
+                          segment.HISTO_STAT_COLS)
+                expand.append(("stats_g", "stats", histo_rows, shape5))
+                expand.append(("comb_g", "comb", histo_rows, shape5))
             else:
-                comb = _combine_stats(snap.histo_stats,
-                                      snap.histo_import_stats)
-            devs["stats"] = snap.histo_stats
-            devs["comb"] = comb
+                if need_q:
+                    qs = np.asarray(all_pcts, np.float32)
+                    comb, qvals = _histo_readout(
+                        snap.histo_stats, snap.histo_import_stats,
+                        snap.histo_means, snap.histo_weights,
+                        jnp.asarray(qs))
+                    devs["qvals"] = qvals
+                else:
+                    comb = _combine_stats(snap.histo_stats,
+                                          snap.histo_import_stats)
+                devs["stats"] = snap.histo_stats
+                devs["comb"] = comb
             fwd = [int(r) for r in histo_rows
                    if self._forwardable(snap.histo_meta[r], always=True)]
             pre["histo_fwd"] = fwd
@@ -220,6 +283,11 @@ class Flusher:
                 if need_est:
                     devs["ests"] = hll.estimate(regs)
         pre.update(jax.device_get(devs))
+        for dev_key, out_key, rows, shape in expand:
+            got = pre.pop(dev_key)
+            full = np.zeros(shape, got.dtype)
+            full[rows] = got[:len(rows)]
+            pre[out_key] = full
         return pre
 
     # ------------------------------------------------------------------
