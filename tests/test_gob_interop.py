@@ -1,0 +1,117 @@
+"""Reference HTTP-import wire compatibility: the gob/binary JSONMetric
+codec (forward/gob_codec.py) and both directions of the /import
+schema bridge — a Go local's wire decodes into our global, and our
+local can emit the Go wire (forward_json_schema: reference)."""
+
+import base64
+import json
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from veneur_tpu.core.flusher import Flusher
+from veneur_tpu.core.table import MetricTable, TableConfig
+from veneur_tpu.forward import gob_codec, hll_codec, http_import
+from veneur_tpu.protocol import dogstatsd as dsd
+
+REF_FIXTURE = "/root/reference/testdata/import.uncompressed"
+
+
+def test_digest_gob_roundtrip():
+    rng = np.random.default_rng(3)
+    means = rng.gamma(2, 30, 150).astype(np.float32)
+    weights = rng.integers(1, 50, 150).astype(np.float32)
+    enc = gob_codec.encode_digest(means, weights, 100.0,
+                                  float(means.min()),
+                                  float(means.max()), 0.25)
+    d = gob_codec.decode_digest(enc)
+    np.testing.assert_allclose(d["means"], means, rtol=1e-6)
+    np.testing.assert_allclose(d["weights"], weights)
+    assert d["min"] == pytest.approx(float(means.min()), rel=1e-6)
+    assert d["rsum"] == pytest.approx(0.25)
+
+
+def test_digest_gob_zero_fields_omitted():
+    """gob omits zero-valued struct fields; both directions must
+    handle centroids with mean 0."""
+    enc = gob_codec.encode_digest([0.0, 3.0], [2.0, 1.0], 100.0,
+                                  0.0, 3.0, 0.0)
+    d = gob_codec.decode_digest(enc)
+    assert list(d["means"]) == [0.0, 3.0]
+    assert list(d["weights"]) == [2.0, 1.0]
+
+
+def test_decode_rejects_garbage():
+    for blob in (b"", b"\x01", b"\xff\xff\xff", bytes(64)):
+        with pytest.raises(gob_codec.GobCodecError):
+            gob_codec.decode_digest(blob)
+
+
+@pytest.mark.skipif(not os.path.exists(REF_FIXTURE),
+                    reason="reference tree not mounted")
+def test_reference_fixture_imports_end_to_end():
+    """The reference's own checked-in /import body (a REAL Go-encoded
+    gob digest) must decode byte-for-byte and merge into a table with
+    the exact centroid content Go wrote: (1,2,7,8,100) weight 1."""
+    items = json.loads(open(REF_FIXTURE, "rb").read())
+    table = MetricTable(TableConfig())
+    acc, dropped = http_import.apply_import(table, items)
+    assert (acc, dropped) == (1, 0)
+    snap = table.swap()
+    assert snap.histo_meta[0].name == "a.b.c"
+    w = np.asarray(snap.histo_weights)[0]
+    m = np.asarray(snap.histo_means)[0]
+    live = sorted(zip(m[w > 0], w[w > 0]))
+    assert [(round(float(a), 4), float(b)) for a, b in live] == [
+        (1.0, 1.0), (2.0, 1.0), (7.0, 1.0), (8.0, 1.0), (100.0, 1.0)]
+    st = np.asarray(snap.histo_import_stats)[0]
+    assert st[0] == 5.0  # weight
+    assert st[1] == 1.0 and st[2] == 100.0  # min/max
+
+
+@pytest.mark.skipif(not os.path.exists(REF_FIXTURE),
+                    reason="reference tree not mounted")
+def test_reference_deflate_fixture_decodes():
+    raw = open("/root/reference/testdata/import.deflate", "rb").read()
+    items = http_import.decode_body(raw, content_encoding="deflate")
+    assert items[0]["name"] == "a.b.c"
+
+
+def test_reference_schema_forward_roundtrip():
+    """Our local emitting forward_json_schema=reference wire, merged
+    by our global: counters/gauges/digests/sets all survive with
+    correct values (the same bytes an unmodified Go global reads)."""
+    rng = np.random.default_rng(11)
+    src = MetricTable(TableConfig())
+    vals = rng.gamma(2.0, 30.0, 3000).astype(np.float32)
+    for v in vals:
+        src.ingest(dsd.Sample(name="lat", type=dsd.TIMER,
+                              value=float(v)))
+    for i in range(800):
+        src.ingest(dsd.Sample(name="uniq", type=dsd.SET,
+                              value=f"u{i}".encode()))
+    src.ingest(dsd.Sample(name="total", type=dsd.COUNTER, value=41.0,
+                          scope=dsd.SCOPE_GLOBAL))
+    src.ingest(dsd.Sample(name="depth", type=dsd.GAUGE, value=2.5,
+                          scope=dsd.SCOPE_GLOBAL))
+    res = Flusher(is_local=True).flush(src.swap())
+    body, headers = http_import.encode_rows_reference(res.forward)
+    items = http_import.decode_body(
+        body, headers.get("Content-Encoding", ""))
+    # every item is reference-shaped: opaque base64 value string
+    assert all(isinstance(it["value"], str) for it in items)
+
+    dst = MetricTable(TableConfig())
+    acc, dropped = http_import.apply_import(dst, items)
+    assert dropped == 0 and acc == len(items)
+    out = Flusher(is_local=False, percentiles=(0.5, 0.99)).flush(
+        dst.swap())
+    m = {x.name: x for x in out.metrics}
+    assert m["total"].value == 41.0
+    assert m["depth"].value == 2.5
+    assert m["uniq"].value == pytest.approx(800, rel=0.05)
+    for p, q in ((0.5, "lat.50percentile"), (0.99, "lat.99percentile")):
+        assert m[q].value == pytest.approx(
+            float(np.quantile(vals, p)), rel=0.03)

@@ -77,6 +77,12 @@ class Config:
     # forwarding / tiering
     forward_address: str = ""
     forward_use_grpc: bool = False
+    # HTTP /import wire schema when forwarding: "native" (default)
+    # carries scope; "reference" emits the reference's JSONMetric
+    # format (gob digests, LE counter/gauge, axiomhq HLL binary) so an
+    # unmodified Go global can receive this local.  Inbound /import
+    # always accepts BOTH schemas.
+    forward_json_schema: str = "native"
 
     # span plane (reference: indicator_span_timer_name,
     # objective_span_timer_name config keys; ssf_buffer via SpanChan)
@@ -187,6 +193,9 @@ class Config:
                 problems.append(f"unknown aggregate: {a}")
         if self.metric_max_length <= 0:
             problems.append("metric_max_length must be positive")
+        if self.forward_json_schema not in ("reference", "native"):
+            problems.append(
+                "forward_json_schema must be 'reference' or 'native'")
         for n in ("tpu_counter_rows", "tpu_gauge_rows", "tpu_histo_rows",
                   "tpu_set_rows", "span_channel_capacity",
                   "reader_batch_packets", "tpu_stage_flush_samples"):

@@ -1018,7 +1018,10 @@ class Server:
             self.bump("forward_post_metrics", len(rows))
 
     def _forward_http(self, rows) -> None:
-        body, headers = http_import.encode_rows(rows)
+        if self.config.forward_json_schema == "reference":
+            body, headers = http_import.encode_rows_reference(rows)
+        else:
+            body, headers = http_import.encode_rows(rows)
         url = self.config.forward_address.rstrip("/") + "/import"
         if not url.startswith("http"):
             url = "http://" + url

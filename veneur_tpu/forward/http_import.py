@@ -4,8 +4,11 @@ Plays the role of the reference's HTTP+JSON forward path
 (flusher.go:363 ``flushForward`` -> handlers_global.go:60
 ``handleImport``), carrying mergeable per-series state.  The reference
 encodes sampler state as Go gob inside JSONMetric.Value
-(samplers/samplers.go:678); gob is a Go-specific format, so this
-framework uses an explicit JSON schema with base64 payloads instead:
+(samplers/samplers.go:678).  TWO schemas are spoken here: the native
+one below (explicit JSON with base64 payloads, carries scope), and
+the reference's own JSONMetric wire (gob digests etc. — see
+``encode_rows_reference``/``_apply_reference_item``), which inbound
+/import always accepts and ``forward_json_schema: reference`` emits:
 
     {"name", "type", "tags": [...], "scope",
      "value":        <float>            (counter/gauge)
@@ -62,12 +65,56 @@ def encode_rows(rows: list[ForwardRow], deflate: bool = True) -> tuple[
                 zlib.compress(np.asarray(r.regs, np.uint8).tobytes())
             ).decode()
         items.append(item)
+    return _finish_body(items, deflate)
+
+
+def _finish_body(items: list[dict], deflate: bool) -> tuple[
+        bytes, dict[str, str]]:
     body = json.dumps(items).encode()
     headers = {"Content-Type": "application/json"}
     if deflate:
         body = zlib.compress(body)
         headers["Content-Encoding"] = "deflate"
     return body, headers
+
+
+def encode_rows_reference(rows: list[ForwardRow],
+                          deflate: bool = True) -> tuple[
+        bytes, dict[str, str]]:
+    """ForwardRows -> the REFERENCE's JSONMetric wire format
+    (samplers/samplers.go:95, Export methods :162/:278/:455/:678):
+    counter = LE int64, gauge = LE float64, set = axiomhq HLL binary,
+    histogram = gob MergingDigest — so this local can forward into an
+    unmodified Go global.  The schema carries no scope field (neither
+    does the reference's), so scope-sensitive deployments can keep the
+    native schema via ``forward_json_schema: native``."""
+    from veneur_tpu.forward import gob_codec, hll_codec
+    items = []
+    for r in rows:
+        item: dict = {"name": r.meta.name,
+                      "type": (r.meta.type if r.kind == "histo"
+                               else r.kind),
+                      "tags": list(r.meta.tags),
+                      "tagstring": ",".join(r.meta.tags)}
+        if r.kind == "counter":
+            val = gob_codec.encode_counter(r.value)
+        elif r.kind == "gauge":
+            val = gob_codec.encode_gauge(r.value)
+        elif r.kind == "histo":
+            from veneur_tpu.ops import segment
+            st = np.asarray(r.stats, np.float32)
+            val = gob_codec.encode_digest(
+                r.means, r.weights, 100.0,
+                float(st[segment.STAT_MIN]),
+                float(st[segment.STAT_MAX]),
+                float(st[segment.STAT_RSUM]))
+        elif r.kind == "set":
+            val = hll_codec.encode_dense(np.asarray(r.regs, np.uint8))
+        else:
+            continue
+        item["value"] = base64.b64encode(val).decode()
+        items.append(item)
+    return _finish_body(items, deflate)
 
 
 def decode_body(body: bytes, content_encoding: str = "") -> list[dict]:
@@ -77,6 +124,41 @@ def decode_body(body: bytes, content_encoding: str = "") -> list[dict]:
     if not isinstance(items, list):
         raise ValueError("import body must be a JSON array")
     return items
+
+
+def _apply_reference_item(table: MetricTable, it: dict) -> bool:
+    """Merge one REFERENCE-schema JSONMetric (opaque base64 value;
+    the wire a Go local's flushForward produces)."""
+    from veneur_tpu.forward import gob_codec, hll_codec
+    from veneur_tpu.ops import segment
+    name = it["name"]
+    mtype = it.get("type", "")
+    tags = it.get("tags") or ()
+    if not tags and it.get("tagstring"):
+        tags = it["tagstring"].split(",")
+    tags = tuple(tags)
+    val = base64.b64decode(it["value"])
+    if mtype == "counter":
+        return table.import_counter(name, tags,
+                                    gob_codec.decode_counter(val))
+    if mtype == "gauge":
+        return table.import_gauge(name, tags,
+                                  gob_codec.decode_gauge(val))
+    if mtype in ("histogram", "timer"):
+        d = gob_codec.decode_digest(val)
+        w = float(d["weights"].sum())
+        stats = np.asarray(
+            [w,
+             d["min"] if w else segment.STAT_MIN_EMPTY,
+             d["max"] if w else segment.STAT_MAX_EMPTY,
+             float((d["means"] * d["weights"]).sum()),
+             d["rsum"]], np.float32)
+        return table.import_histo(
+            name, dsd.TIMER if mtype == "timer" else dsd.HISTOGRAM,
+            tags, stats, d["means"], d["weights"])
+    if mtype == "set":
+        return table.import_set(name, tags, hll_codec.decode(val))
+    raise ValueError(f"unknown reference import type {mtype!r}")
 
 
 def apply_import(table: MetricTable, items: list[dict]) -> tuple[int, int]:
@@ -89,6 +171,14 @@ def apply_import(table: MetricTable, items: list[dict]) -> tuple[int, int]:
         # without aborting the rest of the batch (the reference drops
         # and counts bad imports the same way)
         try:
+            if "kind" not in it and isinstance(it.get("value"), str):
+                # reference JSONMetric: opaque base64 value bytes and
+                # no "kind" field (native items always carry one, and
+                # their counter/gauge "value" is a JSON number)
+                ok = _apply_reference_item(table, it)
+                accepted += int(ok)
+                dropped += int(not ok)
+                continue
             tags = tuple(it.get("tags", ()))
             kind = it.get("kind") or it.get("type")
             name = it["name"]
