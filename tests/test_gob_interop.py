@@ -115,3 +115,16 @@ def test_reference_schema_forward_roundtrip():
     for p, q in ((0.5, "lat.50percentile"), (0.99, "lat.99percentile")):
         assert m[q].value == pytest.approx(
             float(np.quantile(vals, p)), rel=0.03)
+
+
+@pytest.mark.skipif(not os.path.exists(REF_FIXTURE),
+                    reason="reference tree not mounted")
+def test_proxy_routes_reference_items(monkeypatch):
+    """A Go local's /import body (tags: null, gob value) must route
+    through the proxy on its MetricKey without touching the opaque
+    value."""
+    from veneur_tpu.core.proxy import Proxy
+
+    items = json.loads(open(REF_FIXTURE, "rb").read())
+    key = Proxy._json_key(items[0])
+    assert key == "a.b.c|histogram|"

@@ -172,8 +172,11 @@ class ProxyServer:
 
     @staticmethod
     def _json_key(item: dict) -> str:
-        return (f"{item.get('name')}|{item.get('type')}|"
-                f"{','.join(item.get('tags', ()))}")
+        # reference JSONMetric items may carry tags: null with the
+        # joined form in "tagstring"
+        tags = item.get("tags") or ()
+        joined = ",".join(tags) if tags else item.get("tagstring", "")
+        return f"{item.get('name')}|{item.get('type')}|{joined}"
 
     def route_pb_metrics(self, metrics: list) -> None:
         """Group by destination and forward over gRPC, one task per
