@@ -119,12 +119,12 @@ def test_reference_schema_forward_roundtrip():
 
 @pytest.mark.skipif(not os.path.exists(REF_FIXTURE),
                     reason="reference tree not mounted")
-def test_proxy_routes_reference_items(monkeypatch):
+def test_proxy_routes_reference_items():
     """A Go local's /import body (tags: null, gob value) must route
     through the proxy on its MetricKey without touching the opaque
     value."""
-    from veneur_tpu.core.proxy import Proxy
+    from veneur_tpu.core.proxy import ProxyServer
 
     items = json.loads(open(REF_FIXTURE, "rb").read())
-    key = Proxy._json_key(items[0])
+    key = ProxyServer._json_key(items[0])
     assert key == "a.b.c|histogram|"
