@@ -152,3 +152,26 @@ def test_entry_compiles_single_device():
     fn, args = g.entry()
     out = jax.jit(fn)(*args)
     assert len(out) == 7
+
+
+@pytest.mark.parametrize("n_devices", [1, 2, 4])
+def test_small_meshes_aggregate_correctly(n_devices):
+    """Degenerate and small meshes (single chip, a 2-chip board) must
+    produce the same exact counts as the 8-device mesh — shape
+    assumptions about the shard axis tend to break exactly here."""
+    devs = jax.devices()[:n_devices]
+    mesh = make_mesh(devs)
+    cfg = ShardedConfig(rows=16, set_rows=4, slots=16, batch=64)
+    agg = ShardedAggregator(mesh, cfg)
+    rng = np.random.default_rng(n_devices)
+    exact = np.zeros(cfg.rows)
+    for shard in range(agg.n_shard):
+        rows = rng.integers(0, cfg.rows, 40, dtype=np.int32)
+        vals = rng.normal(2.0, 0.5, 40).astype(np.float32)
+        np.add.at(exact, rows, vals)
+        agg.stage(shard, counter_rows=rows, counter_vals=vals,
+                  counter_wts=np.ones(40, np.float32))
+    agg.step()
+    out = agg.flush(qs=(0.5,))
+    np.testing.assert_allclose(np.asarray(out["counters"]), exact,
+                               rtol=1e-4, atol=1e-3)
