@@ -457,30 +457,15 @@ def bench_global_merge() -> dict:
 
 
 def _device_probe() -> str | None:
-    """Probe the device in a SUBPROCESS with a hard timeout: when the
-    tunnel service is down, backend init hangs indefinitely inside the
-    client — a hung probe child can be killed, a hung import in this
-    process cannot.  Returns None when healthy, else an error string
-    (timeout vs child-failure distinguished, stderr tail included so
-    an environment breakage can't masquerade as an outage)."""
-    import subprocess
+    """Probe the device in a killable SUBPROCESS (see
+    utils/devprobe: a hung tunnel blocks backend init inside the
+    client and can even survive a kill+wait through inherited pipes).
+    Returns None when healthy, else an error string."""
+    from veneur_tpu.utils import devprobe
     timeout_s = 240.0
     if _BUDGET > 0:
         timeout_s = min(timeout_s, _BUDGET)
-    code = ("import jax, numpy, jax.numpy as jnp;"
-            "a = jnp.asarray(numpy.zeros(8, numpy.float32));"
-            "a.block_until_ready()")
-    try:
-        r = subprocess.run([sys.executable, "-c", code],
-                           timeout=timeout_s, capture_output=True)
-    except subprocess.TimeoutExpired:
-        return (f"device unreachable: probe hung past {timeout_s:.0f}s "
-                "(tunnel service down)")
-    if r.returncode == 0:
-        return None
-    tail = r.stderr.decode(errors="replace").strip().splitlines()
-    return ("device probe failed (rc={}): {}".format(
-        r.returncode, "; ".join(tail[-2:]) if tail else "no stderr"))
+    return devprobe.probe_device(timeout_s)
 
 
 def main() -> None:

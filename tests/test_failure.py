@@ -99,3 +99,34 @@ def test_raising_sink_isolated_from_others(make_server):
     vals = [m.value for m in cap.metrics if m.name == "ok"]
     assert 5.0 in vals and 6.0 in vals
     assert server.stats.get("flush_errors", 0) >= 1
+
+
+def test_table_init_failure_retries_on_cpu(monkeypatch):
+    """A flapping accelerator can pass the startup probe and then
+    fail backend init: Server must retry the table on the CPU
+    backend instead of dying (metrics flow > speed)."""
+    import veneur_tpu.core.server as srv
+
+    real_table = srv.MetricTable
+    calls = {"n": 0}
+
+    class Flaky:
+        def __new__(cls, cfg):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("Unable to initialize backend")
+            return real_table(cfg)
+
+    monkeypatch.setattr(srv, "MetricTable", Flaky)
+    cfg = read_config(data={"statsd_listen_addresses":
+                            ["udp://127.0.0.1:0"],
+                            "interval": "50ms",
+                            "accelerator_probe_timeout": "1s"})
+    s = Server(cfg, extra_sinks=[CaptureSink()])
+    try:
+        assert calls["n"] == 2  # failed once, retried on cpu
+        from veneur_tpu.protocol import dogstatsd as dsd
+        s.table.ingest(dsd.parse_metric(b"ok:1|c"))
+        s.flush_once()
+    finally:
+        s.shutdown()

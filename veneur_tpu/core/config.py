@@ -153,6 +153,10 @@ class Config:
     # watchdog model) pays ~0.3s per kernel instead of 20-40s cold
     # compiles.  Empty disables.
     compile_cache_dir: str = ""
+    # startup accelerator probe: if the default device backend cannot
+    # be initialized within this window (subprocess probe), fall back
+    # to the CPU backend and keep serving.  "0s" disables the probe.
+    accelerator_probe_timeout: str = "60s"
     sentry_dsn: str = ""
     stats_address: str = ""
 
@@ -167,6 +171,9 @@ class Config:
     # bounding host staging memory and smoothing device work instead of
     # landing the whole interval's batch at the flush boundary
     tpu_stage_flush_samples: int = 65536
+
+    def accelerator_probe_timeout_seconds(self) -> float:
+        return parse_duration(self.accelerator_probe_timeout)
 
     def interval_seconds(self) -> float:
         return parse_duration(self.interval)

@@ -51,6 +51,7 @@ class Server:
                  extra_plugins: list | None = None,
                  extra_span_sinks: list | None = None):
         self.config = config
+        self._maybe_fall_back_to_cpu()
         if config.compile_cache_dir:
             # before the table below triggers the first jit compiles;
             # restarts then hit the on-disk cache (the fast half of
@@ -59,13 +60,34 @@ class Server:
             compile_cache.enable(config.compile_cache_dir)
         self.interval = config.interval_seconds()
         self.is_local = config.is_local()
-        self.table = MetricTable(TableConfig(
+        table_cfg = TableConfig(
             counter_rows=config.tpu_counter_rows,
             gauge_rows=config.tpu_gauge_rows,
             histo_rows=config.tpu_histo_rows,
             set_rows=config.tpu_set_rows,
             compression=config.tpu_compression,
-            histo_slots=config.tpu_histo_slots))
+            histo_slots=config.tpu_histo_slots)
+        try:
+            self.table = MetricTable(table_cfg)
+        except RuntimeError as e:
+            # a flapping link can pass the probe and then fail init;
+            # same policy as the probe: metrics flow on CPU.  Only
+            # backend-initialization failures qualify — an HBM OOM
+            # from an oversized table config must surface, not switch
+            # the operator to CPU silently
+            if (self.config.accelerator_probe_timeout_seconds() <= 0
+                    or "initialize backend" not in str(e)):
+                raise
+            log.warning("accelerator backend init failed (%s); "
+                        "retrying on the CPU backend", e)
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            try:
+                from jax.extend.backend import clear_backends
+                clear_backends()
+            except Exception:
+                pass
+            self.table = MetricTable(table_cfg)
         self.lock = threading.Lock()
         self.flusher = Flusher(
             is_local=self.is_local,
@@ -372,6 +394,10 @@ class Server:
                                     socket.SO_REUSEPORT, 1)
                 sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
                                 self.config.read_buffer_size_bytes)
+                # periodic wake: SO_REUSEPORT hashes the shutdown
+                # wake datagram to ONE group member, so a timeout is
+                # the guarantee every reader re-checks _shutdown
+                sock.settimeout(1.0)
                 sock.bind((host, port))
                 port = sock.getsockname()[1]  # resolve port 0 once
                 self._sockets.append(sock)
@@ -466,6 +492,8 @@ class Server:
         while not self._shutdown.is_set():
             try:
                 data = sock.recv(bufsize)
+            except TimeoutError:
+                continue  # periodic shutdown check (see settimeout)
             except OSError:
                 return
             if not data:
@@ -555,6 +583,8 @@ class Server:
         while not self._shutdown.is_set():
             try:
                 data = sock.recv(bufsize)
+            except TimeoutError:
+                continue  # periodic shutdown check (see settimeout)
             except OSError:
                 return
             if not data:
@@ -582,17 +612,10 @@ class Server:
                     # the blocking path
                     n_pkts += int(drain_over.value)
                     self.bump("packet_errors", int(drain_over.value))
-            else:
-                # no drain (library without the symbol, e.g. a stale
-                # cached .so): per-packet non-blocking sweep
-                try:
-                    while len(batch) < max_batch:
-                        more = sock.recv(bufsize, socket.MSG_DONTWAIT)
-                        if more:  # empty datagrams silently ignored,
-                            batch.append(more)  # as on blocking path
-                except (BlockingIOError, OSError):
-                    pass
-                n_pkts = len(batch)
+            # (no native drain — library without the symbol, e.g. a
+            # stale cached .so: packets process one per loop; a
+            # MSG_DONTWAIT sweep would BLOCK on the timeout socket,
+            # CPython retries flagged recvs until the timeout)
             self.handle_packet_batch(
                 batch, parser, drained=drained,
                 drained_pkts=int(drain_n.value) if drained else 0)
@@ -648,8 +671,10 @@ class Server:
                 conn, _ = sock.accept()
             except _ssl.SSLError:
                 # failed handshake (bad/missing client cert, protocol
-                # junk): count and keep accepting
-                self.bump("tls_handshake_errors")
+                # junk): count and keep accepting — except the
+                # shutdown wake connection, which is self-inflicted
+                if not self._shutdown.is_set():
+                    self.bump("tls_handshake_errors")
                 continue
             except OSError:
                 return
@@ -668,7 +693,8 @@ class Server:
             try:
                 conn.do_handshake()
             except (OSError, _ssl.SSLError):
-                self.bump("tls_handshake_errors")
+                if not self._shutdown.is_set():
+                    self.bump("tls_handshake_errors")
                 conn.close()
                 return
         buf = b""
@@ -996,6 +1022,31 @@ class Server:
                 self._sink_durations.get(sink.name, 0) +
                 time.monotonic_ns() - t0)
 
+    def _maybe_fall_back_to_cpu(self) -> None:
+        """Metrics must flow even when the accelerator is sick: probe
+        the default backend in a killable SUBPROCESS (an unreachable
+        tunneled device hangs init inside the client), and fall back
+        to the CPU backend on failure so the agent still boots and
+        serves — slower, never dead.  Skipped when a platform is
+        already pinned (tests pin cpu) or the timeout is 0."""
+        timeout = self.config.accelerator_probe_timeout_seconds()
+        if timeout <= 0:
+            return
+        import jax
+        # skip only when pinned to CPU (tests): the deployment image
+        # pins the TUNNEL platform at interpreter start, which is
+        # exactly the pin that must be overridden when the link is
+        # dead
+        if jax.config.jax_platforms == "cpu":
+            return
+        from veneur_tpu.utils import devprobe
+        why = devprobe.probe_device(timeout)
+        if why is None:
+            return
+        log.warning("accelerator unreachable (%s); falling back to "
+                    "the CPU backend so metrics keep flowing", why)
+        jax.config.update("jax_platforms", "cpu")
+
     def _forward(self, rows) -> None:
         """Ship mergeable state upstream over gRPC or HTTP (reference
         flusher.go:82-99: forwardGRPC when configured, else
@@ -1076,15 +1127,38 @@ class Server:
 
     def shutdown(self) -> None:
         self._shutdown.set()
+        # wake every datagram reader BEFORE closing: on Linux a
+        # close() does NOT interrupt a thread blocked in recv, so the
+        # reader would sit in the dead syscall until killed mid-C-call
+        # at interpreter exit (observed as glibc 'FATAL: exception not
+        # rethrown' aborts after otherwise-green runs).  An empty
+        # datagram pops the recv; the loop then sees _shutdown.
+        for s in self._sockets:
+            try:
+                if s.type == socket.SOCK_DGRAM:
+                    wake = socket.socket(s.family, socket.SOCK_DGRAM)
+                    wake.sendto(b"", s.getsockname())
+                    wake.close()
+                else:  # listening TCP: accept() needs a connection
+                    wake = socket.socket(s.family, socket.SOCK_STREAM)
+                    wake.settimeout(0.5)
+                    wake.connect(s.getsockname())
+                    wake.close()
+            except OSError:
+                pass
         for s in self._sockets:
             try:
                 s.close()
             except OSError:
                 pass
+        # stop the HTTP server before joining: its serve_forever
+        # thread is in _threads and only returns on shutdown()
         if self._httpd:
             self._httpd.shutdown()
         for g in self.grpc_servers:
             g.stop()
+        for t in self._threads:
+            t.join(timeout=1.5)
         self.trace_client.close()
         self.span_worker.stop()
         if self.config.enable_profiling:

@@ -1,0 +1,51 @@
+"""Killable accelerator-reachability probe.
+
+An unreachable tunneled device hangs JAX backend init INSIDE the
+client library, so the probe must run in a subprocess.  Two classic
+subprocess gotchas are handled here, both observed in this
+environment:
+
+- ``subprocess.run(capture_output=True, timeout=...)`` calls
+  ``communicate()`` with no timeout after killing the child; if the
+  stuck client forked (or the child sits uninterruptible in the
+  tunnel transport), the pipe never closes and the caller hangs
+  anyway.  Output goes to a temp file instead of pipes.
+- the post-kill ``wait()`` can block on a D-state child; it gets its
+  own short timeout and the zombie is abandoned (reaped at our exit).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+
+_PROBE_CODE = ("import jax, numpy, jax.numpy as jnp;"
+               "a = jnp.asarray(numpy.zeros(8, numpy.float32));"
+               "a.block_until_ready()")
+
+
+def probe_device(timeout_s: float) -> str | None:
+    """Returns None when the default backend is reachable, else a
+    one-line error description."""
+    with tempfile.TemporaryFile() as errf:
+        p = subprocess.Popen([sys.executable, "-c", _PROBE_CODE],
+                             stdout=subprocess.DEVNULL, stderr=errf)
+        try:
+            rc = p.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass  # uninterruptible child: abandon it
+            return (f"probe did not finish in {timeout_s:.0f}s "
+                    "(device link hung)")
+        if rc == 0:
+            return None
+        errf.seek(0)
+        tail = errf.read().decode(errors="replace").strip()
+        lines = tail.splitlines()
+        return ("probe failed (rc={}): {}".format(
+            rc, lines[-1] if lines else "no stderr"))
