@@ -218,3 +218,61 @@ def test_proxy_http_import_path(chain):
     # this fixture only listen on gRPC, so deliveries fail — but the
     # proxy must count routing and failures, not crash
     assert _wait(lambda: proxy.stats.get("metrics_routed", 0) >= 10)
+
+
+def test_reference_wire_through_http_proxy():
+    """A local emitting the REFERENCE JSONMetric wire
+    (forward_json_schema: reference) -> proxy HTTP /import -> two
+    globals: routing happens on the outer JSON fields, the opaque gob
+    values pass through untouched, and each series lands on exactly
+    one global with correct aggregates."""
+    import numpy as np
+
+    from veneur_tpu.protocol import dogstatsd as dsd
+
+    servers, caps = [], []
+    for _ in range(2):
+        cap = CaptureSink()
+        g = Server(read_config(data={
+            "http_address": "127.0.0.1:0", "interval": "10s",
+            "percentiles": [0.5]}), extra_sinks=[cap])
+        g.start()
+        servers.append(g)
+        caps.append(cap)
+    dests = ",".join(f"127.0.0.1:{g.http_port}" for g in servers)
+    proxy = ProxyServer(ProxyConfig(
+        forward_address=dests, http_address="127.0.0.1:0"))
+    proxy.start()
+
+    local = Server(read_config(data={
+        "forward_address": f"http://127.0.0.1:{proxy.http_port}",
+        "forward_json_schema": "reference", "interval": "10s"}),
+        extra_sinks=[CaptureSink()])
+    local.start()
+    try:
+        rng = np.random.default_rng(21)
+        for i in range(20):
+            for v in rng.gamma(2.0, 30.0, 50):
+                local.table.ingest(dsd.parse_metric(
+                    f"ref.lat.{i}:{v:.3f}|ms".encode()))
+        local.flush_once()
+        assert _wait(lambda: sum(
+            g.stats.get("imports_received", 0) for g in servers) >= 20,
+            timeout=15.0), [g.stats for g in servers]
+        for g in servers:
+            g.flush_once()
+        got = {}
+        for ci, c in enumerate(caps):
+            for m in c.metrics:
+                if m.name.endswith(".50percentile"):
+                    got.setdefault(m.name, set()).add(ci)
+        # every forwarded series produced percentiles on EXACTLY one
+        # global (consistent-hash routing), and both globals got some
+        assert len(got) == 20, sorted(got)
+        assert all(len(v) == 1 for v in got.values())
+        assert len({ci for v in got.values() for ci in v}) == 2
+    finally:
+        local.shutdown()
+        proxy.shutdown()
+        for g in servers:
+            g.shutdown()
