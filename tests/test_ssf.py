@@ -324,3 +324,36 @@ def test_ssf_frame_decode_never_crashes_on_fuzz():
             wire.read_ssf(io.BytesIO(blob))
         except (wire.FramingError, wire.SSFParseError):
             pass
+
+
+def test_emit_cli_command_timing():
+    """veneur-emit -command wraps a child command, times it, emits the
+    timer over statsd, and passes through the child's exit status
+    (reference cmd/veneur-emit -command mode)."""
+    import socket as socket_mod
+    import sys
+
+    from veneur_tpu.cli import emit
+
+    rx = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.settimeout(5.0)
+    port = rx.getsockname()[1]
+    rc = emit.main(["-hostport", f"udp://127.0.0.1:{port}",
+                    "-name", "cmd.dur", "-tag", "k:v",
+                    "-command", sys.executable, "-c",
+                    "import time; time.sleep(0.05)"])
+    assert rc == 0
+    data = rx.recv(4096).decode()
+    assert data.startswith("cmd.dur:")
+    assert "|ms" in data and "k:v" in data
+    ms = float(data.split(":")[1].split("|")[0])
+    assert ms >= 50.0
+
+    # child exit status passes through
+    rc = emit.main(["-hostport", f"udp://127.0.0.1:{port}",
+                    "-name", "cmd.dur",
+                    "-command", sys.executable, "-c",
+                    "import sys; sys.exit(3)"])
+    assert rc == 3
+    rx.close()
