@@ -386,3 +386,31 @@ def test_udp_burst_drained_in_batches(make_server):
     assert m["burst"].value == 400.0
     assert "big" not in m
     assert server.stats["packet_errors"] >= 1
+
+
+def test_enable_profiling_writes_trace(tmp_path, monkeypatch):
+    """enable_profiling starts a jax profiler trace at startup and
+    stops it at shutdown, leaving an xplane artifact (the role of the
+    reference's enable_profiling -> pkg/profile CPU profiles,
+    server.go:1512)."""
+    monkeypatch.chdir(tmp_path)
+    server, _ = None, None
+    from veneur_tpu.core.config import read_config
+    from veneur_tpu.core.server import Server
+    s = Server(read_config(data={
+        "statsd_listen_addresses": ["udp://127.0.0.1:0"],
+        "interval": "50ms", "enable_profiling": True}))
+    s.start()
+    try:
+        s.table.ingest(
+            __import__("veneur_tpu.protocol.dogstatsd",
+                       fromlist=["parse_metric"]).parse_metric(
+                b"p:1|c"))
+        s.flush_once()
+    finally:
+        s.shutdown()
+    import os
+    found = []
+    for root, _dirs, files in os.walk(tmp_path):
+        found.extend(f for f in files if "xplane" in f or "trace" in f)
+    assert found, "no profiler artifact written"
