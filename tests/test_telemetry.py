@@ -33,6 +33,7 @@ def test_operator_metrics_emitted_via_loopback():
             "veneur.sink.metric_flush_total_duration_ns")
             for n in names)
         assert "veneur.gc.number" in names
+        assert "veneur.gc.pause_total_ns" in names
         assert "veneur.mem.heap_alloc_bytes" in names
         m = {x.name: x for x in cap.metrics}
         assert m["veneur.worker.metrics_processed_total"].value == 2.0

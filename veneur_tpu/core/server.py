@@ -834,6 +834,7 @@ class Server:
 
             def do_POST(self):
                 if self.path == "/import":
+                    t_imp0 = time.monotonic_ns()
                     length = int(self.headers.get("Content-Length", 0))
                     body = self.rfile.read(length)
                     try:
@@ -846,6 +847,9 @@ class Server:
                             server._maybe_device_step_locked()
                         server.bump("imports_received", acc)
                         server.bump("metrics_dropped", dropped)
+                        server.bump("import_response_ns",
+                                    time.monotonic_ns() - t_imp0)
+                        server.bump("import_responses")
                         self._ok(json.dumps({"accepted": acc}).encode(),
                                  "application/json")
                     except (ValueError, KeyError) as e:

@@ -24,6 +24,34 @@ import time
 from veneur_tpu.protocol import dogstatsd as dsd
 from veneur_tpu.protocol.addr import parse_addr
 
+# cumulative GC pause time via gc callbacks — the Python stand-in for
+# Go's MemStats.PauseTotalNs (reference flusher.go:36).  Installed
+# once per process; time.monotonic_ns in the callbacks costs ~100ns
+# per collection, noise next to a collection itself.
+_GC_PAUSE = {"total_ns": 0, "t0": 0, "installed": False}
+
+
+def _gc_cb(phase, info):
+    if phase == "start":
+        _GC_PAUSE["t0"] = time.monotonic_ns()
+    elif _GC_PAUSE["t0"]:
+        _GC_PAUSE["total_ns"] += time.monotonic_ns() - _GC_PAUSE["t0"]
+
+
+def _install_gc_hook() -> None:
+    # called from Telemetry.__init__, NOT at import: mutating the
+    # process-global gc.callbacks should be scoped to processes that
+    # actually emit the metric, and the flag (not an `in` check, which
+    # a reload would defeat with a fresh function object) keeps it
+    # single-registered
+    if not _GC_PAUSE["installed"]:
+        _GC_PAUSE["installed"] = True
+        gc.callbacks.append(_gc_cb)
+
+
+def _gc_pause_total_ns() -> int:
+    return _GC_PAUSE["total_ns"]
+
 log = logging.getLogger("veneur_tpu.telemetry")
 
 # stats-dict key -> (metric name, extra tags)
@@ -126,10 +154,24 @@ class Telemetry:
             timer("veneur.sink.metric_flush_total_duration_ns", dur_ns,
                   (f"sink:{sink_name}",))
 
-        # runtime stats (flusher.go:32-43: gc.number, heap bytes)
+        # import response timing (reference README:
+        # veneur.import.response_duration_ns)
+        # ns read BEFORE the count: a request landing in between
+        # contributes its count now and its ns next interval — the
+        # average can only deflate transiently, never inflate
+        imp_ns = self._delta("import_response_ns")
+        resp = self._delta("import_responses")
+        if resp:
+            timer("veneur.import.response_duration_ns",
+                  imp_ns / resp, ("part:merge",))
+
+        # runtime stats (flusher.go:32-43: gc.number, heap bytes).
+        # gc pause time comes from gc callbacks (the Python stand-in
+        # for Go's PauseTotalNs).
         counts = gc.get_stats()
         gauge("veneur.gc.number",
               sum(s.get("collections", 0) for s in counts))
+        gauge("veneur.gc.pause_total_ns", _gc_pause_total_ns())
         gauge("veneur.mem.heap_alloc_bytes",
               resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024)
         gauge("veneur.flush.flush_timestamp_ns", time.time_ns())
