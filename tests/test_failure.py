@@ -130,3 +130,80 @@ def test_table_init_failure_retries_on_cpu(monkeypatch):
         s.flush_once()
     finally:
         s.shutdown()
+
+
+def test_table_init_failure_reworded_message_still_falls_back(monkeypatch):
+    """The backend-init message text is a JAX-internal detail; a
+    rewording across upgrades must not silently disable the CPU
+    fallback."""
+    import veneur_tpu.core.server as srv
+
+    real_table = srv.MetricTable
+    calls = {"n": 0}
+
+    class Flaky:
+        def __new__(cls, cfg):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError(
+                    "PJRT plugin for tunnel device failed to start")
+            return real_table(cfg)
+
+    monkeypatch.setattr(srv, "MetricTable", Flaky)
+    cfg = read_config(data={"statsd_listen_addresses":
+                            ["udp://127.0.0.1:0"],
+                            "interval": "50ms",
+                            "accelerator_probe_timeout": "1s"})
+    s = Server(cfg, extra_sinks=[CaptureSink()])
+    try:
+        assert calls["n"] == 2
+    finally:
+        s.shutdown()
+
+
+def test_table_init_oom_surfaces(monkeypatch):
+    """An HBM OOM from an oversized table config must crash loudly,
+    never demote the operator to CPU silently."""
+    import pytest
+
+    import veneur_tpu.core.server as srv
+
+    class AlwaysOOM:
+        def __new__(cls, cfg):
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: Out of memory allocating "
+                "17179869184 bytes")
+
+    monkeypatch.setattr(srv, "MetricTable", AlwaysOOM)
+    cfg = read_config(data={"statsd_listen_addresses":
+                            ["udp://127.0.0.1:0"],
+                            "interval": "50ms",
+                            "accelerator_probe_timeout": "1s"})
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        Server(cfg, extra_sinks=[CaptureSink()])
+
+
+def test_unixgram_socket_flock_single_owner(tmp_path):
+    """Two instances must not silently split one datagram socket: the
+    second bind on the same path fails on the flock (reference
+    networking.go:362 acquireLockForSocket), and the lock is released
+    at shutdown so a restart can rebind."""
+    path = str(tmp_path / "dsd.sock")
+    cfg = lambda: read_config(data={
+        "statsd_listen_addresses": [f"unix://{path}"],
+        "interval": "10s"})
+    s1 = Server(cfg(), extra_sinks=[CaptureSink()])
+    s1.start()
+    try:
+        s2 = Server(cfg(), extra_sinks=[CaptureSink()])
+        try:
+            with pytest.raises(RuntimeError, match="lock file"):
+                s2.start()
+        finally:
+            s2.shutdown()
+    finally:
+        s1.shutdown()
+    # lock released: a restart takes the path cleanly
+    s3 = Server(cfg(), extra_sinks=[CaptureSink()])
+    s3.start()
+    s3.shutdown()

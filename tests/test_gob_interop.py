@@ -117,6 +117,33 @@ def test_reference_schema_forward_roundtrip():
             float(np.quantile(vals, p)), rel=0.03)
 
 
+def test_nonfinite_gob_import_rejected():
+    """Gob-decoded state gets the same finiteness gate as the DSD
+    parse path: one NaN centroid or inf counter must be dropped, not
+    merged into device aggregates."""
+    table = MetricTable(TableConfig())
+    bad_digest = gob_codec.encode_digest(
+        [1.0, float("nan")], [1.0, 1.0], 100.0, 1.0, 1.0, 0.0)
+    bad_counter = gob_codec.encode_counter(0)
+    items = [
+        {"name": "h", "type": "histogram", "tags": [],
+         "value": base64.b64encode(bad_digest).decode()},
+        # hand-craft an inf gauge: LE float64 +inf
+        {"name": "g", "type": "gauge", "tags": [],
+         "value": base64.b64encode(
+             np.float64(np.inf).tobytes()).decode()},
+    ]
+    acc, dropped = http_import.apply_import(table, items)
+    assert (acc, dropped) == (0, 2)
+    # finite state still flows
+    good = gob_codec.encode_digest([1.0, 2.0], [1.0, 1.0], 100.0,
+                                   1.0, 2.0, 1.5)
+    acc, dropped = http_import.apply_import(table, [
+        {"name": "h", "type": "histogram", "tags": [],
+         "value": base64.b64encode(good).decode()}])
+    assert (acc, dropped) == (1, 0)
+
+
 @pytest.mark.skipif(not os.path.exists(REF_FIXTURE),
                     reason="reference tree not mounted")
 def test_proxy_routes_reference_items():

@@ -264,7 +264,11 @@ def test_reference_wire_through_http_proxy():
         got = {}
         for ci, c in enumerate(caps):
             for m in c.metrics:
-                if m.name.endswith(".50percentile"):
+                # only the series under test: a slow run lets the flush
+                # ticker fire, which adds veneur.* self-telemetry
+                # percentiles to the capture
+                if (m.name.startswith("ref.lat.") and
+                        m.name.endswith(".50percentile")):
                     got.setdefault(m.name, set()).add(ci)
         # every forwarded series produced percentiles on EXACTLY one
         # global (consistent-hash routing), and both globals got some

@@ -73,6 +73,15 @@ class Config:
     aggregates: list[str] = field(default_factory=lambda: ["min", "max",
                                                            "count"])
     count_unique_timeseries: bool = False
+    # "precise" emits .999percentile for 0.999; "reference" keeps the
+    # Go fleet's int(p*100) truncation (samplers.go:664 — 0.999 ->
+    # .99percentile) for byte-identical mixed-fleet dashboards
+    percentile_naming: str = "precise"
+    # "interp" (default): singleton-exact rank-space interpolation —
+    # the accuracy the p99<=1% budget is measured against; "reference"
+    # reproduces the Go digest's uniform-bounds walk exactly
+    # (merging_digest.go:302) for value-identical mixed fleets
+    quantile_interpolation: str = "interp"
 
     # forwarding / tiering
     forward_address: str = ""
@@ -203,6 +212,13 @@ class Config:
         if self.forward_json_schema not in ("reference", "native"):
             problems.append(
                 "forward_json_schema must be 'reference' or 'native'")
+        if self.percentile_naming not in ("precise", "reference"):
+            problems.append(
+                "percentile_naming must be 'precise' or 'reference'")
+        if self.quantile_interpolation not in ("interp", "reference"):
+            problems.append(
+                "quantile_interpolation must be 'interp' or "
+                "'reference'")
         for n in ("tpu_counter_rows", "tpu_gauge_rows", "tpu_histo_rows",
                   "tpu_set_rows", "span_channel_capacity",
                   "reader_batch_packets", "tpu_stage_flush_samples"):

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -59,28 +60,35 @@ def _combine_stats(stats, imp):
     ], axis=1)
 
 
-@jax.jit
-def _histo_readout(stats, imp, means, weights, qs):
+@partial(jax.jit, static_argnames=("method",))
+def _histo_readout(stats, imp, means, weights, qs, method="interp"):
     """_combine_stats plus the per-row quantile kernel in one
     dispatch — used only when someone will actually emit quantiles
-    (the batched sort over every digest row is not free)."""
+    (the batched sort over every digest row is not free).  ``method``
+    selects the interpolation (see ops/tdigest.quantile): "interp"
+    (default, singleton-exact) or "reference" (Go-identical)."""
     comb = _combine_stats(stats, imp)
-    qvals = tdigest._quantile(means, weights, qs,
-                              comb[:, segment.STAT_MIN],
-                              comb[:, segment.STAT_MAX])
+    qfn = (tdigest._quantile if method == "reference"
+           else tdigest._quantile_interp)
+    qvals = qfn(means, weights, qs,
+                comb[:, segment.STAT_MIN],
+                comb[:, segment.STAT_MAX])
     return comb, qvals
 
 
-@jax.jit
-def _histo_readout_rows(stats, imp, means, weights, qs, idx):
+@partial(jax.jit, static_argnames=("method",))
+def _histo_readout_rows(stats, imp, means, weights, qs, idx,
+                        method="interp"):
     """_histo_readout restricted to a padded row-index slice: both the
     readback bytes and the quantile kernel's batched sort scale with
     the touched-row count instead of the table capacity."""
     st = stats[idx]
     comb = _combine_stats(st, imp[idx])
-    qvals = tdigest._quantile(means[idx], weights[idx], qs,
-                              comb[:, segment.STAT_MIN],
-                              comb[:, segment.STAT_MAX])
+    qfn = (tdigest._quantile if method == "reference"
+           else tdigest._quantile_interp)
+    qvals = qfn(means[idx], weights[idx], qs,
+                comb[:, segment.STAT_MIN],
+                comb[:, segment.STAT_MAX])
     return st, comb, qvals
 
 
@@ -119,10 +127,15 @@ class FlushResult:
     tally: dict[str, int] = field(default_factory=dict)
 
 
-def _percentile_suffix(p: float) -> str:
+def _percentile_suffix(p: float, naming: str = "precise") -> str:
     """Reference emits ``.50percentile`` for 0.5 (samplers.go:657);
     sub-percent quantiles keep their digits (``.999percentile``
-    for 0.999) instead of truncating."""
+    for 0.999) instead of truncating.  ``naming="reference"`` keeps
+    the Go fleet's exact ``int(p*100)`` truncation (0.999 ->
+    ``99percentile`` — colliding with 0.99, the reference's own noted
+    TODO) so mixed-fleet dashboards see byte-identical names."""
+    if naming == "reference":
+        return f"{int(p * 100)}percentile"
     scaled = p * 100
     if abs(scaled - round(scaled)) < 1e-9:
         return f"{int(round(scaled))}percentile"
@@ -133,12 +146,16 @@ class Flusher:
     def __init__(self, is_local: bool,
                  percentiles: tuple[float, ...] = DEFAULT_PERCENTILES,
                  aggregates: tuple[str, ...] = DEFAULT_AGGREGATES,
-                 hostname: str = "", tags: tuple[str, ...] = ()):
+                 hostname: str = "", tags: tuple[str, ...] = (),
+                 percentile_naming: str = "precise",
+                 quantile_interpolation: str = "interp"):
         self.is_local = is_local
         self.percentiles = tuple(percentiles)
         self.aggregates = tuple(aggregates)
         self.hostname = hostname
         self.common_tags = tuple(tags)
+        self.percentile_naming = percentile_naming
+        self.quantile_interpolation = quantile_interpolation
 
     # ------------------------------------------------------------------
 
@@ -214,7 +231,8 @@ class Flusher:
                     st_g, comb_g, qvals_g = _histo_readout_rows(
                         snap.histo_stats, snap.histo_import_stats,
                         snap.histo_means, snap.histo_weights,
-                        jnp.asarray(qs), idx)
+                        jnp.asarray(qs), idx,
+                        method=self.quantile_interpolation)
                     devs["qvals_g"] = qvals_g
                     expand.append(("qvals_g", "qvals", histo_rows,
                                    (snap.histo_stats.shape[0],
@@ -236,7 +254,8 @@ class Flusher:
                     comb, qvals = _histo_readout(
                         snap.histo_stats, snap.histo_import_stats,
                         snap.histo_means, snap.histo_weights,
-                        jnp.asarray(qs))
+                        jnp.asarray(qs),
+                        method=self.quantile_interpolation)
                     devs["qvals"] = qvals
                 else:
                     comb = _combine_stats(snap.histo_stats,
@@ -427,8 +446,9 @@ class Flusher:
         if with_percentiles and qvals is not None:
             for pi, p in enumerate(self.percentiles):
                 out.append(self._mk(
-                    f"{meta.name}.{_percentile_suffix(p)}", ts,
-                    float(qvals[row, pi]), meta, im.GAUGE))
+                    f"{meta.name}."
+                    f"{_percentile_suffix(p, self.percentile_naming)}",
+                    ts, float(qvals[row, pi]), meta, im.GAUGE))
 
     def _flush_sets(self, snap: Snapshot, ts: int, res: FlushResult,
                     pre: dict) -> None:

@@ -45,6 +45,18 @@ from veneur_tpu.sinks.simple import (BlackholeSink, DebugSink,
 
 log = logging.getLogger("veneur_tpu.server")
 
+# Substrings that mark a device allocation failure across jaxlib
+# versions (XlaRuntimeError carries the grpc-style status name).
+# These must NOT trigger the CPU fallback: an oversized table config
+# should crash loudly, not silently demote the operator to CPU.
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                "OOM", "Allocation failure")
+
+
+def _is_oom_error(e: BaseException) -> bool:
+    msg = str(e)
+    return any(m in msg for m in _OOM_MARKERS)
+
 
 class Server:
     def __init__(self, config: Config, extra_sinks: list | None = None,
@@ -71,12 +83,14 @@ class Server:
             self.table = MetricTable(table_cfg)
         except RuntimeError as e:
             # a flapping link can pass the probe and then fail init;
-            # same policy as the probe: metrics flow on CPU.  Only
-            # backend-initialization failures qualify — an HBM OOM
-            # from an oversized table config must surface, not switch
-            # the operator to CPU silently
+            # same policy as the probe: metrics flow on CPU.  Any
+            # RuntimeError this early is treated as a sick backend
+            # (the exact init message is a JAX-internal detail that
+            # changes across upgrades) — EXCEPT resource exhaustion:
+            # an HBM OOM from an oversized table config must surface,
+            # not switch the operator to CPU silently
             if (self.config.accelerator_probe_timeout_seconds() <= 0
-                    or "initialize backend" not in str(e)):
+                    or _is_oom_error(e)):
                 raise
             log.warning("accelerator backend init failed (%s); "
                         "retrying on the CPU backend", e)
@@ -94,7 +108,9 @@ class Server:
             percentiles=tuple(config.percentiles),
             aggregates=tuple(config.aggregates),
             hostname=config.hostname or socket.gethostname(),
-            tags=tuple(config.tags))
+            tags=tuple(config.tags),
+            percentile_naming=config.percentile_naming,
+            quantile_interpolation=config.quantile_interpolation)
 
         self.metric_sinks: list = list(extra_sinks or [])
         self.plugins: list = list(extra_plugins or [])
@@ -142,9 +158,18 @@ class Server:
         self._flush_pending: dict[str, object] = {}
         self._tls_context = self._build_tls()
 
+        # serializes whole flushes: the ticker thread and a manual
+        # flush_once (tests, /quitquitquit drain) must not interleave —
+        # a concurrent pair would each swap an interval and emit out of
+        # order, and a caller returning from flush_once could observe
+        # the OTHER flush's data still in flight (the reference has one
+        # flush goroutine, so this serialization is implicit there)
+        self._flush_serial = threading.Lock()
         self._shutdown = threading.Event()
         self._threads: list[threading.Thread] = []
         self._sockets: list[socket.socket] = []
+        # held flocks on unix socket paths: (lock path, open fd)
+        self._socket_locks: list[tuple[str, int]] = []
         self._httpd: http.server.ThreadingHTTPServer | None = None
         self._pool = ThreadPoolExecutor(max_workers=8)
         self.last_flush = time.monotonic()
@@ -428,6 +453,7 @@ class Server:
             t.start()
             self._threads.append(t)
         elif scheme == "unix":
+            self._acquire_socket_lock(path)
             if os.path.exists(path):
                 os.unlink(path)
             sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
@@ -441,6 +467,24 @@ class Server:
             self._threads.append(t)
         else:
             raise ValueError(f"unsupported statsd address {addr!r}")
+
+    def _acquire_socket_lock(self, path: str) -> None:
+        """Single-owner flock on ``<path>.lock`` before binding a unix
+        socket (reference networking.go:362 acquireLockForSocket):
+        without it a second instance silently unlinks-and-rebinds the
+        path and the two split the datagram stream.  The fd is held
+        for the server's lifetime and released at shutdown."""
+        import fcntl
+        lockname = path + ".lock"
+        fd = os.open(lockname, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            raise RuntimeError(
+                f"lock file {lockname!r} is held by another process "
+                f"already; refusing to take over {path!r}")
+        self._socket_locks.append((lockname, fd))
 
     def _start_grpc(self, addr: str) -> None:
         """gRPC Forward import listener — the importsrv role
@@ -470,6 +514,7 @@ class Server:
             t.start()
             self._threads.append(t)
         elif scheme == "unix":
+            self._acquire_socket_lock(path)
             if os.path.exists(path):
                 os.unlink(path)
             sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -925,7 +970,13 @@ class Server:
 
     def flush_once(self) -> FlushResult:
         """One flush: swap table state, read out, emit to sinks, forward
-        (reference flusher.go:28 Flush)."""
+        (reference flusher.go:28 Flush).  Serialized (_flush_serial):
+        when a ticker flush is in flight, a concurrent caller waits for
+        it and then flushes what's left."""
+        with self._flush_serial:
+            return self._flush_once_locked()
+
+    def _flush_once_locked(self) -> FlushResult:
         if self._shutdown.is_set():
             return FlushResult()
         t_flush0 = time.monotonic_ns()
@@ -1074,7 +1125,8 @@ class Server:
 
     def _forward_http(self, rows) -> None:
         if self.config.forward_json_schema == "reference":
-            body, headers = http_import.encode_rows_reference(rows)
+            body, headers = http_import.encode_rows_reference(
+                rows, compression=float(self.config.tpu_compression))
         else:
             body, headers = http_import.encode_rows(rows)
         url = self.config.forward_address.rstrip("/") + "/import"
@@ -1095,7 +1147,8 @@ class Server:
         import grpc as _grpc
         if self._grpc_client is None:
             self._grpc_client = ForwardClient(
-                self.config.forward_address)
+                self.config.forward_address,
+                compression=float(self.config.tpu_compression))
         try:
             self._grpc_client.send(rows)
         except _grpc.RpcError as e:
@@ -1174,3 +1227,13 @@ class Server:
         if self._grpc_client is not None:
             self._grpc_client.close()
         self._pool.shutdown(wait=False)
+        # close releases the flock; the lock FILE stays (unlinking it
+        # would race two starting instances onto different inodes of
+        # the same path, each holding "the" lock — the reference's
+        # acquireLockForSocket likewise leaves the file behind)
+        for _lockname, fd in self._socket_locks:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        self._socket_locks.clear()

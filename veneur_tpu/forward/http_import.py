@@ -79,7 +79,8 @@ def _finish_body(items: list[dict], deflate: bool) -> tuple[
 
 
 def encode_rows_reference(rows: list[ForwardRow],
-                          deflate: bool = True) -> tuple[
+                          deflate: bool = True,
+                          compression: float = 100.0) -> tuple[
         bytes, dict[str, str]]:
     """ForwardRows -> the REFERENCE's JSONMetric wire format
     (samplers/samplers.go:95, Export methods :162/:278/:455/:678):
@@ -104,7 +105,7 @@ def encode_rows_reference(rows: list[ForwardRow],
             from veneur_tpu.ops import segment
             st = np.asarray(r.stats, np.float32)
             val = gob_codec.encode_digest(
-                r.means, r.weights, 100.0,
+                r.means, r.weights, compression,
                 float(st[segment.STAT_MIN]),
                 float(st[segment.STAT_MAX]),
                 float(st[segment.STAT_RSUM]))
@@ -139,20 +140,35 @@ def _apply_reference_item(table: MetricTable, it: dict) -> bool:
     tags = tuple(tags)
     val = base64.b64decode(it["value"])
     if mtype == "counter":
-        return table.import_counter(name, tags,
-                                    gob_codec.decode_counter(val))
+        v = gob_codec.decode_counter(val)
+        if not np.isfinite(v):
+            raise ValueError("non-finite counter value in gob import")
+        return table.import_counter(name, tags, v)
     if mtype == "gauge":
-        return table.import_gauge(name, tags,
-                                  gob_codec.decode_gauge(val))
+        v = gob_codec.decode_gauge(val)
+        if not np.isfinite(v):
+            raise ValueError("non-finite gauge value in gob import")
+        return table.import_gauge(name, tags, v)
     if mtype in ("histogram", "timer"):
         d = gob_codec.decode_digest(val)
+        # the DSD parse path rejects non-finite values because one
+        # poisons a whole row's aggregates; gob-decoded state gets the
+        # same gate (decode_digest fails open to ±inf min/max when the
+        # sub-messages are absent, which is fine only for empty digests)
+        if not (np.isfinite(d["means"]).all()
+                and np.isfinite(d["weights"]).all()
+                and (d["weights"] >= 0).all()):
+            raise ValueError("non-finite centroids in gob import")
         w = float(d["weights"].sum())
+        if w and not (np.isfinite(d["min"]) and np.isfinite(d["max"])
+                      and np.isfinite(d["rsum"])):
+            raise ValueError("non-finite digest stats in gob import")
         stats = np.asarray(
             [w,
              d["min"] if w else segment.STAT_MIN_EMPTY,
              d["max"] if w else segment.STAT_MAX_EMPTY,
              float((d["means"] * d["weights"]).sum()),
-             d["rsum"]], np.float32)
+             d["rsum"] if w else 0.0], np.float32)
         return table.import_histo(
             name, dsd.TIMER if mtype == "timer" else dsd.HISTOGRAM,
             tags, stats, d["means"], d["weights"])
