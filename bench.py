@@ -51,6 +51,17 @@ _T_START = time.monotonic()
 def _over_budget() -> bool:
     return _BUDGET > 0 and time.monotonic() - _T_START > _BUDGET
 
+# VENEUR_BENCH_PLATFORM pins the backend (e.g. "cpu") for orchestration
+# smoke tests and dead-link operation.  The dev image's sitecustomize
+# force-registers the accelerator platform with jax.config.update at
+# interpreter start, so the pin must use jax.config.update too — the
+# env var alone is overridden.  Also exported to probe subprocesses.
+_PLATFORM_PIN = os.environ.get("VENEUR_BENCH_PLATFORM", "")
+if _PLATFORM_PIN:
+    import jax
+    jax.config.update("jax_platforms", _PLATFORM_PIN)
+    os.environ["VENEUR_PROBE_PLATFORM"] = _PLATFORM_PIN
+
 # persistent compile cache: repeat bench runs skip recompiling
 # unchanged kernels.  CACHE_WARM is surfaced in the JSON because warm
 # runs' cold_interval_seconds measure cache loads, not compiles.
@@ -355,9 +366,8 @@ def bench_global_merge() -> dict:
     quantile/estimate readout; reported as items/sec where an item is
     one forwarded digest or sketch."""
     from veneur_tpu.core.table import MetricTable, TableConfig
-    from veneur_tpu.forward.grpc_forward import (apply_metric_list,
-                                                 rows_to_metric_list)
-    from veneur_tpu.forward.gen import forward_pb2
+    from veneur_tpu.forward.grpc_forward import (
+        apply_metric_list_bytes, rows_to_metric_list)
     from veneur_tpu.ops import hll as hll_ops, tdigest
     from veneur_tpu.protocol import dogstatsd as dsd
     import jax
@@ -413,8 +423,7 @@ def bench_global_merge() -> dict:
     def one_interval():
         total = 0
         for wire in wire_lists:
-            ml = forward_pb2.MetricList.FromString(wire)
-            acc, _ = apply_metric_list(dst, ml)
+            acc, _ = apply_metric_list_bytes(dst, wire)
             total += acc
             dst.device_step()
         return total
@@ -456,52 +465,88 @@ def bench_global_merge() -> dict:
     return res_d
 
 
-def _device_probe() -> str | None:
-    """Probe the device in a killable SUBPROCESS (see
-    utils/devprobe: a hung tunnel blocks backend init inside the
-    client and can even survive a kill+wait through inherited pipes).
-    Returns None when healthy, else an error string."""
-    from veneur_tpu.utils import devprobe
-    timeout_s = 240.0
-    if _BUDGET > 0:
-        timeout_s = min(timeout_s, _BUDGET)
-    return devprobe.probe_device(timeout_s)
+CONFIGS = (
+    ("0_counters_1k_names", bench_counters),
+    ("1_cardinality_100k", bench_cardinality),
+    ("2_timers_10k_series", bench_timers),
+    ("3_sets_1m_uniques", bench_sets),
+    ("4_global_merge_64_locals", bench_global_merge),
+)
+
+CKPT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_results", "checkpoints")
 
 
-def main() -> None:
-    t_start = time.time()
-    err = _device_probe()
-    if err is not None:
-        # still ONE parseable JSON line, explicitly marked — a judge
-        # (or operator) sees the failure mode, not a silent hang
-        print(json.dumps({
-            "metric": "aggregation_samples_per_sec_chip",
-            "value": None, "unit": "samples/sec", "vs_baseline": None,
-            "error": err,
-            "wall_seconds": round(time.time() - t_start, 1)}))
-        return
-    configs = {}
-    for key, fn in (
-            ("0_counters_1k_names", bench_counters),
-            ("1_cardinality_100k", bench_cardinality),
-            ("2_timers_10k_series", bench_timers),
-            ("3_sets_1m_uniques", bench_sets),
-            ("4_global_merge_64_locals", bench_global_merge)):
-        if _over_budget() and configs:
-            # degraded-link guard (see _BUDGET): better a JSON line
-            # with skipped configs than a run that never prints one
-            configs[key] = {"skipped": True,
-                            "reason": "wall-clock budget exhausted"}
-            continue
-        configs[key] = fn()
+def _ckpt_path(key: str) -> str:
+    return os.path.join(CKPT_DIR, f"{key}{'.quick' if QUICK else ''}"
+                        ".json")
 
-    headline = configs["0_counters_1k_names"]["samples_per_sec"]
+
+def _run_one_config(key: str) -> None:
+    """Child mode (``--config <key>``): run ONE config and write its
+    result dict to the checkpoint file.  Isolating each config in its
+    own process means a device-link death mid-config costs only that
+    config — the orchestrator kills the child and still assembles a
+    final line from the others' checkpoints."""
+    fn = dict(CONFIGS)[key]
+    res = fn()
+    res["captured_unix"] = round(time.time(), 1)
+    os.makedirs(CKPT_DIR, exist_ok=True)
+    tmp = _ckpt_path(key) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(res, f)
+    os.replace(tmp, _ckpt_path(key))
+    print(json.dumps({key: res}))
+
+
+def _spawn_config(key: str, timeout_s: float) -> dict:
+    """Run one config in a killable subprocess; returns its result
+    dict, or an error marker if it died or hung."""
+    import subprocess
+    env = dict(os.environ)
+    # the child's internal degraded-link guards trip before the kill;
+    # budget 0 means the operator disabled the guards — honor it
+    env["VENEUR_BENCH_BUDGET"] = (
+        "0" if _BUDGET <= 0 else str(max(timeout_s - 30.0, 60.0)))
+    cmd = [sys.executable, os.path.abspath(__file__), "--config", key]
+    if QUICK:
+        cmd.append("--quick")
+    try:
+        os.makedirs(CKPT_DIR, exist_ok=True)
+        with open(os.path.join(CKPT_DIR, f"{key}.log"), "wb") as logf:
+            p = subprocess.Popen(cmd, stdout=logf, stderr=logf,
+                                 env=env)
+            try:
+                rc = p.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass  # uninterruptible child: abandon it
+                return {"error": f"config timed out after "
+                                 f"{timeout_s:.0f}s (device link hung)"}
+        if rc != 0:
+            return {"error": f"config subprocess exited rc={rc}"}
+    except OSError as e:
+        return {"error": f"could not spawn config subprocess: {e}"}
+    try:
+        with open(_ckpt_path(key)) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        return {"error": f"checkpoint unreadable after run: {e}"}
+
+
+def _assemble(configs: dict, t_start: float) -> dict:
+    c0 = configs.get("0_counters_1k_names") or {}
+    headline = c0.get("samples_per_sec")
     target = 10_000_000.0
     out = {
         "metric": "aggregation_samples_per_sec_chip",
-        "value": round(headline, 1),
+        "value": round(headline, 1) if headline else None,
         "unit": "samples/sec",
-        "vs_baseline": round(headline / target, 4),
+        "vs_baseline": (round(headline / target, 4)
+                        if headline else None),
         "quick": QUICK,
         "compile_cache_warm": CACHE_WARM,
         "wall_seconds": round(time.time() - t_start, 1),
@@ -510,8 +555,76 @@ def main() -> None:
                         for kk, vv in v.items()}
                     for k, v in configs.items()},
     }
+    return out
+
+
+def main() -> None:
+    """Orchestrator: probe in short retries across the budget, start
+    configs the moment a probe succeeds, run each in its own killable
+    subprocess, checkpoint per-config JSON to disk, and ALWAYS print
+    one final line assembled from whatever completed.  The tunnel
+    link swings 10-100x and goes hard-down for stretches; the old
+    single 240s probe + in-process run either hung or surrendered."""
+    t_start = time.time()
+    from veneur_tpu.utils import devprobe
+    probe_budget = min(240.0, _BUDGET / 2 if _BUDGET > 0 else 240.0)
+    err = devprobe.probe_device_retry(
+        probe_budget, attempt_s=30.0,
+        on_attempt=lambda i, rem: print(
+            f"# probe attempt {i} ({rem:.0f}s left)", file=sys.stderr))
+    if err is not None:
+        print(json.dumps({
+            "metric": "aggregation_samples_per_sec_chip",
+            "value": None, "unit": "samples/sec", "vs_baseline": None,
+            "error": err,
+            "probe_budget_seconds": round(probe_budget, 1),
+            "wall_seconds": round(time.time() - t_start, 1)}))
+        return
+
+    configs: dict = {}
+    for i, (key, _fn) in enumerate(CONFIGS):
+        if _over_budget() and configs:
+            configs[key] = {"skipped": True,
+                            "reason": "wall-clock budget exhausted"}
+            continue
+        n_left = len(CONFIGS) - i
+        if _BUDGET > 0:
+            remaining = _BUDGET - (time.monotonic() - _T_START)
+            # even share of what's left, floored so a single config
+            # always gets a real shot even late in the budget
+            timeout_s = max(remaining / n_left, 120.0)
+        else:
+            # budget disabled: no wall-clock pressure, only a backstop
+            # against a truly hung device link
+            timeout_s = 86400.0
+        print(f"# config {key} (timeout {timeout_s:.0f}s)",
+              file=sys.stderr)
+        res = _spawn_config(key, timeout_s)
+        configs[key] = res
+        if "error" in res and "hung" in res.get("error", ""):
+            # the link died under this config: one quick re-probe
+            # decides whether the rest get a chance or are skipped
+            if devprobe.probe_device(20.0) is not None:
+                for key2, _ in CONFIGS[i + 1:]:
+                    configs[key2] = {
+                        "skipped": True,
+                        "reason": "device link down mid-run"}
+                break
+
+    out = _assemble(configs, t_start)
+    # preserve the raw artifact (transcriptions are not evidence)
+    try:
+        os.makedirs(os.path.dirname(CKPT_DIR), exist_ok=True)
+        with open(os.path.join(os.path.dirname(CKPT_DIR),
+                               f"run_{int(t_start)}.json"), "w") as f:
+            json.dump(out, f, indent=1)
+    except OSError:
+        pass
     print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    if "--config" in sys.argv:
+        _run_one_config(sys.argv[sys.argv.index("--config") + 1])
+    else:
+        main()

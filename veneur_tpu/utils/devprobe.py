@@ -17,11 +17,19 @@ environment:
 from __future__ import annotations
 
 import os
+import random
 import subprocess
 import sys
 import tempfile
+import time
 
-_PROBE_CODE = ("import jax, numpy, jax.numpy as jnp;"
+# The dev image's sitecustomize force-registers the accelerator
+# platform with jax.config.update at interpreter start, overriding the
+# JAX_PLATFORMS env var — so the override knob must itself use
+# jax.config.update after import.
+_PROBE_CODE = ("import os, jax, numpy, jax.numpy as jnp;"
+               "p = os.environ.get('VENEUR_PROBE_PLATFORM');"
+               "p and jax.config.update('jax_platforms', p);"
                "a = jnp.asarray(numpy.zeros(8, numpy.float32));"
                "a.block_until_ready()")
 
@@ -49,3 +57,34 @@ def probe_device(timeout_s: float) -> str | None:
         lines = tail.splitlines()
         return ("probe failed (rc={}): {}".format(
             rc, lines[-1] if lines else "no stderr"))
+
+
+def probe_device_retry(budget_s: float, attempt_s: float = 30.0,
+                       on_attempt=None) -> str | None:
+    """Retry ``probe_device`` in short attempts until one succeeds or
+    ``budget_s`` of wall-clock is spent.  The tunnel link's service
+    quality swings 10-100x and flaps on minute timescales, so one
+    monolithic long attempt both wastes the healthy windows (a live
+    probe finishes in seconds) and surrenders to a transient stall;
+    many short attempts with jittered gaps have materially better
+    odds.  Returns None on the first success, else the LAST error."""
+    deadline = time.monotonic() + budget_s
+    last_err: str | None = "probe budget is zero"
+    attempt = 0
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        attempt += 1
+        if on_attempt is not None:
+            on_attempt(attempt, remaining)
+        last_err = probe_device(min(attempt_s, max(remaining, 5.0)))
+        if last_err is None:
+            return None
+        # jittered gap so retry cadence doesn't phase-lock with a
+        # periodic link stall; never sleep past the deadline
+        gap = min(random.uniform(1.0, 4.0),
+                  max(deadline - time.monotonic(), 0.0))
+        if gap > 0:
+            time.sleep(gap)
+    return last_err
