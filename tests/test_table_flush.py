@@ -424,7 +424,9 @@ def test_hot_row_flood_preclusters_on_host():
     t._digest_merge = counting
     t._histo_stage.append(rows, vals, np.ones(n, np.float32))
     t.device_step(final=True)
-    assert calls["n"] <= 4  # pre-cluster, not n/slots=937 dispatches
+    # pre-cluster bounds dispatches by capacity/slots, not n/slots=937
+    bound = -(-t.capacity // 128) + 1
+    assert calls["n"] <= bound, calls["n"]
     stats = np.asarray(t.histo_stats)
     assert stats[0, segment.STAT_WEIGHT] == pytest.approx(n)
     assert stats[0, segment.STAT_MIN] == np.float32(vals.min())
@@ -535,3 +537,94 @@ def test_full_pipeline_without_native_library(monkeypatch):
     assert m["lat.max"].value == 199.0
     assert m["lat.50percentile"].value == pytest.approx(99.5, rel=0.02)
     assert m["users"].value == pytest.approx(300, rel=0.05)
+
+
+def test_percentile_naming_modes():
+    """percentile_naming=reference keeps the Go fleet's int(p*100)
+    truncation (samplers.go:664: 0.999 -> .99percentile); the default
+    precise mode emits .999percentile and avoids the collision."""
+    def flush_names(naming):
+        t = small_table()
+        for v in range(500):
+            t.ingest(dsd.parse_metric(f"lat:{v}|ms".encode()))
+        res = Flusher(is_local=False, percentiles=(0.5, 0.999),
+                      aggregates=(),
+                      percentile_naming=naming).flush(t.swap())
+        return {m.name for m in res.metrics}
+
+    precise = flush_names("precise")
+    assert "lat.50percentile" in precise
+    assert "lat.999percentile" in precise
+    ref = flush_names("reference")
+    assert "lat.50percentile" in ref
+    assert "lat.99percentile" in ref
+    assert "lat.999percentile" not in ref
+
+
+def test_host_precluster_keeps_tail_budget():
+    """The host pre-cluster must use the SAME tail-refined scale as
+    the device merge (ops/tdigest.k_scale_np): a heavy-tailed flood
+    through the pre-cluster path keeps the p99 budget (<=1%), which
+    the k1 body scale alone cannot on pareto data."""
+    from veneur_tpu.ops import tdigest
+
+    rng = np.random.default_rng(23)
+    n = 150_000
+    t = MetricTable(TableConfig(histo_rows=1 << 14, histo_slots=128))
+    vals = (rng.pareto(3.0, n) * 100 + 1.0).astype(np.float32)
+    t._histo_stage.append(np.zeros(n, np.int32), vals,
+                          np.ones(n, np.float32))
+    t.device_step(final=True)
+    q = np.asarray(tdigest.quantile(
+        t.histo_means, t.histo_weights,
+        np.asarray([0.99, 0.999], np.float32),
+        t.histo_stats[:, 1], t.histo_stats[:, 2]))
+    for qi, p in enumerate((0.99, 0.999)):
+        exact = float(np.quantile(vals, p))
+        err = abs(q[0, qi] - exact) / exact
+        assert err < 0.01, (p, q[0, qi], exact, err)
+
+
+def test_quantile_interpolation_mode_reference():
+    """quantile_interpolation=reference routes the flush readout
+    through the Go uniform-bounds scheme (values differ from the
+    default interp mode on a sparse digest)."""
+    def flush_p50(mode):
+        t = small_table()
+        for v in (10.0, 20.0):
+            t.ingest(dsd.parse_metric(f"lat:{v}|ms".encode()))
+        res = Flusher(is_local=False, percentiles=(0.5,),
+                      aggregates=(),
+                      quantile_interpolation=mode).flush(t.swap())
+        return by_name(res.metrics)["lat.50percentile"].value
+
+    # Go walk: q*total=1.0 lands at the first centroid's upper bound:
+    # full proportion of [min=10, mid=15] -> 15.0; interp reproduces
+    # np.quantile([10,20], .5) = 15.0 too, so use q where they differ
+    def flush_p25(mode):
+        t = small_table()
+        for v in (10.0, 20.0):
+            t.ingest(dsd.parse_metric(f"lat:{v}|ms".encode()))
+        res = Flusher(is_local=False, percentiles=(0.25,),
+                      aggregates=(),
+                      quantile_interpolation=mode).flush(t.swap())
+        return by_name(res.metrics)["lat.25percentile"].value
+
+    # reference: q*total=0.5 -> half proportion of [10, 15] = 12.5
+    assert flush_p25("reference") == pytest.approx(12.5)
+    # interp: np.quantile([10, 20], 0.25) = 12.5 too... use 3 points
+    def flush3(mode, q):
+        t = small_table()
+        for v in (10.0, 20.0, 40.0):
+            t.ingest(dsd.parse_metric(f"lat:{v}|ms".encode()))
+        res = Flusher(is_local=False, percentiles=(q,),
+                      aggregates=(),
+                      quantile_interpolation=mode).flush(t.swap())
+        return [m for m in res.metrics
+                if m.name.endswith("percentile")][0].value
+
+    exact = float(np.quantile([10.0, 20.0, 40.0], 0.75))
+    assert flush3("interp", 0.75) == pytest.approx(exact)
+    # Go walk: q*total=2.25 -> inside 3rd centroid; lb=mid(20,40)=30,
+    # ub=max=40, proportion (2.25-2)/1=0.25 -> 32.5
+    assert flush3("reference", 0.75) == pytest.approx(32.5)

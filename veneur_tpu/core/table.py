@@ -311,8 +311,9 @@ class MetricTable:
         # LocalMax/LocalWeight gates), so the flusher must be able to
         # tell the two apart or downstream count-sums double.
         self._digest_stage = _Staging()
-        self._stats_import_rows: list[int] = []
-        self._stats_import_vals: list[np.ndarray] = []
+        # (rows i32[N], stats f32[N,5]) parts — single imports append
+        # 1-row parts, the batched gRPC decode appends whole batches
+        self._stats_import_parts: list[tuple[np.ndarray, np.ndarray]] = []
         self._set_import_rows: list[int] = []
         self._set_import_regs: list[np.ndarray] = []
 
@@ -681,8 +682,8 @@ class MetricTable:
                                     self.gen)
         if row is None:
             return False
-        self._stats_import_rows.append(row)
-        self._stats_import_vals.append(stats)
+        self._stats_import_parts.append(
+            (np.asarray([row], np.int32), stats[None, :]))
         self._staged_n += 1
         live = weights > 0
         if live.any():
@@ -694,6 +695,38 @@ class MetricTable:
             # staging-memory bound rides on this counter
             self._staged_n += n_live
         return True
+
+    def import_histo_row(self, name: str, mtype: str,
+                         tags: tuple[str, ...],
+                         scope: str = dsd.SCOPE_DEFAULT) -> int | None:
+        """Row allocation only (the lookup half of import_histo), for
+        the batched gRPC decode path."""
+        key = (name, mtype, tags, scope)
+        return self.histo_idx.lookup(key, name, tags, scope, mtype,
+                                     self.gen)
+
+    def import_histo_batch(self, rows: np.ndarray, stats: np.ndarray,
+                           cent_rows: np.ndarray,
+                           cent_means: np.ndarray,
+                           cent_weights: np.ndarray) -> None:
+        """Batched import_histo: one staging append for a whole
+        decoded MetricList (the columnar half of the native
+        vtpu_metriclist_decode path).  ``rows``/``stats`` are
+        row-aligned (N,)/(N,5); centroid arrays are pre-filtered to
+        live (weight>0, finite) entries with per-centroid target rows.
+        Caller guarantees validity — malformed items must be dropped
+        BEFORE staging (see import_histo's shape note)."""
+        if len(rows):
+            self._stats_import_parts.append(
+                (np.ascontiguousarray(rows, np.int32),
+                 np.ascontiguousarray(stats, np.float32)))
+            self._staged_n += len(rows)
+        if len(cent_rows):
+            self._digest_stage.append(
+                np.ascontiguousarray(cent_rows, np.int32),
+                np.ascontiguousarray(cent_means, np.float32),
+                np.ascontiguousarray(cent_weights, np.float32))
+            self._staged_n += len(cent_rows)
 
     def import_set(self, name: str, tags: tuple[str, ...],
                    regs: np.ndarray,
@@ -793,10 +826,12 @@ class MetricTable:
                     jnp.asarray(_pad_np(srows, b, c.set_rows)),
                     jnp.asarray(_pad_np(spos, b, 0)))
 
-        if self._stats_import_rows:
-            rows = np.asarray(self._stats_import_rows, np.int32)
-            vals = np.stack(self._stats_import_vals)
-            self._stats_import_rows, self._stats_import_vals = [], []
+        if self._stats_import_parts:
+            rows = np.concatenate(
+                [p[0] for p in self._stats_import_parts])
+            vals = np.concatenate(
+                [p[1] for p in self._stats_import_parts])
+            self._stats_import_parts = []
             # padding row ids are out of bounds -> dropped by the
             # scatter, so padding row contents never participate
             b = _bucket_len(len(rows), wide=True)
@@ -932,7 +967,6 @@ class MetricTable:
         a centroid IS a weighted sample — so accuracy matches feeding
         the raw batch through the same scale."""
         c = self.config
-        delta = tdigest._SCALE_MULT * c.compression
         cap = self.capacity
         rows = np.ascontiguousarray(rows, np.int64)
         order = np.lexsort((vals, rows))
@@ -945,9 +979,8 @@ class MetricTable:
         base = np.maximum.accumulate(np.where(first, cw - w, 0.0))
         totals = np.bincount(r, weights=w)[r]
         q_left = (cw - w - base) / np.maximum(totals, 1e-30)
-        k0 = delta / (2.0 * np.pi) * np.arcsin(-1.0)
-        k = (delta / (2.0 * np.pi) *
-             np.arcsin(np.clip(2.0 * q_left - 1.0, -1.0, 1.0)) - k0)
+        k = (tdigest.k_scale_np(q_left, c.compression) -
+             tdigest.k_scale_np(0.0, c.compression))
         cl = np.clip(np.floor(k).astype(np.int64), 0, cap - 1)
         key = r * cap + cl
         uniq, inv = np.unique(key, return_inverse=True)

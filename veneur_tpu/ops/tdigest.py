@@ -24,10 +24,12 @@ parallel-friendly construction from the t-digest paper (arXiv:1902.04023
 "Computing Extremely Accurate Quantiles Using t-Digests", Alg. 2 family)
 and yields the same size bound (<= delta/2 + 1 clusters for k1).  To
 absorb the slightly looser clustering and repeated re-merging, the
-internal scale uses a multiple of the configured compression; with the default
-compression=100 (reference samplers/samplers.go:502) the plane capacity
-``C=312`` holds the <= ~300 clusters of the internal scale and keeps the
-slot axis lane-aligned.
+internal scale uses a multiple of the configured compression, plus a
+clamped log-term that refines the upper tail to constant RELATIVE
+cluster width (see _TAIL_MULT); with the default compression=100
+(reference samplers/samplers.go:502) the plane capacity ``C=616``
+holds the body's ~300 clusters plus the tail refinement's ~305 and
+keeps the slot axis lane-aligned.
 
 Digest-vs-digest merge (the global tier's Histo.Merge,
 samplers/samplers.go:726) is the same kernel with the other digest's
@@ -48,8 +50,9 @@ from veneur_tpu.utils import jitopts
 Array = jax.Array
 
 DEFAULT_COMPRESSION = 100.0
-# Plane capacity for the default compression (see module docstring).
-DEFAULT_CAPACITY = 312
+# Plane capacity for the default compression (see module docstring);
+# asin body (300) + clamped tail refinement (305) + slack.
+DEFAULT_CAPACITY = 616
 
 _EPS = 1e-30
 
@@ -62,11 +65,33 @@ _EPS = 1e-30
 # (p999 on heavy-tailed distributions needs the finer clusters).
 _SCALE_MULT = 6.0
 
+# Upper-tail refinement: the k1 (asin) scale's cluster width at the
+# tail is ~(2*pi/delta)*sqrt(1-q), so the RELATIVE q-width
+# dq/(1-q) ~ 1/sqrt(1-q) -> at q=0.99 a cluster spans ~3.5% of the
+# remaining tail regardless of sample count, which on heavy-tailed
+# data (pareto) is a ~3-4% value-space span — the whole p99 error
+# budget.  A clamped log-term (the k2 scale family of the t-digest
+# paper, arXiv:1902.04023 §3) adds clusters with CONSTANT relative
+# width dq/(1-q) = 1/(_TAIL_MULT*compression) for 1-q in
+# [_TAIL_QMIN, _TAIL_Q0]: at the defaults every tail cluster spans
+# 2.5% of the remaining tail down to p9999, i.e. <=0.9% of value for
+# pareto(alpha>=3) and far less for lighter tails.  Timers care about
+# the UPPER tail only (p50/p90/p99/p999), so the refinement is
+# one-sided; the lower tail keeps the k1 resolution and the true-min
+# anchor.
+_TAIL_MULT = 0.4
+_TAIL_Q0 = 0.2     # refinement active where (1-q) < _TAIL_Q0 (p80 up,
+#                    so p90 sits fully inside the refined region)
+_TAIL_QMIN = 1e-4  # clamp: no extra resolution beyond p9999
+
 
 def capacity_for(compression: float) -> int:
-    """Slot capacity: cluster count of the internal scale (+ slack),
-    rounded up to a multiple of 8 for lane alignment."""
-    clusters = int(math.ceil(_SCALE_MULT * compression / 2.0)) + 8
+    """Slot capacity: cluster count of the internal scale — the asin
+    body plus the clamped upper-tail log-term (+ slack), rounded up to
+    a multiple of 8 for lane alignment."""
+    clusters = (int(math.ceil(_SCALE_MULT * compression / 2.0)) +
+                int(math.ceil(_TAIL_MULT * compression *
+                              math.log(_TAIL_Q0 / _TAIL_QMIN))) + 8)
     return ((clusters + 7) // 8) * 8
 
 
@@ -77,9 +102,28 @@ def empty_state(num_rows: int,
     return means, weights
 
 
-def _k_scale(q: Array, delta: float) -> Array:
-    return (delta / (2.0 * jnp.pi)) * jnp.arcsin(
+def _k_scale(q: Array, delta: float, compression: float) -> Array:
+    """Monotone cluster scale: asin body + clamped upper-tail log
+    refinement (see _TAIL_MULT).  floor(k) is the cluster id."""
+    body = (delta / (2.0 * jnp.pi)) * jnp.arcsin(
         jnp.clip(2.0 * q - 1.0, -1.0, 1.0))
+    tail = (_TAIL_MULT * compression) * jnp.log(
+        _TAIL_Q0 / jnp.clip(1.0 - q, _TAIL_QMIN, None))
+    return body + jnp.maximum(tail, 0.0)
+
+
+def k_scale_np(q: "np.ndarray | float", compression: float):
+    """Numpy mirror of _k_scale (same constants, f64) for host-side
+    pre-clustering (core/table._host_precluster) — host and device
+    MUST cluster on the same scale or host-pre-clustered batches lose
+    the tail refinement."""
+    import numpy as np
+    delta = _SCALE_MULT * compression
+    body = (delta / (2.0 * np.pi)) * np.arcsin(
+        np.clip(2.0 * q - 1.0, -1.0, 1.0))
+    tail = (_TAIL_MULT * compression) * np.log(
+        _TAIL_Q0 / np.clip(1.0 - q, _TAIL_QMIN, None))
+    return body + np.maximum(tail, 0.0)
 
 
 def _merge_impl(means: Array, weights: Array, new_means: Array,
@@ -109,7 +153,8 @@ def _merge_impl(means: Array, weights: Array, new_means: Array,
     total = jnp.sum(w, axis=1, keepdims=True)
     cum = jnp.cumsum(w, axis=1)
     q_left = (cum - w) / jnp.maximum(total, _EPS)
-    k = _k_scale(q_left, delta) - _k_scale(jnp.float32(0.0), delta)
+    k = (_k_scale(q_left, delta, compression) -
+         _k_scale(jnp.float32(0.0), delta, compression))
     cluster = jnp.clip(jnp.floor(k).astype(jnp.int32), 0, cap - 1)
 
     rows = jnp.arange(num_rows, dtype=jnp.int32)[:, None]
@@ -364,18 +409,27 @@ def add_samples_unit(means: Array, weights: Array, row_ids: Array,
 
 def quantile(means: Array, weights: Array, qs: Array,
              mins: Array | None = None,
-             maxs: Array | None = None) -> Array:
+             maxs: Array | None = None,
+             method: str = "interp") -> Array:
     """Estimate quantiles for every row -> f32[R, Q].
 
-    Implements the reference's interpolation scheme EXACTLY
+    ``method="interp"`` (default, used by the flush readout):
+    rank-space linear interpolation between centroid means with the
+    R-7 convention (numpy's default) — the mass of centroid i sits at
+    0-based rank position ``cum_before_i + (w_i-1)/2`` and the target
+    rank is ``q*(total-1)``.  On runs of singleton centroids (which is
+    what the refined tail scale produces near p99, see _TAIL_MULT)
+    this reproduces ``np.quantile(..)`` EXACTLY — the uniform-bounds
+    scheme below is off by up to half an order-statistic gap there,
+    which on heavy-tailed data is the entire 1%-max p99 budget.
+
+    ``method="reference"`` implements the reference's scheme EXACTLY
     (tdigest/merging_digest.go:302 ``Quantile`` + :360
     ``centroidUpperBound``): each centroid is a uniform distribution
     over value-space bounds given by the midpoints to its neighbors'
     means, with the first lower bound = true min and the last upper
-    bound = true max.  The target weight q*total lands inside one
-    centroid; the estimate interpolates proportionally inside its
-    bounds.  Matching the scheme (not just the sketch) is what keeps
-    the "vs the Go t-digest" error at zero for identical centroids.
+    bound = true max.  Matching the scheme (not just the sketch) keeps
+    the "vs the Go t-digest" delta at zero for identical centroids.
 
     ``mins``/``maxs`` (f32[R]) are the per-row true extremes the Histo
     sampler tracks anyway (samplers/samplers.go:484); without them the
@@ -385,7 +439,9 @@ def quantile(means: Array, weights: Array, qs: Array,
         mins = jnp.full((means.shape[0],), jnp.nan, jnp.float32)
     if maxs is None:
         maxs = jnp.full((means.shape[0],), jnp.nan, jnp.float32)
-    return _quantile(means, weights, qs, mins, maxs)
+    if method == "reference":
+        return _quantile(means, weights, qs, mins, maxs)
+    return _quantile_interp(means, weights, qs, mins, maxs)
 
 
 def _bounds(m: Array, w: Array, mins: Array, maxs: Array):
@@ -432,6 +488,52 @@ def _quantile(means: Array, weights: Array, qs: Array, mins: Array,
     ub_i = jnp.take_along_axis(ub, idx, axis=1)
     prop = jnp.clip((t - cum_before) / jnp.maximum(w_i, _EPS), 0.0, 1.0)
     est = lb_i + prop * (ub_i - lb_i)
+    return jnp.where((nvalid[:, None] > 0) & (total > 0), est, jnp.nan)
+
+
+@jax.jit
+def _quantile_interp(means: Array, weights: Array, qs: Array,
+                     mins: Array, maxs: Array) -> Array:
+    """Rank-space centroid-mean interpolation (see quantile(),
+    method="interp").  Knots: (-0.5, min), (pos_i, mean_i)...,
+    (total-0.5, max) with pos_i = cum_i - (w_i+1)/2; target rank
+    h = q*(total-1)."""
+    key = jnp.where(weights > 0, means, jnp.inf)
+    _, m, w = jax.lax.sort((key, means, weights), dimension=-1,
+                           num_keys=1)
+    cum = jnp.cumsum(w, axis=1)
+    total = cum[:, -1:]
+    nvalid = jnp.sum(w > 0, axis=1)
+    last = jnp.maximum(nvalid - 1, 0)[:, None]
+    pos = cum - (w + 1.0) * 0.5  # mass centre, 0-based rank space
+    first_m = m[:, :1]
+    last_m = jnp.take_along_axis(m, last, axis=1)
+    lo_anchor = jnp.where(jnp.isnan(mins)[:, None], first_m,
+                          mins[:, None])
+    hi_anchor = jnp.where(jnp.isnan(maxs)[:, None], last_m,
+                          maxs[:, None])
+
+    h = qs[None, :] * jnp.maximum(total - 1.0, 0.0)  # [R, Q]
+    # number of valid knots with pos < h  ->  knots idx-1, idx bracket h
+    pos_masked = jnp.where(w > 0, pos, jnp.inf)
+    idx = jnp.sum(pos_masked[:, None, :] < h[:, :, None], axis=-1)
+    below = idx == 0           # h before the first knot
+    above = idx > last         # h past the last knot
+    idx_hi = jnp.clip(idx, 0, last)
+    idx_lo = jnp.clip(idx - 1, 0, last)
+
+    def take(a, i):
+        return jnp.take_along_axis(a, i, axis=1)
+
+    p_lo = jnp.where(below, -0.5, take(pos, idx_lo))
+    v_lo = jnp.where(below, lo_anchor, take(m, idx_lo))
+    p_hi = jnp.where(above, total - 0.5, take(pos, idx_hi))
+    v_hi = jnp.where(above, hi_anchor, take(m, idx_hi))
+    frac = jnp.clip((h - p_lo) / jnp.maximum(p_hi - p_lo, _EPS),
+                    0.0, 1.0)
+    est = v_lo + frac * (v_hi - v_lo)
+    # exact anchors outside the knot range
+    est = jnp.clip(est, lo_anchor, hi_anchor)
     return jnp.where((nvalid[:, None] > 0) & (total > 0), est, jnp.nan)
 
 
