@@ -354,3 +354,72 @@ def test_wire_fixture_regression():
     assert m["fix.lat.50percentile"].value == pytest.approx(
         52.87, rel=0.05)  # frozen digest's p50 for seed 42
     assert m["fix.users"].value == pytest.approx(250, rel=0.05)
+
+
+def test_native_decode_matches_protobuf_path():
+    """The columnar native decode (vtpu_metriclist_decode +
+    apply_metric_list_bytes) must produce bit-identical table state to
+    the protobuf object path for a full fleet wire: counters, gauges,
+    tagged digests, sets."""
+    from veneur_tpu.core.flusher import Flusher
+    from veneur_tpu.forward.grpc_forward import (apply_metric_list,
+                                                 apply_metric_list_bytes)
+
+    rng = np.random.default_rng(21)
+    src = MetricTable(TableConfig(histo_rows=64, set_rows=16,
+                                  histo_slots=512,
+                                  histo_merge_samples=1 << 30))
+    for i in range(32):
+        src.ingest(dsd.Sample(name=f"lat.{i}", type=dsd.TIMER,
+                              value=1.0,
+                              tags=(f"host:h{i % 7}", "dc:x")))
+    rows = np.repeat(np.arange(32, dtype=np.int32), 64)
+    vals = rng.gamma(2.0, 30.0, len(rows)).astype(np.float32)
+    src._histo_stage.append(rows, vals, np.ones(len(rows), np.float32))
+    for i in range(300):
+        src.ingest(dsd.Sample(name=f"uniq.{i % 16}", type=dsd.SET,
+                              value=f"m{i}".encode()))
+    src.ingest(dsd.Sample(name="cnt", type=dsd.COUNTER, value=42.0,
+                          scope=dsd.SCOPE_GLOBAL))
+    src.ingest(dsd.Sample(name="gau", type=dsd.GAUGE, value=-2.5,
+                          scope=dsd.SCOPE_GLOBAL))
+    res = Flusher(is_local=True).flush(src.swap())
+    wire = rows_to_metric_list(res.forward).SerializeToString()
+
+    def build(apply_fn, arg):
+        dst = MetricTable(TableConfig(histo_rows=128, set_rows=32,
+                                      histo_slots=512,
+                                      histo_merge_samples=1 << 30))
+        acc, dropped = apply_fn(dst, arg)
+        return acc, dropped, dst.swap()
+
+    acc1, d1, s1 = build(apply_metric_list,
+                         forward_pb2.MetricList.FromString(wire))
+    acc2, d2, s2 = build(apply_metric_list_bytes, wire)
+    assert (acc1, d1) == (acc2, d2)
+    np.testing.assert_allclose(np.asarray(s1.histo_import_stats),
+                               np.asarray(s2.histo_import_stats),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(s1.histo_means),
+                               np.asarray(s2.histo_means), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(s1.histo_weights),
+                               np.asarray(s2.histo_weights), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(s1.counters),
+                               np.asarray(s2.counters))
+    np.testing.assert_allclose(np.asarray(s1.gauges),
+                               np.asarray(s2.gauges))
+    np.testing.assert_array_equal(s1.set_registers(),
+                                  s2.set_registers())
+
+
+def test_bytes_path_malformed_wire_falls_back():
+    """Garbage bytes must not crash the bytes path: the native walker
+    rejects them and the protobuf fallback's error surfaces as a
+    decode error, not a wedged table."""
+    from veneur_tpu.forward.grpc_forward import apply_metric_list_bytes
+
+    dst = MetricTable(TableConfig(histo_rows=16, set_rows=8))
+    with pytest.raises(Exception):
+        apply_metric_list_bytes(dst, b"\xff\xff\xff\x01garbage")
+    # table still usable
+    assert dst.import_counter("c", (), 1.0)

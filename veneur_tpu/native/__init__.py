@@ -110,5 +110,17 @@ def load() -> ctypes.CDLL | None:
             i32p, f32p, f32p, u8p,
             i32p, i32p, u8p,
             i64p, i64p]
+        lib.vtpu_metriclist_decode.restype = i64
+        lib.vtpu_metriclist_decode.argtypes = [
+            u8p, i64, i64, i64, i64,
+            i64p, i32p,
+            u8p, i32p, i32p, f64p,
+            f64p,
+            i64p, i32p,
+            f32p, f32p,
+            i64p, i32p,
+            i64p, i32p,
+            i64p, i32p,
+            i64p]
         _lib = lib
         return _lib
