@@ -1,0 +1,407 @@
+"""Reference config-surface compatibility: every key in the
+reference's example.yaml (config.go:3-132, ~116 keys) parses
+strictly, aliases resolve, and the behavioral knobs do what the
+reference's do."""
+
+import os
+
+import numpy as np
+import pytest
+
+from veneur_tpu.core.config import read_config
+
+REF_YAML = "/root/reference/example.yaml"
+
+
+@pytest.mark.skipif(not os.path.exists(REF_YAML),
+                    reason="reference tree not mounted")
+def test_reference_example_yaml_parses_strictly():
+    """The canonical reference config (the file config.go is generated
+    from) must parse with strict=True: zero unknown keys."""
+    cfg = read_config(path=REF_YAML, strict=True, env={})
+    assert cfg.interval  # parsed something real
+    # deprecated grpc_address alias folded into the listener list
+    assert cfg.grpc_listen_addresses == ["tcp://localhost:8181"]
+
+
+def test_deprecated_aliases_resolve():
+    cfg = read_config(data={
+        "flush_max_per_body": 123,
+        "ssf_buffer_size": 77,
+        "trace_lightstep_access_token": "tok",
+        "trace_lightstep_num_clients": 3,
+    })
+    assert cfg.datadog_flush_max_per_body == 123
+    assert cfg.datadog_span_buffer_size == 77
+    assert cfg.lightstep_access_token == "tok"
+    assert cfg.lightstep_num_clients == 3
+    # explicit replacement wins over the alias
+    cfg = read_config(data={"flush_max_per_body": 123,
+                            "datadog_flush_max_per_body": 9})
+    assert cfg.datadog_flush_max_per_body == 9
+
+
+def test_validation_of_new_keys():
+    with pytest.raises(ValueError, match="require_acks"):
+        read_config(data={"kafka_metric_require_acks": "most"})
+    with pytest.raises(ValueError, match="partitioner"):
+        read_config(data={"kafka_partitioner": "zodiac"})
+    with pytest.raises(ValueError, match="sample_rate_percent"):
+        read_config(data={"kafka_span_sample_rate_percent": 0.0})
+    with pytest.raises(ValueError, match="veneur_metrics_scopes"):
+        read_config(data={"veneur_metrics_scopes": {"counter": "far"}})
+
+
+def test_generate_excluded_tags_rules():
+    from veneur_tpu.core.server import generate_excluded_tags
+    rules = ["nonce", "host_env|signalfx", "dc|datadog|signalfx"]
+    assert generate_excluded_tags(rules, "datadog") == ["nonce", "dc"]
+    assert generate_excluded_tags(rules, "signalfx") == [
+        "nonce", "host_env", "dc"]
+    assert generate_excluded_tags(rules, "kafka") == ["nonce"]
+
+
+def test_tags_exclude_strips_per_sink():
+    """tags_exclude rules reach the sinks: a global rule strips
+    everywhere, a sink-scoped rule only on that sink."""
+    from veneur_tpu.core.config import read_config as rc
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.protocol import dogstatsd as dsd
+    from veneur_tpu.sinks.simple import CaptureSink
+
+    class NamedCapture(CaptureSink):
+        def __init__(self, name):
+            super().__init__()
+            self.name = name
+
+    a, b = NamedCapture("sink_a"), NamedCapture("sink_b")
+    s = Server(rc(data={
+        "interval": "10s",
+        "tags_exclude": ["nonce", "env|sink_b"]}),
+        extra_sinks=[a, b])
+    try:
+        s.table.ingest(dsd.parse_metric(
+            b"hits:1|c|#env:prod,nonce:xyz,keep:yes"))
+        s.flush_once()
+    finally:
+        s.shutdown()
+    ma = [m for m in a.metrics if m.name == "hits"][0]
+    mb = [m for m in b.metrics if m.name == "hits"][0]
+    assert set(ma.tags) == {"env:prod", "keep:yes"}
+    assert set(mb.tags) == {"keep:yes"}
+
+
+def test_omit_empty_hostname():
+    from veneur_tpu.core.config import read_config as rc
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.protocol import dogstatsd as dsd
+    from veneur_tpu.sinks.simple import CaptureSink
+
+    cap = CaptureSink()
+    s = Server(rc(data={"interval": "10s",
+                        "omit_empty_hostname": True}),
+               extra_sinks=[cap])
+    try:
+        s.table.ingest(dsd.parse_metric(b"h:1|c"))
+        s.flush_once()
+    finally:
+        s.shutdown()
+    assert [m.hostname for m in cap.metrics if m.name == "h"] == [""]
+
+
+def test_veneur_metrics_scopes_and_additional_tags():
+    """Self-telemetry metrics pick up the configured scope per type
+    and the extra tags."""
+    from veneur_tpu.core.config import read_config as rc
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.protocol import dogstatsd as dsd
+    from veneur_tpu.sinks.simple import CaptureSink
+
+    cap = CaptureSink()
+    # a LOCAL node forwards global-scope metrics instead of emitting:
+    # making the telemetry counters global must route them to forward
+    s = Server(rc(data={
+        "interval": "10s",
+        "forward_address": "http://127.0.0.1:1",  # local role
+        "veneur_metrics_scopes": {"counter": "global"},
+        "veneur_metrics_additional_tags": ["veneur_internal:true"],
+    }), extra_sinks=[cap])
+    try:
+        s.table.ingest(dsd.parse_metric(b"x:1|c"))
+        s.flush_once()   # tick 1 emits telemetry samples -> ingested
+        res = s.flush_once()  # tick 2 flushes them
+    finally:
+        s.shutdown()
+    fwd_names = {r.meta.name for r in res.forward}
+    # flush.total_duration is a TIMER (histogram scope unchanged ->
+    # forwards anyway on a local); the COUNTER metrics_processed must
+    # now be forwarded as global rather than emitted locally
+    assert any(n.startswith("veneur.") and "total" in n
+               for n in fwd_names)
+    emitted_counters = [m for m in cap.metrics
+                        if m.name == "veneur.worker."
+                                     "metrics_processed_total"]
+    assert not emitted_counters
+    fwd_tags = [t for r in res.forward
+                if r.meta.name.startswith("veneur.")
+                for t in r.meta.tags]
+    assert "veneur_internal:true" in fwd_tags
+
+
+def test_kafka_partitioner_and_batch_bounds():
+    from veneur_tpu.sinks.kafka import bound_batches, partition_for
+
+    recs = [(b"k%d" % i, b"v" * 10) for i in range(10)]
+    chunks = list(bound_batches(recs, 0, 4))
+    assert [len(c) for c in chunks] == [4, 4, 2]
+    chunks = list(bound_batches(recs, 100, 0))
+    assert all(
+        sum(len(k) + len(v) + 32 for k, v in c) <= 100 or len(c) == 1
+        for c in chunks)
+    assert list(bound_batches(recs, 0, 0)) == [recs]
+    # hash partitioning is stable; random stays in range
+    assert partition_for(b"abc", 8, "hash") == \
+        partition_for(b"abc", 8, "hash")
+    assert 0 <= partition_for(b"abc", 8, "random") < 8
+
+
+def test_kafka_produce_retry():
+    from veneur_tpu.sinks.kafka import produce_with_retry
+
+    calls = {"n": 0}
+
+    class Flaky:
+        def produce(self, topic, part, batch, acks=1):
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("transient")
+
+    produce_with_retry(Flaky(), "t", 0, b"x", -1, retry_max=3)
+    assert calls["n"] == 3
+    calls["n"] = 0
+    with pytest.raises(OSError):
+        produce_with_retry(Flaky(), "t", 0, b"x", -1, retry_max=1)
+
+
+def test_kafka_span_sampling_by_tag():
+    from veneur_tpu.sinks.kafka import KafkaSpanSink
+
+    class FakeClient:
+        pass
+
+    sink = KafkaSpanSink("b:9092", client=FakeClient(),
+                         sample_rate_percent=50.0,
+                         sample_tag="customer")
+
+    class Span:
+        def __init__(self, i):
+            self.trace_id = i
+            self.tags = {"customer": f"c{i % 7}"}
+
+    # same tag value -> same decision (whole customers sample together)
+    d1 = sink._sampled_in(Span(3))
+    d2 = sink._sampled_in(Span(10))  # same customer c3
+    assert d1 == d2
+    kept = sum(sink._sampled_in(Span(i)) for i in range(1000))
+    assert 300 < kept < 700  # ~50%
+
+
+def test_splunk_batching_and_connection_recycling(monkeypatch):
+    from veneur_tpu.sinks.splunk import SplunkSpanSink
+
+    sink = SplunkSpanSink("http://127.0.0.1:1", "tok",
+                          batch_size=3, submission_workers=2,
+                          max_connection_lifetime=0.01,
+                          connection_lifetime_jitter=0.01)
+    posts = []
+    monkeypatch.setattr(sink, "_post",
+                        lambda batch: posts.append(len(batch)))
+
+    class Span:
+        trace_id = 0
+        id = 1
+        parent_id = 0
+        name = "n"
+        service = "s"
+        start_timestamp = 0
+        end_timestamp = 10
+        error = False
+        indicator = False
+        tags = {}
+
+    sink.start()
+    try:
+        for _ in range(8):
+            sink.ingest(Span())
+        sink.flush()
+        assert sorted(posts) == [2, 3, 3]
+        # connection recycling: the persistent conn is redialed after
+        # the jittered lifetime deadline
+        c1 = sink._connection()
+        import time
+        time.sleep(0.05)
+        c2 = sink._connection()
+        assert c1 is not c2
+    finally:
+        sink.stop()
+
+
+def test_signalfx_dynamic_key_refresh(monkeypatch):
+    import json as _json
+
+    from veneur_tpu.sinks.signalfx import SignalFxSink
+
+    sink = SignalFxSink("base-key", vary_key_by="customer",
+                        dynamic_per_tag_api_keys_enable=True,
+                        dynamic_per_tag_api_keys_refresh_period=3600)
+
+    class Resp:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+        def read(self):
+            return _json.dumps({"results": [
+                {"name": "acme", "secret": "tok-acme"}]}).encode()
+
+    monkeypatch.setattr("urllib.request.urlopen",
+                        lambda req, timeout=0: Resp())
+    sink._refresh_keys()
+    assert sink.per_tag_api_keys["acme"] == "tok-acme"
+
+    from veneur_tpu.core.metrics import GAUGE, InterMetric
+    m = InterMetric(name="x", timestamp=0, value=1.0,
+                    tags=("customer:acme",), type=GAUGE)
+    assert sink._token_for(m) == "tok-acme"
+
+
+def test_lightstep_buffer_cap():
+    from veneur_tpu.sinks.lightstep import LightStepSpanSink
+
+    sink = LightStepSpanSink("tok", maximum_spans=5)
+
+    class Span:
+        trace_id = 1
+        id = 2
+        parent_id = 0
+        name = "n"
+        service = "s"
+        start_timestamp = 0
+        end_timestamp = 10
+        error = False
+        tags = {}
+
+    for _ in range(9):
+        sink.ingest(Span())
+    assert len(sink._buf) == 5
+    assert sink.dropped == 4
+
+
+def test_datadog_prefix_drops_and_tag_exclusion(monkeypatch):
+    from veneur_tpu.core.metrics import GAUGE, InterMetric
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
+
+    sink = DatadogMetricSink(
+        "k", "http://127.0.0.1:1", 10.0,
+        metric_name_prefix_drops=("debug.",),
+        exclude_tags_prefix_by_prefix_metric=[
+            {"metric_prefix": "db.", "tags": ["shard"]}])
+    posted = []
+    monkeypatch.setattr(sink, "_post", lambda chunk: posted.extend(chunk))
+    sink.flush([
+        InterMetric(name="debug.noise", timestamp=0, value=1.0,
+                    tags=(), type=GAUGE),
+        InterMetric(name="db.latency", timestamp=0, value=2.0,
+                    tags=("shard:3", "env:prod"), type=GAUGE),
+        InterMetric(name="api.hits", timestamp=0, value=3.0,
+                    tags=("shard:3",), type=GAUGE),
+    ])
+    names = {e["metric"] for e in posted}
+    assert names == {"db.latency", "api.hits"}
+    by_name = {e["metric"]: e for e in posted}
+    assert by_name["db.latency"]["tags"] == ["env:prod"]
+    assert by_name["api.hits"]["tags"] == ["shard:3"]
+
+
+def test_num_span_workers_drain_concurrently():
+    """num_span_workers dispatch threads drain one queue; every span
+    reaches the sink exactly once."""
+    import time
+
+    from veneur_tpu.core.spans import SpanWorker
+
+    class Cap:
+        name = "cap"
+
+        def __init__(self):
+            self.got = []
+
+        def start(self):
+            pass
+
+        def ingest(self, span):
+            self.got.append(span)
+
+        def flush(self):
+            pass
+
+    class Span:
+        def __init__(self, i):
+            self.trace_id = i + 1
+            self.id = i + 1
+            self.parent_id = 0
+            self.name = "n"
+            self.service = "s"
+            self.start_timestamp = 1
+            self.end_timestamp = 2
+            self.error = False
+            self.indicator = False
+            self.tags = {}
+            self.metrics = []
+
+    cap = Cap()
+    w = SpanWorker([cap], common_tags={}, workers=4)
+    w.start()
+    try:
+        for i in range(200):
+            assert w.submit(Span(i))
+        deadline = time.monotonic() + 5
+        while len(cap.got) < 200 and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        w.stop()
+    assert len(cap.got) == 200
+    assert len({s.id for s in cap.got}) == 200
+
+
+def test_kafka_acks_none_does_not_wait(monkeypatch):
+    """acks=0 sends no ProduceResponse by protocol: produce must
+    write-and-return, not block reading a response that never comes."""
+    import socket as _socket
+
+    from veneur_tpu.sinks.kafka import KafkaClient
+
+    client = KafkaClient("127.0.0.1:9092")
+    sent = []
+
+    class FakeSock:
+        def sendall(self, data):
+            sent.append(data)
+
+        def recv(self, n):
+            raise AssertionError("acks=0 must not read a response")
+
+    monkeypatch.setattr(client, "_connect", lambda: FakeSock())
+    client.produce("t", 0, b"batch", acks=0)
+    assert sent  # the request went out
+
+
+def test_opentracing_inject_unknown_format_raises():
+    from veneur_tpu.trace import opentracing as ot
+
+    tr = ot.Tracer()
+    ctx = tr.start_span("x").context()
+    with pytest.raises(ot.UnsupportedFormatError):
+        tr.inject(ctx, "bogus", {})

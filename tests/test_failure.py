@@ -88,7 +88,11 @@ def test_raising_sink_isolated_from_others(make_server):
         def flush_other_samples(self, samples):
             raise RuntimeError("boom")
 
-    server, cap = make_server(extra_sinks=[BoomSink()])
+    # long interval: the test drives flush_once manually and ingests
+    # directly into the table (no server lock) — a 50ms ticker flush
+    # racing those direct ingests can wipe a value mid-step
+    server, cap = make_server(extra_sinks=[BoomSink()],
+                              interval="60s")
     from veneur_tpu.protocol import dogstatsd as dsd
     server.table.ingest(dsd.parse_metric(b"ok:5|c"))
     server.flush_once()

@@ -36,6 +36,8 @@ def main(argv=None) -> int:
     except (ValueError, OSError) as e:
         print(f"config error: {e}", file=sys.stderr)
         return 1
+    if cfg.debug:
+        logging.getLogger().setLevel(logging.DEBUG)
     if args.validate_config or args.validate_config_strict:
         print("config ok")
         return 0

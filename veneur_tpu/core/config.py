@@ -48,6 +48,8 @@ class Config:
     hostname: str = ""
     tags: list[str] = field(default_factory=list)
     interval: str = "10s"
+    # debug-level logging (reference config.go Debug)
+    debug: bool = False
     flush_watchdog_missed_flushes: int = 0
     synchronize_with_interval: bool = False
 
@@ -56,6 +58,9 @@ class Config:
     statsd_listen_addresses: list[str] = field(default_factory=list)
     ssf_listen_addresses: list[str] = field(default_factory=list)
     grpc_listen_addresses: list[str] = field(default_factory=list)
+    # deprecated single-listener alias of grpc_listen_addresses
+    # (reference config.go GrpcAddress)
+    grpc_address: str = ""
     http_address: str = ""
     # serve POST-free GET /quitquitquit for graceful shutdown
     # (reference server.go:82 http_quit)
@@ -99,12 +104,58 @@ class Config:
     objective_span_timer_name: str = ""
     span_channel_capacity: int = 1024
 
+    # hostname/tag emission controls (config.go:74,111)
+    # keep hostname EMPTY on emitted metrics instead of defaulting to
+    # the os hostname (reference server.go hostname fallback)
+    omit_empty_hostname: bool = False
+    # per-sink tag exclusion rules: "tagname" strips everywhere,
+    # "tagname|sink1|sink2" strips only on the named sinks
+    # (reference server.go:1642-1668 setSinkExcludedTags)
+    tags_exclude: list[str] = field(default_factory=list)
+    # scope overrides for the server's OWN metrics by type
+    # ({counter: local|global|default, gauge: ..., ...}; reference
+    # scopesFromConfig server.go:278) and extra tags on them
+    veneur_metrics_scopes: dict = field(default_factory=dict)
+    veneur_metrics_additional_tags: list[str] = field(
+        default_factory=list)
+
+    # worker sizing.  num_workers is parsed for config compatibility
+    # but is an intentional no-op: the reference shards across N
+    # aggregation goroutines (worker.go:31); here ONE device-resident
+    # columnar table replaces the shard set, and reader parallelism is
+    # num_readers.  num_span_workers sizes the span fan-out pool
+    # (reference worker.go:575).
+    num_workers: int = 0
+    num_span_workers: int = 1
+
+    # profiling knobs: Go-runtime specific (mutex/block profiling,
+    # server.go:371-384); parsed for compatibility, documented no-ops
+    # under the JAX runtime (enable_profiling drives the jax trace)
+    mutex_profile_fraction: int = 0
+    block_profile_rate: int = 0
+    # log every ingested span (reference debug_ingested_spans)
+    debug_ingested_spans: bool = False
+
     # sinks
     debug_flushed_metrics: bool = False
     blackhole_sink: bool = False
     datadog_api_key: str = ""
     datadog_api_hostname: str = "https://app.datadoghq.com"
     datadog_flush_max_per_body: int = 25000
+    # deprecated alias of datadog_flush_max_per_body (example.yaml:188)
+    flush_max_per_body: int = 0
+    # drop metrics whose name starts with any of these prefixes before
+    # the datadog sink (config.go DatadogMetricNamePrefixDrops)
+    datadog_metric_name_prefix_drops: list[str] = field(
+        default_factory=list)
+    # strip tag PREFIXES from metrics with matching name prefixes
+    # ([{metric_prefix: "...", tags: [...]}]; example.yaml:301)
+    datadog_exclude_tags_prefix_by_prefix_metric: list = field(
+        default_factory=list)
+    # ring-buffer span capacity for the datadog span sink; the
+    # deprecated ssf_buffer_size aliases it (example.yaml:190)
+    datadog_span_buffer_size: int = 16384
+    ssf_buffer_size: int = 0
     prometheus_repeater_address: str = ""
     prometheus_network_type: str = "tcp"
     flush_file: str = ""  # localfile plugin
@@ -116,28 +167,71 @@ class Config:
     aws_secret_access_key: str = ""
     # override for S3-compatible stores (minio, test fakes)
     aws_s3_endpoint: str = ""
-    # kafka (reference config.go:38-55; the buffer/acks tuning knobs
-    # are deliberately absent — flushes batch per interval here)
+    # kafka (reference config.go:38-55)
     kafka_broker: str = ""
     kafka_metric_topic: str = "veneur_metrics"
     kafka_check_topic: str = ""
     kafka_event_topic: str = ""
     kafka_span_topic: str = ""
     kafka_span_serialization_format: str = "protobuf"
+    # producer tuning (sarama equivalents): flushes batch per interval
+    # here, and these bound the per-interval produce batches
+    kafka_metric_buffer_bytes: int = 0
+    kafka_metric_buffer_messages: int = 0
+    kafka_metric_buffer_frequency: str = ""
+    kafka_span_buffer_bytes: int = 0
+    kafka_span_buffer_mesages: int = 0  # reference's own typo, kept
+    kafka_span_buffer_frequency: str = ""
+    # acks required from the broker: none, local or all
+    kafka_metric_require_acks: str = "all"
+    kafka_span_require_acks: str = "all"
+    kafka_partitioner: str = "hash"  # hash | random
+    kafka_retry_max: int = 0
+    # span sampling: percent kept, hashed on a tag (or trace id)
+    kafka_span_sample_rate_percent: float = 100.0
+    kafka_span_sample_tag: str = ""
     # datadog span half: local trace agent (config.go:20)
     datadog_trace_api_address: str = ""
     # signalfx (config.go:80-93)
     signalfx_api_key: str = ""
     signalfx_endpoint_base: str = "https://ingest.signalfx.com"
+    # separate API (metadata) endpoint for dynamic key fetch; empty
+    # falls back to endpoint_base (reference SignalfxEndpointAPI)
+    signalfx_endpoint_api: str = ""
     signalfx_flush_max_per_body: int = 5000
     signalfx_vary_key_by: str = ""
     signalfx_per_tag_api_keys: dict = field(default_factory=dict)
-    # splunk HEC span sink (config.go:95-104)
+    # periodically refresh the per-tag key map from the API endpoint
+    # (reference server.go:530-541)
+    signalfx_dynamic_per_tag_api_keys_enable: bool = False
+    signalfx_dynamic_per_tag_api_keys_refresh_period: str = "10m"
+    # dimension name carrying the hostname (default "host")
+    signalfx_hostname_tag: str = "host"
+    # drop metrics/tags by name prefix before emission
+    signalfx_metric_name_prefix_drops: list[str] = field(
+        default_factory=list)
+    signalfx_metric_tag_prefix_drops: list[str] = field(
+        default_factory=list)
+    # splunk HEC span sink (config.go:95-104, server.go:660-697)
     splunk_hec_address: str = ""
     splunk_hec_token: str = ""
     splunk_span_sample_rate: int = 1
+    splunk_hec_batch_size: int = 100
+    splunk_hec_submission_workers: int = 1
+    splunk_hec_tls_validate_hostname: str = ""
+    splunk_hec_send_timeout: str = ""
+    splunk_hec_ingest_timeout: str = ""
+    # recycle HEC connections after at most this lifetime, jittered
+    # so a fleet's connections don't stampede the indexer together
+    splunk_hec_max_connection_lifetime: str = ""
+    splunk_hec_connection_lifetime_jitter: str = ""
     # newrelic (config.go:63-69)
     newrelic_insert_key: str = ""
+    newrelic_account_id: int = 0
+    newrelic_region: str = ""
+    newrelic_event_type: str = "veneur"
+    newrelic_service_check_event_type: str = "veneurCheck"
+    newrelic_trace_observer_url: str = ""
     newrelic_metric_endpoint: str = "https://metric-api.newrelic.com"
     newrelic_trace_endpoint: str = "https://trace-api.newrelic.com"
     newrelic_common_tags: list[str] = field(default_factory=list)
@@ -145,9 +239,18 @@ class Config:
     xray_address: str = ""
     xray_sample_percentage: float = 100.0
     xray_annotation_tags: list[str] = field(default_factory=list)
-    # lightstep (config.go:56-57)
+    # lightstep (config.go:56-57); trace_lightstep_* are the
+    # reference's deprecated aliases (example.yaml:191-204)
     lightstep_access_token: str = ""
     lightstep_collector_host: str = "https://collector.lightstep.com"
+    lightstep_maximum_spans: int = 100000
+    lightstep_num_clients: int = 1
+    lightstep_reconnect_period: str = "5m"
+    trace_lightstep_access_token: str = ""
+    trace_lightstep_collector_host: str = ""
+    trace_lightstep_maximum_spans: int = 0
+    trace_lightstep_num_clients: int = 0
+    trace_lightstep_reconnect_period: str = ""
     # falconer: thin grpsink wrapper (config.go:25)
     falconer_address: str = ""
 
@@ -180,6 +283,49 @@ class Config:
     # bounding host staging memory and smoothing device work instead of
     # landing the whole interval's batch at the flush boundary
     tpu_stage_flush_samples: int = 65536
+    # multi-chip global tier: nonzero runs the table as SPMD sharded
+    # planes over a (shard, series) jax Mesh of ALL visible devices,
+    # with this many entries on the shard (ingest-parallel) axis; the
+    # flush merge rides ICI collectives (parallel/sharded.py).  0 =
+    # single-chip table.
+    tpu_mesh_shards: int = 0
+
+    def resolve_aliases(self) -> None:
+        """Fold the reference's deprecated alias keys into their
+        replacements (example.yaml:187-204): deprecated value applies
+        only when the replacement still holds its default."""
+        if self.grpc_address and not self.grpc_listen_addresses:
+            addr = self.grpc_address
+            if "://" not in addr:
+                addr = "tcp://" + addr
+            self.grpc_listen_addresses = [addr]
+        if self.flush_max_per_body and \
+                self.datadog_flush_max_per_body == 25000:
+            self.datadog_flush_max_per_body = self.flush_max_per_body
+        if self.ssf_buffer_size and \
+                self.datadog_span_buffer_size == 16384:
+            self.datadog_span_buffer_size = self.ssf_buffer_size
+        if self.trace_lightstep_access_token and \
+                not self.lightstep_access_token:
+            self.lightstep_access_token = \
+                self.trace_lightstep_access_token
+        if self.trace_lightstep_collector_host and \
+                self.lightstep_collector_host == \
+                "https://collector.lightstep.com":
+            self.lightstep_collector_host = \
+                self.trace_lightstep_collector_host
+        if self.trace_lightstep_maximum_spans and \
+                self.lightstep_maximum_spans == 100000:
+            self.lightstep_maximum_spans = \
+                self.trace_lightstep_maximum_spans
+        if self.trace_lightstep_num_clients and \
+                self.lightstep_num_clients == 1:
+            self.lightstep_num_clients = \
+                self.trace_lightstep_num_clients
+        if self.trace_lightstep_reconnect_period and \
+                self.lightstep_reconnect_period == "5m":
+            self.lightstep_reconnect_period = \
+                self.trace_lightstep_reconnect_period
 
     def accelerator_probe_timeout_seconds(self) -> float:
         return parse_duration(self.accelerator_probe_timeout)
@@ -230,6 +376,32 @@ class Config:
                 "kafka_span_serialization_format must be "
                 "'protobuf' or 'json', got "
                 f"{self.kafka_span_serialization_format!r}")
+        for key in ("kafka_metric_require_acks",
+                    "kafka_span_require_acks"):
+            if getattr(self, key) not in ("none", "local", "all"):
+                problems.append(
+                    f"{key} must be none, local or all")
+        if self.kafka_partitioner not in ("hash", "random"):
+            problems.append("kafka_partitioner must be hash or random")
+        if not (0.0 < self.kafka_span_sample_rate_percent <= 100.0):
+            problems.append(
+                "kafka_span_sample_rate_percent must be in (0, 100]")
+        if self.num_span_workers <= 0:
+            problems.append("num_span_workers must be positive")
+        for scope_type, scope in self.veneur_metrics_scopes.items():
+            if scope_type not in ("counter", "gauge", "histogram",
+                                  "set", "status"):
+                problems.append(
+                    f"veneur_metrics_scopes: unknown type "
+                    f"{scope_type!r}")
+            if scope not in ("local", "global", "default"):
+                problems.append(
+                    f"veneur_metrics_scopes: unknown scope {scope!r}")
+        for rule in self.datadog_exclude_tags_prefix_by_prefix_metric:
+            if not (isinstance(rule, dict) and "metric_prefix" in rule):
+                problems.append(
+                    "datadog_exclude_tags_prefix_by_prefix_metric "
+                    "entries need a metric_prefix")
         return problems
 
 
@@ -330,6 +502,8 @@ def read_config(path: str | None = None, data: dict | None = None,
         if env_key in env:
             setattr(cfg, name, _coerce(cls, name, env[env_key]))
 
+    if hasattr(cfg, "resolve_aliases"):
+        cfg.resolve_aliases()
     problems = cfg.validate()
     if problems:
         raise ValueError("; ".join(problems))

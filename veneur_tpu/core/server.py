@@ -58,6 +58,19 @@ def _is_oom_error(e: BaseException) -> bool:
     return any(m in msg for m in _OOM_MARKERS)
 
 
+def generate_excluded_tags(rules: list[str],
+                           sink_name: str) -> list[str]:
+    """tags_exclude rules -> tag names excluded for one sink:
+    "tagname" applies everywhere, "tagname|sink1|sink2" only on the
+    named sinks (reference server.go generateExcludedTags)."""
+    out = []
+    for rule in rules:
+        parts = rule.split("|")
+        if len(parts) == 1 or sink_name in parts[1:]:
+            out.append(parts[0])
+    return out
+
+
 class Server:
     def __init__(self, config: Config, extra_sinks: list | None = None,
                  extra_plugins: list | None = None,
@@ -79,6 +92,24 @@ class Server:
             set_rows=config.tpu_set_rows,
             compression=config.tpu_compression,
             histo_slots=config.tpu_histo_slots)
+        if config.tpu_mesh_shards:
+            # multi-chip global node: SPMD sharded planes over the
+            # full device mesh; flush merge = ICI collectives
+            from veneur_tpu.parallel.sharded import (ShardedConfig,
+                                                     ShardedTable,
+                                                     make_mesh)
+            mesh = make_mesh(n_shard=config.tpu_mesh_shards)
+            self.table = ShardedTable(mesh, ShardedConfig(
+                rows=config.tpu_histo_rows,
+                set_rows=config.tpu_set_rows,
+                counter_rows=config.tpu_counter_rows,
+                gauge_rows=config.tpu_gauge_rows,
+                compression=config.tpu_compression,
+                slots=config.tpu_histo_slots,
+                batch=max(1024, config.tpu_stage_flush_samples)))
+            self._init_after_table(config, extra_sinks, extra_plugins,
+                                   extra_span_sinks)
+            return
         try:
             self.table = MetricTable(table_cfg)
         except RuntimeError as e:
@@ -102,12 +133,21 @@ class Server:
             except Exception:
                 pass
             self.table = MetricTable(table_cfg)
+        self._init_after_table(config, extra_sinks, extra_plugins,
+                               extra_span_sinks)
+
+    def _init_after_table(self, config, extra_sinks, extra_plugins,
+                          extra_span_sinks) -> None:
+        """Everything downstream of table construction — shared by the
+        single-chip and mesh-sharded table paths."""
         self.lock = threading.Lock()
         self.flusher = Flusher(
             is_local=self.is_local,
             percentiles=tuple(config.percentiles),
             aggregates=tuple(config.aggregates),
-            hostname=config.hostname or socket.gethostname(),
+            hostname=(config.hostname if (config.hostname or
+                                          config.omit_empty_hostname)
+                      else socket.gethostname()),
             tags=tuple(config.tags),
             percentile_naming=config.percentile_naming,
             quantile_interpolation=config.quantile_interpolation)
@@ -130,7 +170,15 @@ class Server:
             common_tags=dict(t.split(":", 1) for t in config.tags
                              if ":" in t),
             capacity=config.span_channel_capacity,
-            stats_cb=self.bump)
+            stats_cb=self.bump,
+            workers=config.num_span_workers)
+        # per-sink tag exclusion (reference server.go:1642
+        # setSinkExcludedTags) — after ALL sinks exist
+        if config.tags_exclude:
+            for sink in self.metric_sinks + self.span_sinks:
+                if hasattr(sink, "set_excluded_tags"):
+                    sink.set_excluded_tags(generate_excluded_tags(
+                        config.tags_exclude, sink.name))
         # in-process loopback trace client: the server (and any
         # embedding code) traces into its OWN span pipeline — the role
         # of the reference's NewChannelClient (server.go:347-354)
@@ -194,18 +242,34 @@ class Server:
             self.metric_sinks.append(DatadogMetricSink(
                 c.datadog_api_key, c.datadog_api_hostname,
                 self.interval, hostname=c.hostname,
-                flush_max_per_body=c.datadog_flush_max_per_body))
+                flush_max_per_body=c.datadog_flush_max_per_body,
+                metric_name_prefix_drops=tuple(
+                    c.datadog_metric_name_prefix_drops),
+                exclude_tags_prefix_by_prefix_metric=(
+                    c.datadog_exclude_tags_prefix_by_prefix_metric)))
         if c.prometheus_repeater_address:
             self.metric_sinks.append(PrometheusRepeaterSink(
                 c.prometheus_repeater_address, c.prometheus_network_type))
         if c.signalfx_api_key:
+            from veneur_tpu.core.config import parse_duration
             from veneur_tpu.sinks.signalfx import SignalFxSink
             self.metric_sinks.append(SignalFxSink(
                 c.signalfx_api_key, endpoint=c.signalfx_endpoint_base,
                 vary_key_by=c.signalfx_vary_key_by,
                 per_tag_api_keys=c.signalfx_per_tag_api_keys,
                 max_per_body=c.signalfx_flush_max_per_body,
-                hostname=c.hostname))
+                hostname=c.hostname,
+                hostname_tag=c.signalfx_hostname_tag,
+                metric_name_prefix_drops=tuple(
+                    c.signalfx_metric_name_prefix_drops),
+                metric_tag_prefix_drops=tuple(
+                    c.signalfx_metric_tag_prefix_drops),
+                dynamic_per_tag_api_keys_enable=(
+                    c.signalfx_dynamic_per_tag_api_keys_enable),
+                dynamic_per_tag_api_keys_refresh_period=parse_duration(
+                    c.signalfx_dynamic_per_tag_api_keys_refresh_period
+                    or "10m"),
+                endpoint_api=c.signalfx_endpoint_api))
         if c.newrelic_insert_key:
             from veneur_tpu.sinks.newrelic import (NewRelicMetricSink,
                                                    NewRelicSpanSink)
@@ -214,31 +278,67 @@ class Server:
             self.metric_sinks.append(NewRelicMetricSink(
                 c.newrelic_insert_key,
                 endpoint=c.newrelic_metric_endpoint,
-                common_attributes=common, interval=self.interval))
+                common_attributes=common, interval=self.interval,
+                account_id=c.newrelic_account_id,
+                region=c.newrelic_region,
+                event_type=c.newrelic_event_type,
+                service_check_event_type=(
+                    c.newrelic_service_check_event_type)))
             self.span_sinks.append(NewRelicSpanSink(
                 c.newrelic_insert_key,
-                endpoint=c.newrelic_trace_endpoint))
+                endpoint=c.newrelic_trace_endpoint,
+                trace_observer_url=c.newrelic_trace_observer_url,
+                region=c.newrelic_region))
         if c.kafka_broker:
             from veneur_tpu.sinks.kafka import (KafkaMetricSink,
                                                 KafkaSpanSink)
             self.metric_sinks.append(KafkaMetricSink(
                 c.kafka_broker, check_topic=c.kafka_check_topic,
                 event_topic=c.kafka_event_topic,
-                metric_topic=c.kafka_metric_topic))
+                metric_topic=c.kafka_metric_topic,
+                require_acks=c.kafka_metric_require_acks,
+                partitioner=c.kafka_partitioner,
+                retry_max=c.kafka_retry_max,
+                buffer_bytes=c.kafka_metric_buffer_bytes,
+                buffer_messages=c.kafka_metric_buffer_messages))
             if c.kafka_span_topic:
                 self.span_sinks.append(KafkaSpanSink(
                     c.kafka_broker, span_topic=c.kafka_span_topic,
-                    serialization=c.kafka_span_serialization_format))
+                    serialization=c.kafka_span_serialization_format,
+                    require_acks=c.kafka_span_require_acks,
+                    partitioner=c.kafka_partitioner,
+                    retry_max=c.kafka_retry_max,
+                    buffer_bytes=c.kafka_span_buffer_bytes,
+                    buffer_messages=c.kafka_span_buffer_mesages,
+                    sample_rate_percent=(
+                        c.kafka_span_sample_rate_percent),
+                    sample_tag=c.kafka_span_sample_tag))
         if c.datadog_trace_api_address:
             from veneur_tpu.sinks.datadog import DatadogSpanSink
             self.span_sinks.append(DatadogSpanSink(
-                c.datadog_trace_api_address, hostname=c.hostname))
+                c.datadog_trace_api_address, hostname=c.hostname,
+                buffer_size=c.datadog_span_buffer_size))
         if c.splunk_hec_address and c.splunk_hec_token:
+            from veneur_tpu.core.config import parse_duration
             from veneur_tpu.sinks.splunk import SplunkSpanSink
+
+            def _dur(text: str) -> float:
+                return parse_duration(text) if text else 0.0
+
             self.span_sinks.append(SplunkSpanSink(
                 c.splunk_hec_address, c.splunk_hec_token,
                 sample_rate=c.splunk_span_sample_rate,
-                hostname=c.hostname))
+                hostname=c.hostname,
+                batch_size=c.splunk_hec_batch_size,
+                submission_workers=c.splunk_hec_submission_workers,
+                send_timeout=_dur(c.splunk_hec_send_timeout),
+                ingest_timeout=_dur(c.splunk_hec_ingest_timeout),
+                max_connection_lifetime=_dur(
+                    c.splunk_hec_max_connection_lifetime),
+                connection_lifetime_jitter=_dur(
+                    c.splunk_hec_connection_lifetime_jitter),
+                tls_validate_hostname=(
+                    c.splunk_hec_tls_validate_hostname)))
         if c.xray_address:
             from veneur_tpu.sinks.xray import XRaySpanSink
             self.span_sinks.append(XRaySpanSink(
@@ -246,10 +346,15 @@ class Server:
                 sample_percentage=c.xray_sample_percentage,
                 annotation_tags=tuple(c.xray_annotation_tags)))
         if c.lightstep_access_token:
+            from veneur_tpu.core.config import parse_duration
             from veneur_tpu.sinks.lightstep import LightStepSpanSink
             self.span_sinks.append(LightStepSpanSink(
                 c.lightstep_access_token,
-                collector_host=c.lightstep_collector_host))
+                collector_host=c.lightstep_collector_host,
+                maximum_spans=c.lightstep_maximum_spans,
+                num_clients=c.lightstep_num_clients,
+                reconnect_period=parse_duration(
+                    c.lightstep_reconnect_period or "5m")))
         if c.falconer_address:
             from veneur_tpu.sinks.grpsink import FalconerSpanSink
             self.span_sinks.append(FalconerSpanSink(c.falconer_address))
@@ -589,6 +694,9 @@ class Server:
     def handle_ssf(self, span) -> None:
         """Enqueue one span (reference server.go:1190 handleSSF);
         per-protocol receive counters are bumped at the listeners."""
+        if self.config.debug_ingested_spans:
+            log.debug("ingested span service=%s name=%s trace=%s",
+                      span.service, span.name, span.trace_id)
         self.span_worker.submit(span)
 
     def _udp_reader(self, sock: socket.socket,
@@ -1226,6 +1334,12 @@ class Server:
                 pass
         if self._grpc_client is not None:
             self._grpc_client.close()
+        for s in self.metric_sinks + self.span_sinks:
+            if hasattr(s, "stop"):
+                try:
+                    s.stop()
+                except Exception:
+                    pass
         self._pool.shutdown(wait=False)
         # close releases the flock; the lock FILE stays (unlinking it
         # would race two starting instances onto different inodes of

@@ -23,7 +23,8 @@ SINK_TIMEOUT = 9.0  # reference worker.go:611 const Timeout
 
 class SpanWorker:
     def __init__(self, sinks: list, common_tags: dict[str, str],
-                 capacity: int = 1024, stats_cb=None):
+                 capacity: int = 1024, stats_cb=None,
+                 workers: int = 1):
         self.sinks = list(sinks)
         self.common_tags = dict(common_tags)
         self.queue: queue.Queue = queue.Queue(maxsize=capacity)
@@ -32,16 +33,34 @@ class SpanWorker:
         # wedge itself — its spans are dropped-and-counted while its
         # ingest hangs, and every other sink keeps flowing (the
         # reference gets the same isolation from per-sink goroutines,
-        # worker.go:648)
+        # worker.go:648).  In-flight work per sink is BOUNDED: with
+        # several dispatch threads feeding one serialized sink, a
+        # small queue absorbs bursts while a truly wedged sink still
+        # sheds load instead of accumulating the interval behind it.
         self._pools = [ThreadPoolExecutor(max_workers=1)
                        for _ in self.sinks]
-        self._pending = [None] * len(self.sinks)
+        self._inflight = [0] * len(self.sinks)
+        self._inflight_cap = 128
+        # a sink whose ingest TIMED OUT is wedged: later spans skip it
+        # instantly (no 9s wait each) until its hung call returns —
+        # the reference's skip-busy-sink behavior, kept compatible
+        # with multiple dispatch threads
+        self._timed_out = [False] * len(self.sinks)
+        # RLock: a future that completes before add_done_callback runs
+        # executes the callback INLINE in the submitting thread, which
+        # already holds this lock
+        self._pending_lock = threading.RLock()
         self._shutdown = threading.Event()
-        self._thread = threading.Thread(target=self._work, daemon=True,
-                                        name="span-worker")
+        # num_span_workers dispatch threads drain the one queue
+        # (reference worker.go:575 SpanWorker set, server.go:892-910)
+        self._threads = [
+            threading.Thread(target=self._work, daemon=True,
+                             name=f"span-worker-{i}")
+            for i in range(max(1, workers))]
 
     def start(self) -> None:
-        self._thread.start()
+        for t in self._threads:
+            t.start()
 
     def submit(self, span) -> bool:
         """Enqueue; drop-and-count when the buffer is full (the
@@ -70,30 +89,42 @@ class SpanWorker:
                 self._stats_cb("empty_ssf")
                 continue
             futs = []
-            for i, s in enumerate(self.sinks):
-                prev = self._pending[i]
-                if prev is not None and not prev.done():
-                    # the sink is still stuck in an earlier ingest:
-                    # don't queue more work behind it
-                    self._stats_cb("span_sink_dropped")
-                    continue
-                self._pending[i] = self._pools[i].submit(s.ingest, span)
-                futs.append((i, s))
-            for i, sink in futs:
+            with self._pending_lock:
+                for i, s in enumerate(self.sinks):
+                    if ((self._timed_out[i] and self._inflight[i]) or
+                            self._inflight[i] >= self._inflight_cap):
+                        # the sink is wedged (a timed-out ingest still
+                        # hasn't returned) or far behind: shed load
+                        # instead of queueing an interval behind it
+                        self._stats_cb("span_sink_dropped")
+                        continue
+                    fut = self._pools[i].submit(s.ingest, span)
+                    self._inflight[i] += 1
+                    fut.add_done_callback(
+                        lambda _f, i=i: self._task_done(i))
+                    futs.append((i, s, fut))
+            for i, sink, fut in futs:
                 try:
-                    self._pending[i].result(timeout=SINK_TIMEOUT)
-                    self._pending[i] = None
+                    fut.result(timeout=SINK_TIMEOUT)
                 except FTimeout:
-                    # leave the future as pending; later spans skip
-                    # this sink until it returns
+                    # the task keeps running on the sink's pool; the
+                    # wedged flag sheds later spans instantly while
+                    # it's stuck
+                    with self._pending_lock:
+                        self._timed_out[i] = True
                     self._stats_cb("span_sink_timeouts")
                     log.warning("span sink %s timed out", sink.name)
                 except Exception:
-                    self._pending[i] = None
                     self._stats_cb("span_sink_errors")
                     log.exception("span sink %s ingest failed",
                                   sink.name)
             self._stats_cb("spans_processed")
+
+    def _task_done(self, i: int) -> None:
+        with self._pending_lock:
+            self._inflight[i] -= 1
+            if self._inflight[i] == 0:
+                self._timed_out[i] = False
 
     def flush(self) -> None:
         """Per-interval sink flush (reference SpanWorker.Flush,
@@ -106,7 +137,8 @@ class SpanWorker:
 
     def stop(self) -> None:
         self._shutdown.set()
-        if self._thread.is_alive():
-            self._thread.join(timeout=1.0)
+        for t in self._threads:
+            if t.is_alive():
+                t.join(timeout=1.0)
         for p in self._pools:
             p.shutdown(wait=False)

@@ -109,22 +109,38 @@ class Telemetry:
         """Called once per flush with the interval's numbers; builds
         and emits the operator samples."""
         samples: list[dsd.Sample] = []
+        cfg = self.server.config
+        # per-type scope overrides + fixed extra tags on the server's
+        # OWN metrics (reference scopesFromConfig server.go:278 +
+        # veneur_metrics_additional_tags)
+        name_to_scope = {"local": dsd.SCOPE_LOCAL,
+                         "global": dsd.SCOPE_GLOBAL,
+                         "default": dsd.SCOPE_DEFAULT}
+        scope_cfg = cfg.veneur_metrics_scopes
+        extra = tuple(cfg.veneur_metrics_additional_tags)
+
+        def _scope(mtype: str) -> str:
+            return name_to_scope.get(scope_cfg.get(mtype, "local"),
+                                     dsd.SCOPE_LOCAL)
 
         def count(name, value, tags=()):
             if value:
                 samples.append(dsd.Sample(
                     name=name, type=dsd.COUNTER, value=float(value),
-                    tags=tuple(sorted(tags)), scope=dsd.SCOPE_LOCAL))
+                    tags=tuple(sorted(tuple(tags) + extra)),
+                    scope=_scope("counter")))
 
         def gauge(name, value, tags=()):
             samples.append(dsd.Sample(
                 name=name, type=dsd.GAUGE, value=float(value),
-                tags=tuple(sorted(tags)), scope=dsd.SCOPE_LOCAL))
+                tags=tuple(sorted(tuple(tags) + extra)),
+                scope=_scope("gauge")))
 
         def timer(name, value_ns, tags=()):
             samples.append(dsd.Sample(
                 name=name, type=dsd.TIMER, value=float(value_ns),
-                tags=tuple(sorted(tags)), scope=dsd.SCOPE_LOCAL))
+                tags=tuple(sorted(tuple(tags) + extra)),
+                scope=_scope("histogram")))
 
         for key, (name, tags) in _COUNTER_MAP.items():
             count(name, self._delta(key), tags)

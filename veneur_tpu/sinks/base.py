@@ -90,6 +90,24 @@ class SinkBase:
         pass
 
 
+class SpanTagExcluder:
+    """set_excluded_tags for SPAN sinks (the reference's
+    setSinkExcludedTags walks span sinks too, server.go:1658): span
+    tags are a dict, filtered at payload-build time so the shared
+    span object is never mutated across sinks."""
+
+    excluded_tags: frozenset = frozenset()
+
+    def set_excluded_tags(self, tags: Iterable[str]) -> None:
+        self.excluded_tags = frozenset(tags)
+
+    def filter_span_tags(self, tags) -> dict:
+        if not self.excluded_tags:
+            return dict(tags)
+        return {k: v for k, v in tags.items()
+                if k not in self.excluded_tags}
+
+
 def route(metrics: list[InterMetric], sink_name: str,
           sink: SinkBase | None = None) -> list[InterMetric]:
     """Filter a flush batch for one sink: whitelist routing + excluded

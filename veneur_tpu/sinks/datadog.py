@@ -19,6 +19,7 @@ import urllib.request
 import zlib
 
 from veneur_tpu.core.metrics import COUNTER, InterMetric
+from veneur_tpu.sinks import base as sinks_base
 from veneur_tpu.sinks.base import SinkBase
 
 log = logging.getLogger("veneur_tpu.sinks.datadog")
@@ -29,7 +30,9 @@ class DatadogMetricSink(SinkBase):
 
     def __init__(self, api_key: str, api_hostname: str,
                  interval_seconds: float, hostname: str = "",
-                 flush_max_per_body: int = 25000, timeout: float = 10.0):
+                 flush_max_per_body: int = 25000, timeout: float = 10.0,
+                 metric_name_prefix_drops: tuple[str, ...] = (),
+                 exclude_tags_prefix_by_prefix_metric: list | None = None):
         super().__init__()
         self.api_key = api_key
         self.api_hostname = api_hostname.rstrip("/")
@@ -37,12 +40,26 @@ class DatadogMetricSink(SinkBase):
         self.hostname = hostname
         self.max_per_body = flush_max_per_body
         self.timeout = timeout
+        # drop whole metrics by name prefix (config.go
+        # DatadogMetricNamePrefixDrops)
+        self.name_prefix_drops = tuple(metric_name_prefix_drops)
+        # strip tag PREFIXES from metrics whose name matches a prefix
+        # ([{metric_prefix, tags: [...]}], server.go datadog wiring)
+        self.tag_prefix_rules = [
+            (r.get("metric_prefix", ""), tuple(r.get("tags", ())))
+            for r in (exclude_tags_prefix_by_prefix_metric or ())]
 
     def _series(self, m: InterMetric) -> dict:
+        tags = list(m.tags)
+        for metric_prefix, tag_prefixes in self.tag_prefix_rules:
+            if m.name.startswith(metric_prefix):
+                tags = [t for t in tags
+                        if not any(t.startswith(p)
+                                   for p in tag_prefixes)]
         entry = {
             "metric": m.name,
             "points": [[m.timestamp, m.value]],
-            "tags": list(m.tags),
+            "tags": tags,
             "host": m.hostname or self.hostname,
         }
         if m.type == COUNTER:
@@ -56,6 +73,10 @@ class DatadogMetricSink(SinkBase):
         return entry
 
     def flush(self, metrics: list[InterMetric]) -> None:
+        if self.name_prefix_drops:
+            metrics = [m for m in metrics
+                       if not any(m.name.startswith(p)
+                                  for p in self.name_prefix_drops)]
         if not metrics:
             return
         series = [self._series(m) for m in metrics]
@@ -78,7 +99,7 @@ class DatadogMetricSink(SinkBase):
             # flusher.go:536-549 error handling stance)
             log.warning("datadog flush failed: %s", e)
 
-class DatadogSpanSink:
+class DatadogSpanSink(sinks_base.SpanTagExcluder):
     """Span half of the datadog sink (reference
     sinks/datadog/datadog.go:409 DatadogSpanSink): spans buffer
     between flushes, group by trace id, and PUT to the local trace
@@ -108,7 +129,7 @@ class DatadogSpanSink:
                 self.dropped += 1
 
     def _ddspan(self, span) -> dict:
-        meta = dict(span.tags)
+        meta = self.filter_span_tags(span.tags)
         if self.hostname:
             meta.setdefault("host", self.hostname)
         # the resource tag maps to DD's resource field, not meta

@@ -190,7 +190,9 @@ class KafkaClient:
 
     def produce(self, topic: str, partition: int, batch: bytes,
                 acks: int = 1) -> None:
-        """Produce v3, one partition's record set."""
+        """Produce v3, one partition's record set.  With acks=0 the
+        broker sends NO ProduceResponse (fire-and-forget by
+        protocol), so the request is written without waiting."""
         body = (struct.pack(">h", -1) +  # null transactional id
                 struct.pack(">hi", acks,
                             int(self.timeout * 1000)) +
@@ -198,6 +200,19 @@ class KafkaClient:
                 struct.pack(">i", 1) +
                 struct.pack(">i", partition) +
                 struct.pack(">i", len(batch)) + batch)
+        if acks == 0:
+            self._corr += 1
+            header = struct.pack(">hhi", 0, 3, self._corr) + \
+                _str(self.client_id)
+            msg = header + body
+            with self._lock:
+                sock = self._connect()
+                try:
+                    sock.sendall(struct.pack(">i", len(msg)) + msg)
+                except OSError:
+                    self._sock = None
+                    raise
+            return
         with self._lock:
             resp = self._request(0, 3, body)
         # response: topics[1] -> partitions[1] -> error code
@@ -218,6 +233,54 @@ class KafkaClient:
             self._sock = None
 
 
+# reference config acks values -> kafka wire acks
+ACKS = {"none": 0, "local": 1, "all": -1}
+
+
+def partition_for(key: bytes, n_parts: int, partitioner: str) -> int:
+    """hash: fnv1a over the key (sarama hash partitioner role);
+    random: uniform (kafka_partitioner: random)."""
+    if partitioner == "random":
+        import random as _r
+        return _r.randrange(n_parts)
+    return fnv1a_64_int(key) % n_parts
+
+
+def bound_batches(records: list, max_bytes: int, max_msgs: int):
+    """Split one partition's records into produce batches bounded by
+    kafka_*_buffer_bytes / _messages (0 = one batch per flush; the
+    _frequency knobs are interval-bound here — flushes already happen
+    once per interval, so a time-based producer flush below the
+    interval has nothing to emit)."""
+    if not max_bytes and not max_msgs:
+        yield records
+        return
+    out, size = [], 0
+    for rec in records:
+        rec_size = len(rec[0] or b"") + len(rec[1]) + 32
+        if out and ((max_msgs and len(out) >= max_msgs) or
+                    (max_bytes and size + rec_size > max_bytes)):
+            yield out
+            out, size = [], 0
+        out.append(rec)
+        size += rec_size
+    if out:
+        yield out
+
+
+def produce_with_retry(client, topic: str, part: int, batch: bytes,
+                       acks: int, retry_max: int) -> None:
+    """kafka_retry_max semantics: retry transient produce errors up to
+    N times before dropping-and-counting."""
+    for attempt in range(retry_max + 1):
+        try:
+            client.produce(topic, part, batch, acks=acks)
+            return
+        except OSError:
+            if attempt == retry_max:
+                raise
+
+
 class KafkaMetricSink(SinkBase):
     """InterMetrics as JSON records, keyed and partitioned by metric
     name (reference kafka.go encodeInterMetricJSON + hash
@@ -227,12 +290,22 @@ class KafkaMetricSink(SinkBase):
     def __init__(self, broker: str, check_topic: str = "",
                  event_topic: str = "",
                  metric_topic: str = "veneur_metrics",
-                 client: KafkaClient | None = None):
+                 client: KafkaClient | None = None,
+                 require_acks: str = "all",
+                 partitioner: str = "hash",
+                 retry_max: int = 0,
+                 buffer_bytes: int = 0,
+                 buffer_messages: int = 0):
         super().__init__()
         self.metric_topic = metric_topic
         self.check_topic = check_topic
         self.event_topic = event_topic
         self.client = client or KafkaClient(broker)
+        self.acks = ACKS[require_acks]
+        self.partitioner = partitioner
+        self.retry_max = max(0, int(retry_max))
+        self.buffer_bytes = buffer_bytes
+        self.buffer_messages = buffer_messages
         self.flushed_total = 0
 
     def flush(self, metrics: list[InterMetric]) -> None:
@@ -243,7 +316,8 @@ class KafkaMetricSink(SinkBase):
             groups: dict[int, list] = {}
             ts = 0
             for m in metrics:
-                part = fnv1a_64_int(m.name.encode()) % n_parts
+                part = partition_for(m.name.encode(), n_parts,
+                                     self.partitioner)
                 value = json.dumps({
                     "name": m.name, "timestamp": m.timestamp,
                     "value": m.value, "tags": list(m.tags),
@@ -252,8 +326,12 @@ class KafkaMetricSink(SinkBase):
                     (m.name.encode(), value))
                 ts = max(ts, m.timestamp * 1000)
             for part, records in groups.items():
-                self.client.produce(self.metric_topic, part,
-                                    record_batch(records, ts))
+                for chunk in bound_batches(records, self.buffer_bytes,
+                                           self.buffer_messages):
+                    produce_with_retry(
+                        self.client, self.metric_topic, part,
+                        record_batch(chunk, ts), self.acks,
+                        self.retry_max)
             self.flushed_total += len(metrics)
         except OSError as e:
             log.warning("kafka metric flush failed: %s", e)
@@ -266,18 +344,48 @@ class KafkaSpanSink:
 
     def __init__(self, broker: str, span_topic: str = "veneur_spans",
                  serialization: str = "protobuf",
-                 client: KafkaClient | None = None):
+                 client: KafkaClient | None = None,
+                 require_acks: str = "all",
+                 partitioner: str = "hash",
+                 retry_max: int = 0,
+                 buffer_bytes: int = 0,
+                 buffer_messages: int = 0,
+                 sample_rate_percent: float = 100.0,
+                 sample_tag: str = ""):
         self.span_topic = span_topic
         self.serialization = serialization
         self.client = client or KafkaClient(broker)
+        self.acks = ACKS[require_acks]
+        self.partitioner = partitioner
+        self.retry_max = max(0, int(retry_max))
+        self.buffer_bytes = buffer_bytes
+        self.buffer_messages = buffer_messages
+        # sample on a tag value when configured, else the trace id, so
+        # related spans sample together (kafka_span_sample_tag)
+        self.sample_rate_percent = float(sample_rate_percent)
+        self.sample_tag = sample_tag
         self._buf: list[tuple[bytes | None, bytes]] = []
         self._lock = threading.Lock()
         self.submitted = 0
+        self.sampled_out = 0
 
     def start(self) -> None:
         pass
 
+    def _sampled_in(self, span) -> bool:
+        if self.sample_rate_percent >= 100.0:
+            return True
+        if self.sample_tag and self.sample_tag in span.tags:
+            key = span.tags[self.sample_tag].encode()
+        else:
+            key = str(span.trace_id).encode()
+        return fnv1a_64_int(key) % 10000 < \
+            self.sample_rate_percent * 100
+
     def ingest(self, span) -> None:
+        if not self._sampled_in(span):
+            self.sampled_out += 1
+            return
         if self.serialization == "json":
             from google.protobuf.json_format import MessageToDict
             value = json.dumps(MessageToDict(span)).encode()
@@ -295,13 +403,18 @@ class KafkaSpanSink:
             n_parts = self.client.partitions_for(self.span_topic)
             groups: dict[int, list] = {}
             for key, value in batch:
-                part = fnv1a_64_int(key or b"") % n_parts
+                part = partition_for(key or b"", n_parts,
+                                     self.partitioner)
                 groups.setdefault(part, []).append((key, value))
             import time as _t
             ts = int(_t.time() * 1000)
             for part, records in groups.items():
-                self.client.produce(self.span_topic, part,
-                                    record_batch(records, ts))
+                for chunk in bound_batches(records, self.buffer_bytes,
+                                           self.buffer_messages):
+                    produce_with_retry(
+                        self.client, self.span_topic, part,
+                        record_batch(chunk, ts), self.acks,
+                        self.retry_max)
             self.submitted += len(batch)
         except OSError as e:
             log.warning("kafka span flush failed: %s", e)

@@ -17,24 +17,46 @@ import urllib.request
 log = logging.getLogger("veneur_tpu.sinks")
 
 
-class LightStepSpanSink:
+from veneur_tpu.sinks.base import SpanTagExcluder
+
+
+class LightStepSpanSink(SpanTagExcluder):
     name = "lightstep"
 
     def __init__(self, access_token: str,
                  collector_host: str = "https://collector.lightstep.com",
-                 component_name: str = "veneur"):
+                 component_name: str = "veneur",
+                 maximum_spans: int = 100000,
+                 num_clients: int = 1,
+                 reconnect_period: float = 300.0):
         self.access_token = access_token
         self.collector = collector_host.rstrip("/")
         self.component_name = component_name
+        # buffer cap between flushes (lightstep_maximum_spans); spans
+        # past it are dropped-and-counted like the reference's
+        # bounded tracer buffers
+        self.maximum_spans = max(1, int(maximum_spans))
+        # lightstep_num_clients spreads reports across N parallel
+        # submissions per flush (the reference's client pool)
+        self.num_clients = max(1, int(num_clients))
+        # lightstep_reconnect_period is accepted for config parity;
+        # reports here are connectionless (urllib dials per POST), so
+        # every flush already reconnects and the period is trivially
+        # satisfied
+        self.reconnect_period = float(reconnect_period)
         self._buf: list[dict] = []
         self._lock = threading.Lock()
         self.submitted = 0
+        self.dropped = 0
 
     def start(self) -> None:
         pass
 
     def ingest(self, span) -> None:
         with self._lock:
+            if len(self._buf) >= self.maximum_spans:
+                self.dropped += 1
+                return
             self._buf.append({
                 "span_guid": str(span.id),
                 "trace_guid": str(span.trace_id),
@@ -45,14 +67,11 @@ class LightStepSpanSink:
                 "error_flag": bool(span.error),
                 "attributes": [
                     {"Key": k, "Value": v}
-                    for k, v in span.tags.items()],
+                    for k, v in self.filter_span_tags(
+                        span.tags).items()],
             })
 
-    def flush(self) -> None:
-        with self._lock:
-            batch, self._buf = self._buf, []
-        if not batch:
-            return
+    def _report(self, batch: list[dict]) -> None:
         body = json.dumps({
             "auth": {"access_token": self.access_token},
             "span_records": batch,
@@ -64,6 +83,23 @@ class LightStepSpanSink:
         try:
             with urllib.request.urlopen(req, timeout=10.0) as r:
                 r.read()
-            self.submitted += len(batch)
+            with self._lock:
+                self.submitted += len(batch)
         except OSError as e:
             log.warning("lightstep flush failed: %s", e)
+
+    def flush(self) -> None:
+        with self._lock:
+            batch, self._buf = self._buf, []
+        if not batch:
+            return
+        n = self.num_clients
+        parts = [batch[i::n] for i in range(n)]
+        parts = [p for p in parts if p]
+        if len(parts) == 1:
+            self._report(parts[0])
+            return
+        # the client pool: N genuinely concurrent submissions
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(len(parts)) as pool:
+            list(pool.map(self._report, parts))

@@ -14,6 +14,7 @@ import threading
 import urllib.request
 
 from veneur_tpu.core.metrics import COUNTER, InterMetric
+from veneur_tpu.sinks import base as sinks_base
 from veneur_tpu.sinks.base import SinkBase
 
 log = logging.getLogger("veneur_tpu.sinks")
@@ -33,13 +34,66 @@ class NewRelicMetricSink(SinkBase):
     def __init__(self, insert_key: str,
                  endpoint: str = "https://metric-api.newrelic.com",
                  common_attributes: dict | None = None,
-                 interval: float = 10.0):
+                 interval: float = 10.0,
+                 account_id: int = 0, region: str = "",
+                 event_type: str = "veneur",
+                 service_check_event_type: str = "veneurCheck"):
         super().__init__()
         self.insert_key = insert_key
+        # newrelic_region: eu routes to the EU data centers (the
+        # harvester SDK's region option); explicit endpoints win
+        if region.lower() == "eu" and "newrelic.com" in endpoint and \
+                ".eu." not in endpoint:
+            endpoint = endpoint.replace("metric-api.",
+                                        "metric-api.eu.")
         self.endpoint = endpoint.rstrip("/")
         self.common = dict(common_attributes or {})
         self.interval = interval
+        # events/service checks go to the Insights Event API, which is
+        # account-scoped (newrelic_account_id) with configurable
+        # eventType names
+        self.account_id = int(account_id)
+        self.event_type = event_type
+        self.service_check_event_type = service_check_event_type
+        # the Event API is region-scoped too (EU license keys are
+        # rejected by the US collector)
+        self.events_endpoint = (
+            "https://insights-collector.eu01.nr-data.net"
+            if region.lower() == "eu"
+            else "https://insights-collector.newrelic.com")
         self.flushed_total = 0
+
+    def flush_other_samples(self, samples: list) -> None:
+        """Events + service checks -> the account-scoped Event API
+        (reference newrelic sink's FlushOtherSamples)."""
+        if not samples or self.account_id <= 0:
+            return
+        out = []
+        for s in samples:
+            is_check = hasattr(s, "status")
+            item = {"eventType": (self.service_check_event_type
+                                  if is_check else self.event_type)}
+            item.update(_tags_to_attrs(getattr(s, "tags", ())))
+            item["title"] = getattr(s, "title", "") or \
+                getattr(s, "name", "")
+            if is_check:
+                item["status"] = int(s.status)
+            msg = getattr(s, "message", "") or getattr(s, "text", "")
+            if msg:
+                item["message"] = msg
+            out.append(item)
+        body = gzip.compress(json.dumps(out).encode())
+        req = urllib.request.Request(
+            f"{self.events_endpoint}/v1/accounts/"
+            f"{self.account_id}/events", data=body,
+            headers={"Content-Type": "application/json",
+                     "Content-Encoding": "gzip",
+                     "Api-Key": self.insert_key}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=10.0) as r:
+                r.read()
+        except OSError as e:
+            log.warning("newrelic event flush failed: %s", e)
 
     def flush(self, metrics: list[InterMetric]) -> None:
         if not metrics:
@@ -73,13 +127,21 @@ class NewRelicMetricSink(SinkBase):
             log.warning("newrelic metric flush failed: %s", e)
 
 
-class NewRelicSpanSink:
+class NewRelicSpanSink(sinks_base.SpanTagExcluder):
     name = "newrelic"
 
     def __init__(self, insert_key: str,
                  endpoint: str = "https://trace-api.newrelic.com",
-                 service_name: str = "veneur"):
+                 service_name: str = "veneur",
+                 trace_observer_url: str = "", region: str = ""):
         self.insert_key = insert_key
+        # newrelic_trace_observer_url (Infinite Tracing) overrides the
+        # default Trace API endpoint entirely
+        if trace_observer_url:
+            endpoint = trace_observer_url
+        elif region.lower() == "eu" and "newrelic.com" in endpoint \
+                and ".eu." not in endpoint:
+            endpoint = endpoint.replace("trace-api.", "trace-api.eu.")
         self.endpoint = endpoint.rstrip("/")
         self.service_name = service_name
         self._buf: list[dict] = []
@@ -90,8 +152,9 @@ class NewRelicSpanSink:
         pass
 
     def ingest(self, span) -> None:
-        attrs = _tags_to_attrs(f"{k}:{v}" for k, v in
-                               span.tags.items())
+        attrs = _tags_to_attrs(
+            f"{k}:{v}" for k, v in
+            self.filter_span_tags(span.tags).items())
         attrs.update({
             "service.name": span.service or self.service_name,
             "name": span.name,

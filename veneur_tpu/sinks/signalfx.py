@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import logging
+import threading
 import urllib.request
 
 from veneur_tpu.core.metrics import COUNTER, InterMetric
@@ -26,7 +27,13 @@ class SignalFxSink(SinkBase):
                  endpoint: str = "https://ingest.signalfx.com",
                  vary_key_by: str = "",
                  per_tag_api_keys: dict[str, str] | None = None,
-                 max_per_body: int = 5000, hostname: str = ""):
+                 max_per_body: int = 5000, hostname: str = "",
+                 hostname_tag: str = "host",
+                 metric_name_prefix_drops: tuple[str, ...] = (),
+                 metric_tag_prefix_drops: tuple[str, ...] = (),
+                 dynamic_per_tag_api_keys_enable: bool = False,
+                 dynamic_per_tag_api_keys_refresh_period: float = 600.0,
+                 endpoint_api: str = ""):
         super().__init__()
         self.api_key = api_key
         self.endpoint = endpoint.rstrip("/")
@@ -34,28 +41,88 @@ class SignalFxSink(SinkBase):
         self.per_tag_api_keys = dict(per_tag_api_keys or {})
         self.max_per_body = max_per_body
         self.hostname = hostname
+        self.hostname_tag = hostname_tag or "host"
+        self.name_prefix_drops = tuple(metric_name_prefix_drops)
+        self.tag_prefix_drops = tuple(metric_tag_prefix_drops)
+        # dynamic per-tag token refresh (reference server.go:530-541):
+        # periodically re-fetch the <vary_key_by> -> token map from the
+        # org's API endpoint so new orgs get keys without a restart
+        self.dynamic_keys_enable = dynamic_per_tag_api_keys_enable
+        self.dynamic_refresh_period = float(
+            dynamic_per_tag_api_keys_refresh_period)
+        self.endpoint_api = (endpoint_api or endpoint).rstrip("/")
+        self._keys_lock = threading.Lock()
+        self._refresh_thread: threading.Thread | None = None
+        self._stop = threading.Event()
         self.flushed_total = 0
+
+    def start(self) -> None:
+        if self.dynamic_keys_enable:
+            # the initial fetch runs ON the refresh thread: a slow or
+            # partitioned API endpoint must not block Server.start()
+            # (the watchdog's crash-and-restart path needs startup
+            # fast; keep-last-good covers the gap)
+            self._refresh_thread = threading.Thread(
+                target=self._refresh_loop, daemon=True,
+                name="signalfx-key-refresh")
+            self._refresh_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _refresh_loop(self) -> None:
+        self._refresh_keys()
+        while not self._stop.wait(self.dynamic_refresh_period):
+            self._refresh_keys()
+
+    def _refresh_keys(self) -> None:
+        """Fetch {name -> token} from the API endpoint's token list
+        (the reference walks /v2/token pages); keep-last-good on any
+        error."""
+        try:
+            req = urllib.request.Request(
+                f"{self.endpoint_api}/v2/token",
+                headers={"X-SF-Token": self.api_key,
+                         "Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10.0) as r:
+                doc = json.loads(r.read())
+            fetched = {it["name"]: it["secret"]
+                       for it in doc.get("results", ())
+                       if it.get("name") and it.get("secret")}
+            if fetched:
+                with self._keys_lock:
+                    self.per_tag_api_keys.update(fetched)
+        except Exception as e:
+            log.warning("signalfx dynamic key refresh failed "
+                        "(keeping last good map): %s", e)
 
     def _token_for(self, m: InterMetric) -> str:
         if self.vary_key_by:
-            for t in m.tags:
-                k, _, v = t.partition(":")
-                if k == self.vary_key_by and v in self.per_tag_api_keys:
-                    return self.per_tag_api_keys[v]
+            with self._keys_lock:
+                for t in m.tags:
+                    k, _, v = t.partition(":")
+                    if (k == self.vary_key_by and
+                            v in self.per_tag_api_keys):
+                        return self.per_tag_api_keys[v]
         return self.api_key
 
-    @staticmethod
-    def _datapoint(m: InterMetric) -> dict:
+    def _datapoint(self, m: InterMetric) -> dict:
         dims = {}
         for t in m.tags:
+            if any(t.startswith(p) for p in self.tag_prefix_drops):
+                continue
             k, _, v = t.partition(":")
             dims[k] = v
         if m.hostname:
-            dims.setdefault("host", m.hostname)
+            dims.setdefault(self.hostname_tag, m.hostname)
         return {"metric": m.name, "value": m.value,
                 "timestamp": m.timestamp * 1000, "dimensions": dims}
 
     def flush(self, metrics: list[InterMetric]) -> None:
+        if self.name_prefix_drops:
+            metrics = [m for m in metrics
+                       if not any(m.name.startswith(p)
+                                  for p in self.name_prefix_drops)]
         # group by token so vary-by-tag keys hit their own org
         by_token: dict[str, dict] = {}
         for m in metrics:
