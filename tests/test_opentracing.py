@@ -1,0 +1,126 @@
+"""Opentracing shim parity tests (reference trace/opentracing_test.go
+basics: StartSpan child semantics, header inject/extract across every
+supported HeaderGroup, binary roundtrip, baggage)."""
+
+import io
+
+import pytest
+
+from veneur_tpu.trace import opentracing as ot
+
+
+def test_start_span_root_and_child():
+    tr = ot.Tracer()
+    root = tr.start_span("op.root", service="svc")
+    assert root.inner.trace_id != 0
+    assert root.inner.proto.parent_id == 0
+    child = tr.start_span("op.child", child_of=root)
+    assert child.inner.trace_id == root.inner.trace_id
+    assert child.inner.proto.parent_id == root.inner.span_id
+    assert child.inner.span_id != root.inner.span_id
+
+
+def test_tags_and_name_override():
+    tr = ot.Tracer()
+    s = tr.start_span("x", tags={"name": "renamed", "k": "v"})
+    assert s.inner.proto.name == "renamed"
+    assert s.inner.proto.tags["k"] == "v"
+    s.set_operation_name("again")
+    assert s.inner.proto.name == "again"
+
+
+def test_http_header_inject_uses_envoy_format():
+    """Inject writes the FIRST header group: hex ids + the
+    ot-tracer-sampled outgoing header (opentracing.go:38,557)."""
+    tr = ot.Tracer()
+    s = tr.start_span("op")
+    headers = {}
+    tr.inject_header(s, headers)
+    assert headers["ot-tracer-traceid"] == \
+        format(s.inner.trace_id, "x")
+    assert headers["ot-tracer-spanid"] == format(s.inner.span_id, "x")
+    assert headers["ot-tracer-sampled"] == "true"
+
+
+@pytest.mark.parametrize("trace_hdr,span_hdr,hexfmt", [
+    ("ot-tracer-traceid", "ot-tracer-spanid", True),
+    ("Trace-Id", "Span-Id", False),
+    ("X-Trace-Id", "X-Span-Id", False),
+    ("Traceid", "Spanid", False),
+])
+def test_extract_every_header_group(trace_hdr, span_hdr, hexfmt):
+    tr = ot.Tracer()
+    fmt = (lambda v: format(v, "x")) if hexfmt else str
+    headers = {trace_hdr: fmt(12345), span_hdr: fmt(678)}
+    ctx = tr.extract(ot.FORMAT_HTTP_HEADERS, headers)
+    assert ctx.trace_id == 12345
+    assert ctx.span_id == 678
+
+
+def test_extract_case_insensitive():
+    tr = ot.Tracer()
+    ctx = tr.extract(ot.FORMAT_HTTP_HEADERS,
+                     {"TRACE-ID": "42", "SPAN-ID": "7"})
+    assert (ctx.trace_id, ctx.span_id) == (42, 7)
+
+
+def test_extract_no_ids_raises():
+    tr = ot.Tracer()
+    with pytest.raises(ot.SpanContextCorruptedError):
+        tr.extract(ot.FORMAT_HTTP_HEADERS, {"unrelated": "1"})
+
+
+def test_binary_roundtrip():
+    """Binary carrier is the SSF span protobuf with the resource tag
+    (opentracing.go:536-549,583-610)."""
+    tr = ot.Tracer()
+    s = tr.start_span("op")
+    s.set_tag(ot.RESOURCE_KEY, "GET /thing")
+    buf = io.BytesIO()
+    tr.inject(s.context(), ot.FORMAT_BINARY, buf)
+    buf.seek(0)
+    ctx = tr.extract(ot.FORMAT_BINARY, buf)
+    assert ctx.trace_id == s.inner.trace_id
+    assert ctx.span_id == s.inner.span_id
+    assert ctx.resource == "GET /thing"
+
+
+def test_extract_request_child():
+    tr = ot.Tracer()
+    parent = tr.start_span("parent")
+    headers = {}
+    tr.inject_header(parent, headers)
+    child = tr.extract_request_child("GET /x", headers, "handler")
+    assert child.inner.trace_id == parent.inner.trace_id
+    assert child.inner.proto.parent_id == parent.inner.span_id
+    assert child.inner.proto.tags[ot.RESOURCE_KEY] == "GET /x"
+
+
+def test_baggage():
+    tr = ot.Tracer()
+    s = tr.start_span("op")
+    s.set_baggage_item("tenant", "acme")
+    assert s.baggage_item("tenant") == "acme"
+    seen = {}
+    s.context().foreach_baggage_item(
+        lambda k, v: seen.__setitem__(k, v))
+    assert seen["tenant"] == "acme"
+    assert seen["traceid"] == str(s.inner.trace_id)
+
+
+def test_span_records_through_client():
+    """finish(client) sends the span to a trace client, entering the
+    native pipeline (the ClientFinish contract)."""
+    from veneur_tpu import trace as vtrace
+
+    got = []
+    client = vtrace.Client(vtrace.ChannelBackend(got.append),
+                           capacity=8)
+    tr = ot.Tracer()
+    with tr.start_span("op", service="svc") as s:
+        s.set_tag("k", "v")
+        s.finish(client)
+    client.close()
+    assert len(got) == 1
+    assert got[0].name == "op"
+    assert got[0].tags["k"] == "v"
