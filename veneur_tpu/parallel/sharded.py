@@ -80,14 +80,25 @@ def make_mesh(devices=None, n_shard: int | None = None) -> Mesh:
 
 @dataclass(frozen=True)
 class ShardedConfig:
-    rows: int = 1024          # total table rows per class (global)
+    rows: int = 1024          # histo/timer table rows (global)
     set_rows: int = 64
+    # counter/gauge cardinality can far exceed histo cardinality
+    # (their planes are 1 value/row, digests are ~2*capacity); 0
+    # inherits ``rows``
+    counter_rows: int = 0
+    gauge_rows: int = 0
     compression: float = 100.0
     slots: int = 64           # densify slots per update call
     batch: int = 1024         # per-shard samples per update call
 
     def capacity(self) -> int:
         return tdigest.capacity_for(self.compression)
+
+    def c_rows(self) -> int:
+        return self.counter_rows or self.rows
+
+    def g_rows(self) -> int:
+        return self.gauge_rows or self.rows
 
 
 def _specs(mesh: Mesh):
@@ -106,6 +117,7 @@ def empty_state(mesh: Mesh, cfg: ShardedConfig) -> dict:
     """Allocate the sharded state pytree on the mesh."""
     s = mesh.shape[SHARD]
     r, rs = cfg.rows, cfg.set_rows
+    rc, rg = cfg.c_rows(), cfg.g_rows()
     cap = cfg.capacity()
     specs = _specs(mesh)
 
@@ -116,10 +128,10 @@ def empty_state(mesh: Mesh, cfg: ShardedConfig) -> dict:
     stats[:, :, STAT_MIN] = STAT_MIN_EMPTY
     stats[:, :, STAT_MAX] = STAT_MAX_EMPTY
     return {
-        "counters": dev("counters", np.zeros((s, r), np.float32)),
-        "gauges": dev("gauges", np.zeros((s, r), np.float32)),
+        "counters": dev("counters", np.zeros((s, rc), np.float32)),
+        "gauges": dev("gauges", np.zeros((s, rg), np.float32)),
         "gauge_ticket": dev("gauge_ticket",
-                            np.full((s, r), -1, np.int32)),
+                            np.full((s, rg), -1, np.int32)),
         "histo_stats": dev("histo_stats", stats),
         "histo_means": dev("histo_means",
                            np.zeros((s, r, cap), np.float32)),
@@ -160,8 +172,11 @@ def make_update_step(mesh: Mesh, cfg: ShardedConfig):
     state_specs = _specs(mesh)
     n_series = mesh.shape[SERIES]
     r_local = cfg.rows // n_series
+    rc_local = cfg.c_rows() // n_series
+    rg_local = cfg.g_rows() // n_series
     rs_local = cfg.set_rows // n_series
-    if cfg.rows % n_series or cfg.set_rows % n_series:
+    if (cfg.rows % n_series or cfg.set_rows % n_series or
+            cfg.c_rows() % n_series or cfg.g_rows() % n_series):
         raise ValueError("rows must divide by the series axis size")
 
     def step(state, batch):
@@ -174,7 +189,7 @@ def make_update_step(mesh: Mesh, cfg: ShardedConfig):
         hw = state["histo_weights"][0]
         regs = state["hll"][0]
 
-        crow = _localize(batch["counter_rows"][0], r_local, SERIES)
+        crow = _localize(batch["counter_rows"][0], rc_local, SERIES)
         cnt = cnt.at[crow].add(
             batch["counter_vals"][0] * batch["counter_wts"][0],
             mode="drop")
@@ -182,12 +197,12 @@ def make_update_step(mesh: Mesh, cfg: ShardedConfig):
         # gauge last-write-wins with a global arrival ticket: scatter
         # max of ticket, then adopt the batch value wherever its ticket
         # won (ticket uniqueness is the host's contract)
-        grow = _localize(batch["gauge_rows"][0], r_local, SERIES)
+        grow = _localize(batch["gauge_rows"][0], rg_local, SERIES)
         new_t = gt.at[grow].max(batch["gauge_ticket"][0], mode="drop")
         won = jnp.zeros_like(g).at[grow].max(
             jnp.where(
                 batch["gauge_ticket"][0] ==
-                new_t[jnp.clip(grow, 0, r_local - 1)],
+                new_t[jnp.clip(grow, 0, rg_local - 1)],
                 batch["gauge_vals"][0], -jnp.inf),
             mode="drop")
         changed = new_t > gt
@@ -354,10 +369,16 @@ class ShardedAggregator:
     def step(self) -> None:
         """Push staged samples through SPMD updates.
 
-        Histo samples are chunked by within-row rank on the host so no
-        row exceeds ``cfg.slots`` samples per update call — ``densify``
-        drops beyond the slot width (the same contract the single-chip
-        table honors in ``_histo_device_step``).
+        Host pre-combine first: counters collapse to one (row, total)
+        pair per touched row per shard (addition is associative), so
+        the shipped batch is O(rows) regardless of sample volume —
+        the same trick the single-chip table's dense accumulators
+        play.  Oversized residual batches CHUNK across multiple
+        update calls instead of raising.  Histo samples additionally
+        chunk by within-row rank so no row exceeds ``cfg.slots``
+        samples per call — ``densify`` drops beyond the slot width
+        (the contract the single-chip table honors in
+        ``_histo_device_step``).
         """
         n = self.cfg.batch
         cols = {}
@@ -367,21 +388,35 @@ class ShardedAggregator:
                 col = (np.concatenate([np.asarray(a, dt).ravel()
                                        for a in st[key]])
                        if st[key] else np.zeros(0, dt))
-                if len(col) > n:
-                    raise ValueError(
-                        f"staged {key} overflow: {len(col)} > {n}; call "
-                        "step() more often or raise cfg.batch")
                 planes.append(col)
             cols[key] = planes
         self._stage = [self._empty_stage() for _ in range(self.n_shard)]
 
-        # within-row rank -> chunk id, per shard
-        chunk_of = []
-        n_chunks = 1
-        for rows in cols["histo_rows"]:
-            if len(rows) == 0:
-                chunk_of.append(np.zeros(0, np.int64))
+        # counter pre-combine per shard: bincount over touched rows
+        for si in range(self.n_shard):
+            rows = cols["counter_rows"][si]
+            if len(rows) <= 1:
                 continue
+            totals = np.bincount(
+                rows, weights=cols["counter_vals"][si] *
+                cols["counter_wts"][si], minlength=0)
+            touched = np.nonzero(totals)[0]
+            cols["counter_rows"][si] = touched.astype(np.int32)
+            cols["counter_vals"][si] = totals[touched].astype(
+                np.float32)
+            cols["counter_wts"][si] = np.ones(len(touched), np.float32)
+
+        # per-shard selection lists, one entry per update call:
+        # histo selections group by within-row rank (rank // slots —
+        # densify's drop contract) THEN split to <= batch; the other
+        # classes split positionally to <= batch
+        def _pos_sels(length: int) -> list[np.ndarray]:
+            return [np.arange(off, min(off + n, length))
+                    for off in range(0, length, n)] or []
+
+        def _histo_sels(rows: np.ndarray) -> list[np.ndarray]:
+            if len(rows) == 0:
+                return []
             order = np.argsort(rows, kind="stable")
             srows = rows[order]
             first = np.ones(len(rows), bool)
@@ -390,30 +425,51 @@ class ShardedAggregator:
                 np.where(first, np.arange(len(rows)), 0))
             rank = np.empty(len(rows), np.int64)
             rank[order] = np.arange(len(rows)) - start
-            c = rank // self.cfg.slots
-            chunk_of.append(c)
-            n_chunks = max(n_chunks, int(c.max()) + 1)
+            primary = rank // self.cfg.slots
+            sels = []
+            for ci in range(int(primary.max()) + 1):
+                idx = np.nonzero(primary == ci)[0]
+                for off in range(0, len(idx), n):
+                    sels.append(idx[off:off + n])
+            return sels
 
-        for ci in range(n_chunks):
+        group_of = {"counter_rows": "counter", "counter_vals": "counter",
+                    "counter_wts": "counter", "gauge_rows": "gauge",
+                    "gauge_vals": "gauge", "gauge_ticket": "gauge",
+                    "histo_rows": "histo", "histo_vals": "histo",
+                    "histo_wts": "histo", "set_rows": "set",
+                    "set_idx": "set", "set_rank": "set"}
+        sels: dict[tuple[str, int], list[np.ndarray]] = {}
+        n_calls = 0
+        for si in range(self.n_shard):
+            sels[("histo", si)] = _histo_sels(cols["histo_rows"][si])
+            for grp, key in (("counter", "counter_rows"),
+                             ("gauge", "gauge_rows"),
+                             ("set", "set_rows")):
+                sels[(grp, si)] = _pos_sels(len(cols[key][si]))
+            n_calls = max(n_calls, *(len(sels[(g, si)]) for g in
+                                     ("histo", "counter", "gauge",
+                                      "set")), 0)
+
+        specs = batch_specs()
+        for ci in range(n_calls):
             batch = {}
             for key, dt in self._DTYPES.items():
-                fill = {"counter_rows": self.cfg.rows,
-                        "gauge_rows": self.cfg.rows,
+                fill = {"counter_rows": self.cfg.c_rows(),
+                        "gauge_rows": self.cfg.g_rows(),
                         "histo_rows": self.cfg.rows,
                         "set_rows": self.cfg.set_rows,
                         "gauge_ticket": -1}.get(key, 0)
                 planes = []
                 for si in range(self.n_shard):
-                    col = cols[key][si]
-                    if key.startswith("histo"):
-                        col = col[chunk_of[si] == ci]
-                    elif ci > 0:
-                        col = col[:0]
+                    grp_sels = sels[(group_of[key], si)]
+                    col = (cols[key][si][grp_sels[ci]]
+                           if ci < len(grp_sels) else
+                           cols[key][si][:0])
                     plane = np.full(n, fill, dt)
                     plane[:len(col)] = col
                     planes.append(plane)
                 batch[key] = np.stack(planes)
-            specs = batch_specs()
             jbatch = {k: jax.device_put(
                 jnp.asarray(v), NamedSharding(self.mesh, specs[k]))
                 for k, v in batch.items()}
@@ -425,3 +481,293 @@ class ShardedAggregator:
         out = readout(merged, np.asarray(qs, np.float32))
         merged.update(out)
         return merged
+
+    def swap(self) -> dict:
+        """Interval boundary: push any staged work, merge, and reset
+        the partial state for the next interval (the double-buffer
+        swap the single-chip table does at flush, worker.go:498)."""
+        self.step()
+        merged = self._merge(self.state)
+        self.state = empty_state(self.mesh, self.cfg)
+        return merged
+
+
+class ShardedTable:
+    """MetricTable-compatible facade over a device mesh: the surface
+    ``core.Server``/``Flusher`` drive (ingest / import_* / device_step
+    / swap -> Snapshot), backed by the SPMD sharded planes.  A
+    multi-chip global node runs through the ordinary Server path with
+    this table (config: ``tpu_mesh_shards``); gRPC imports land in
+    host staging here exactly as on the single-chip table, and the
+    flush-time shard merge rides ICI collectives.
+
+    Replaces the reference's importsrv worker fan-in + proxy tier for
+    nodes that share a slice (importsrv/server.go:102, collapsed to
+    collectives)."""
+
+    def __init__(self, mesh: Mesh, cfg: ShardedConfig | None = None):
+        from veneur_tpu.core import table as core_table
+        self.mesh = mesh
+        self.cfg = cfg or ShardedConfig()
+        self.agg = ShardedAggregator(mesh, self.cfg)
+        self.gen = 0
+        self.counter_idx = core_table._ClassIndex(self.cfg.c_rows())
+        self.gauge_idx = core_table._ClassIndex(self.cfg.g_rows())
+        self.histo_idx = core_table._ClassIndex(self.cfg.rows)
+        self.set_idx = core_table._ClassIndex(self.cfg.set_rows)
+        self.status: dict = {}
+        self._staged_n = 0
+        self._rr = 0  # round-robin shard cursor
+
+    # -- ingest (the slow-path Sample surface the Server uses) --------
+
+    def _next_shard(self) -> int:
+        self._rr = (self._rr + 1) % self.agg.n_shard
+        return self._rr
+
+    def ingest(self, s) -> bool:
+        from veneur_tpu.protocol import dogstatsd as dsd
+        from veneur_tpu.utils import hashing
+        key = (s.name, s.type, s.tags, s.scope)
+        weight = 1.0 / s.sample_rate
+        sh = self._next_shard()
+        if s.type == dsd.COUNTER:
+            row = self.counter_idx.lookup(key, s.name, s.tags,
+                                          s.scope, s.type, self.gen)
+            if row is None:
+                return False
+            self.agg.stage(sh, counter_rows=[row],
+                           counter_vals=[s.value],
+                           counter_wts=[weight])
+        elif s.type == dsd.GAUGE:
+            row = self.gauge_idx.lookup(key, s.name, s.tags, s.scope,
+                                        s.type, self.gen)
+            if row is None:
+                return False
+            self.agg.stage(sh, gauge_rows=[row],
+                           gauge_vals=[s.value],
+                           gauge_ticket=self.agg.next_ticket())
+        elif s.type in (dsd.TIMER, dsd.HISTOGRAM):
+            row = self.histo_idx.lookup(key, s.name, s.tags, s.scope,
+                                        s.type, self.gen)
+            if row is None:
+                return False
+            self.agg.stage(sh, histo_rows=[row], histo_vals=[s.value],
+                           histo_wts=[weight])
+        elif s.type == dsd.SET:
+            row = self.set_idx.lookup(key, s.name, s.tags, s.scope,
+                                      s.type, self.gen)
+            if row is None:
+                return False
+            member = (s.value if isinstance(s.value, bytes)
+                      else str(s.value).encode())
+            idx, rank = hashing.hash_members([member])
+            self.agg.stage(sh, set_rows=[row], set_idx=idx,
+                           set_rank=rank)
+        elif s.type == dsd.STATUS:
+            self.status[key] = (float(s.value), s.message, s.tags)
+            return True
+        else:
+            raise ValueError(f"unknown metric type {s.type}")
+        self._staged_n += 1
+        return True
+
+    def ingest_many(self, samples) -> int:
+        dropped = 0
+        for s in samples:
+            if not self.ingest(s):
+                dropped += 1
+        return dropped
+
+    def ingest_columns(self, pb) -> tuple[int, int]:
+        """Columnar parse batches sweep through the per-sample path: a
+        mesh global node's hot ingest is the gRPC import plane, not
+        raw DSD volume, so the single-chip table's vectorized identity
+        index is not replicated here.  Lines the caller handles
+        (events/checks/errors, type codes past CODE_SET) are left to
+        its slow sweep."""
+        from veneur_tpu.protocol import columnar
+        from veneur_tpu.protocol import dogstatsd as dsd
+        processed = dropped = 0
+        fast = np.nonzero(pb.type_code[:pb.n] <=
+                          columnar.CODE_SET)[0]
+        for i in fast:
+            try:
+                parsed = dsd.parse_line(pb.line(int(i)))
+            except dsd.ParseError:
+                dropped += 1
+                continue
+            if self.ingest(parsed):
+                processed += 1
+            else:
+                dropped += 1
+        return processed, dropped
+
+    # -- global-tier imports ------------------------------------------
+
+    def import_counter(self, name, tags, value) -> bool:
+        from veneur_tpu.protocol import dogstatsd as dsd
+        row = self.counter_idx.lookup(
+            (name, dsd.COUNTER, tags, dsd.SCOPE_GLOBAL), name, tags,
+            dsd.SCOPE_GLOBAL, dsd.COUNTER, self.gen)
+        if row is None:
+            return False
+        self.agg.stage(self._next_shard(), counter_rows=[row],
+                       counter_vals=[value], counter_wts=[1.0])
+        self._staged_n += 1
+        return True
+
+    def import_gauge(self, name, tags, value) -> bool:
+        from veneur_tpu.protocol import dogstatsd as dsd
+        row = self.gauge_idx.lookup(
+            (name, dsd.GAUGE, tags, dsd.SCOPE_GLOBAL), name, tags,
+            dsd.SCOPE_GLOBAL, dsd.GAUGE, self.gen)
+        if row is None:
+            return False
+        self.agg.stage(self._next_shard(), gauge_rows=[row],
+                       gauge_vals=[value],
+                       gauge_ticket=self.agg.next_ticket())
+        self._staged_n += 1
+        return True
+
+    def import_histo_row(self, name, mtype, tags, scope=None):
+        from veneur_tpu.protocol import dogstatsd as dsd
+        scope = scope or dsd.SCOPE_DEFAULT
+        return self.histo_idx.lookup((name, mtype, tags, scope), name,
+                                     tags, scope, mtype, self.gen)
+
+    def import_histo(self, name, mtype, tags, stats, means, weights,
+                     scope=None) -> bool:
+        """Forwarded digest: centroids re-enter as weighted samples
+        (a centroid IS a weighted sample; min/max ride separately as
+        two weight-epsilon anchor samples so the merged stats keep the
+        true extremes)."""
+        import numpy as _np
+        from veneur_tpu.ops import segment
+        row = self.import_histo_row(name, mtype, tags, scope)
+        if row is None:
+            return False
+        means = _np.asarray(means, _np.float32)
+        weights = _np.asarray(weights, _np.float32)
+        live = weights > 0
+        sh = self._next_shard()
+        if live.any():
+            self.agg.stage(sh,
+                           histo_rows=_np.full(int(live.sum()), row,
+                                               _np.int32),
+                           histo_vals=means[live],
+                           histo_wts=weights[live])
+        st = _np.asarray(stats, _np.float32)
+        w = float(st[segment.STAT_WEIGHT])
+        if w > 0:
+            # zero-ish-weight anchors carry the forwarded min/max into
+            # the stat plane without perturbing sums
+            eps = _np.float32(1e-6)
+            self.agg.stage(sh,
+                           histo_rows=[row, row],
+                           histo_vals=[float(st[segment.STAT_MIN]),
+                                       float(st[segment.STAT_MAX])],
+                           histo_wts=[eps, eps])
+        self._staged_n += 1
+        return True
+
+    def import_histo_batch(self, rows, stats, cent_rows, cent_means,
+                           cent_weights) -> None:
+        import numpy as _np
+        from veneur_tpu.ops import segment
+        sh = self._next_shard()
+        if len(cent_rows):
+            self.agg.stage(sh, histo_rows=cent_rows,
+                           histo_vals=cent_means,
+                           histo_wts=cent_weights)
+        live = stats[:, segment.STAT_WEIGHT] > 0
+        if live.any():
+            eps = _np.float32(1e-6)
+            r = _np.asarray(rows)[live]
+            self.agg.stage(
+                sh,
+                histo_rows=_np.concatenate([r, r]),
+                histo_vals=_np.concatenate(
+                    [stats[live, segment.STAT_MIN],
+                     stats[live, segment.STAT_MAX]]),
+                histo_wts=_np.full(2 * len(r), eps, _np.float32))
+        self._staged_n += len(rows) + len(cent_rows)
+
+    def import_set(self, name, tags, regs, scope=None) -> bool:
+        """Forwarded HLL plane: registers convert to (idx, rank)
+        positions (a register IS the max rank seen at that index)."""
+        import numpy as _np
+        from veneur_tpu.protocol import dogstatsd as dsd
+        scope = scope or dsd.SCOPE_DEFAULT
+        row = self.set_idx.lookup((name, dsd.SET, tags, scope), name,
+                                  tags, scope, dsd.SET, self.gen)
+        if row is None:
+            return False
+        regs = _np.asarray(regs, _np.uint8)
+        nz = _np.nonzero(regs)[0]
+        if len(nz):
+            self.agg.stage(self._next_shard(),
+                           set_rows=_np.full(len(nz), row, _np.int32),
+                           set_idx=nz.astype(_np.int32),
+                           set_rank=regs[nz].astype(_np.int32))
+        self._staged_n += 1
+        return True
+
+    # -- lifecycle -----------------------------------------------------
+
+    def staged(self) -> int:
+        return self._staged_n
+
+    def device_step(self, final: bool = False) -> None:
+        if final or self._staged_n >= self.cfg.batch:
+            self.agg.step()
+            self._staged_n = 0
+
+    def take_status(self):
+        out = self.status
+        self.status = {}
+        return out
+
+    def swap(self):
+        """Interval boundary -> a core-table Snapshot the Flusher
+        consumes unchanged: merged planes land in the same fields the
+        single-chip table fills, with the merged stat plane serving as
+        the local-stats plane and an identity import plane."""
+        from veneur_tpu.core import table as core_table
+        from veneur_tpu.ops import segment
+        self.device_step(final=True)
+        merged = self.agg.swap()
+        rows, set_rows = self.cfg.rows, self.cfg.set_rows
+        imp = np.zeros((rows, segment.HISTO_STAT_COLS), np.float32)
+        imp[:, segment.STAT_MIN] = segment.STAT_MIN_EMPTY
+        imp[:, segment.STAT_MAX] = segment.STAT_MAX_EMPTY
+        snap = core_table.Snapshot(
+            gen=self.gen,
+            counters=merged["counters"],
+            counter_meta=list(self.counter_idx.meta),
+            counter_touched=self.counter_idx.touched.copy(),
+            gauges=merged["gauges"],
+            gauge_meta=list(self.gauge_idx.meta),
+            gauge_touched=self.gauge_idx.touched.copy(),
+            histo_stats=merged["histo_stats"],
+            histo_import_stats=imp,
+            histo_means=merged["histo_means"],
+            histo_weights=merged["histo_weights"],
+            histo_meta=list(self.histo_idx.meta),
+            histo_touched=self.histo_idx.touched.copy(),
+            hll_regs=merged["hll"],
+            set_meta=list(self.set_idx.meta),
+            set_touched=self.set_idx.touched.copy(),
+            hll_host_plane=None,
+            hll_device_touched=True,
+            overflow={
+                "counter": self.counter_idx.overflow,
+                "gauge": self.gauge_idx.overflow,
+                "histo": self.histo_idx.overflow,
+                "set": self.set_idx.overflow,
+            })
+        self.gen += 1
+        for idx in (self.counter_idx, self.gauge_idx, self.histo_idx,
+                    self.set_idx):
+            idx.reset_interval()
+        return snap
