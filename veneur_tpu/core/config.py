@@ -407,7 +407,8 @@ class Config:
 
 @dataclass
 class ProxyConfig:
-    """veneur-proxy configuration (reference config_proxy.go)."""
+    """veneur-proxy configuration (reference config_proxy.go; the
+    full 23-key surface parses)."""
     debug: bool = False
     http_address: str = ""
     grpc_address: str = ""
@@ -418,9 +419,41 @@ class ProxyConfig:
     consul_url: str = "http://127.0.0.1:8500"
     forward_timeout: float = 10.0
     stats_address: str = ""
+    # SEPARATE destination set for gRPC-forwarded metrics (reference
+    # proxy.go:138,184 ForwardGRPCDestinations); unset falls back to
+    # the main ring
+    grpc_forward_address: str = ""
+    consul_forward_grpc_service_name: str = ""
+    # datadog-format trace proxying: POST /spans bodies hash by trace
+    # id across these destinations (proxy.go:543 ProxyTraces)
+    trace_address: str = ""
+    consul_trace_service_name: str = ""
+    # accepted for config compat; unused even by the reference's
+    # proxy.go (vestigial)
+    trace_api_address: str = ""
+    # the proxy's OWN telemetry as SSF spans to this address
+    # (proxy.go:219-250), with the trace client's buffer knobs
+    ssf_destination_address: str = ""
+    tracing_client_capacity: int = 1024
+    tracing_client_flush_interval: str = "500ms"
+    tracing_client_metrics_interval: str = "1s"
+    # cadence of the proxy's periodic runtime stats (proxy.go:210)
+    runtime_metrics_interval: str = "10s"
+    # Go http.Transport pool tuning: parsed for compat, documented
+    # no-ops (forward connections here are per-request HTTP and
+    # persistent gRPC channels, not a pooled Go transport)
+    idle_connection_timeout: str = ""
+    max_idle_conns: int = 0
+    max_idle_conns_per_host: int = 0
+    # Go pprof profiling flag: no-op (the proxy does no device work)
+    enable_profiling: bool = False
+    sentry_dsn: str = ""
 
     def consul_refresh_interval_seconds(self) -> float:
         return parse_duration(self.consul_refresh_interval)
+
+    def runtime_metrics_interval_seconds(self) -> float:
+        return parse_duration(self.runtime_metrics_interval or "10s")
 
     def validate(self) -> list[str]:
         problems = []

@@ -57,6 +57,55 @@ class ProxyServer:
             log.warning("initial discovery refresh failed; starting "
                         "with an empty ring")
 
+        # SEPARATE gRPC-forward destination set (reference
+        # ForwardGRPCDestinations, proxy.go:138); unset -> main ring
+        self.grpc_ring = None
+        if (config.grpc_forward_address or
+                config.consul_forward_grpc_service_name):
+            if config.consul_forward_grpc_service_name:
+                gdisc = ConsulDiscoverer(config.consul_url)
+                gservice = config.consul_forward_grpc_service_name
+            else:
+                gdisc = StaticDiscoverer(
+                    [a.strip() for a in
+                     config.grpc_forward_address.split(",")
+                     if a.strip()])
+                gservice = "static"
+            self.grpc_ring = DestinationRing(gdisc, gservice)
+            self.grpc_ring.refresh()
+
+        # datadog-format trace destinations (reference
+        # TraceDestinations, proxy.go:543 ProxyTraces)
+        self.trace_ring = None
+        if config.trace_address or config.consul_trace_service_name:
+            if config.consul_trace_service_name:
+                tdisc = ConsulDiscoverer(config.consul_url)
+                tservice = config.consul_trace_service_name
+            else:
+                tdisc = StaticDiscoverer(
+                    [a.strip() for a in
+                     config.trace_address.split(",") if a.strip()])
+                tservice = "static"
+            self.trace_ring = DestinationRing(tdisc, tservice)
+            self.trace_ring.refresh()
+
+        # the proxy's OWN telemetry as SSF spans (proxy.go:219-250):
+        # packet backend for udp/unixgram addresses, framed stream for
+        # tcp, with the reference's buffer knobs
+        self.trace_client = None
+        if config.ssf_destination_address:
+            from veneur_tpu import trace as vtrace
+            addr = config.ssf_destination_address
+            if addr.startswith("tcp://"):
+                backend = vtrace.StreamBackend(addr)
+            else:
+                backend = vtrace.PacketBackend(addr)
+            from veneur_tpu.core.config import parse_duration
+            self.trace_client = vtrace.Client(
+                backend, capacity=config.tracing_client_capacity,
+                flush_interval=parse_duration(
+                    config.tracing_client_flush_interval or "500ms"))
+
         self.grpc_server = None
         self.grpc_port = None
         self._httpd = None
@@ -79,6 +128,12 @@ class ProxyServer:
                              name="discovery-refresh")
         t.start()
         self._threads.append(t)
+        if self.trace_client is not None:
+            t = threading.Thread(target=self._runtime_metrics_loop,
+                                 daemon=True,
+                                 name="proxy-runtime-metrics")
+            t.start()
+            self._threads.append(t)
 
     def _start_grpc(self) -> None:
         import grpc
@@ -125,6 +180,30 @@ class ProxyServer:
                     self.send_error(404)
 
             def do_POST(self):
+                if self.path == "/spans":
+                    # datadog-format trace proxying (reference
+                    # handlers_global.go:47 handleTraceRequest ->
+                    # proxy.go:543 ProxyTraces)
+                    if proxy.trace_ring is None:
+                        self.send_error(404, "trace proxying not "
+                                             "configured")
+                        return
+                    length = int(self.headers.get("Content-Length",
+                                                  0))
+                    try:
+                        traces = json.loads(self.rfile.read(length))
+                        if not isinstance(traces, list):
+                            raise ValueError("body must be an array")
+                        proxy.route_traces(traces)
+                    except (ValueError, KeyError, TypeError,
+                            AttributeError) as e:
+                        proxy.bump("import_errors")
+                        self.send_error(400, str(e))
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
                 if self.path != "/import":
                     self.send_error(404)
                     return
@@ -180,12 +259,15 @@ class ProxyServer:
 
     def route_pb_metrics(self, metrics: list) -> None:
         """Group by destination and forward over gRPC, one task per
-        destination (proxysrv/server.go:286 per-dest goroutines)."""
+        destination (proxysrv/server.go:286 per-dest goroutines).
+        Routes on the dedicated gRPC destination set when configured
+        (grpc_forward_address), else the main ring."""
+        ring = self.grpc_ring or self.ring
         groups: dict[str, list] = defaultdict(list)
         routed = dropped = 0
         for m in metrics:
             try:
-                groups[self.ring.get(self._pb_key(m))].append(m)
+                groups[ring.get(self._pb_key(m))].append(m)
                 routed += 1
             except LookupError:
                 dropped += 1
@@ -249,7 +331,70 @@ class ProxyServer:
             self.bump("forward_errors")
             log.warning("proxy forward to %s failed: %s", dest, e)
 
+    def route_traces(self, traces: list) -> None:
+        """Datadog-format trace spans hash by trace id across the
+        trace destinations and re-PUT to each dest's /v0.3/traces
+        (reference proxy.go:543-566 ProxyTraces)."""
+        groups: dict[str, list] = defaultdict(list)
+        routed = dropped = 0
+        for t in traces:
+            spans = t if isinstance(t, list) else [t]
+            if not spans or not isinstance(spans[0], dict):
+                dropped += 1
+                continue
+            tid = str(spans[0].get("trace_id", 0))
+            try:
+                groups[self.trace_ring.get(tid)].append(spans)
+                routed += 1
+            except LookupError:
+                dropped += 1
+        self.bump("traces_routed", routed)
+        if dropped:
+            self.bump("traces_dropped", dropped)
+        for dest, batch in groups.items():
+            self._pool.submit(self._send_traces, dest, batch)
+
+    def _send_traces(self, dest: str, batch: list) -> None:
+        import urllib.request
+        body = json.dumps(batch).encode()
+        url = dest if dest.startswith("http") else f"http://{dest}"
+        req = urllib.request.Request(
+            url.rstrip("/") + "/v0.3/traces", data=body,
+            headers={"Content-Type": "application/json"},
+            method="PUT")
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.config.forward_timeout) as r:
+                r.read()
+            self.bump("traces_sent")
+        except OSError as e:
+            self.bump("trace_errors")
+            log.warning("proxy trace forward to %s failed: %s",
+                        dest, e)
+
     # ------------------------------------------------------------------
+
+    def _emit_ssf_stats(self) -> None:
+        """The proxy's own runtime metrics as SSF samples through the
+        trace client (proxy.go:210 MetricsInterval reporting)."""
+        if self.trace_client is None:
+            return
+        from veneur_tpu.trace import metrics as tmetrics
+        with self._stats_lock:
+            snap = dict(self.stats)
+        samples = [tmetrics.gauge(f"veneur_proxy.{k}", float(v))
+                   for k, v in snap.items()]
+        samples.append(tmetrics.gauge("veneur_proxy.destinations",
+                                      float(len(self.ring.ring))))
+        tmetrics.report_batch(self.trace_client, samples)
+
+    def _runtime_metrics_loop(self) -> None:
+        interval = self.config.runtime_metrics_interval_seconds()
+        while not self._shutdown.wait(interval):
+            try:
+                self._emit_ssf_stats()
+            except Exception:
+                log.exception("proxy runtime metrics emission failed")
 
     def _emit_stats(self) -> None:
         """Operational metrics to stats_address as DogStatsD deltas
@@ -285,6 +430,9 @@ class ProxyServer:
         interval = self.config.consul_refresh_interval_seconds()
         while not self._shutdown.wait(interval):
             self.ring.refresh()
+            for ring in (self.grpc_ring, self.trace_ring):
+                if ring is not None:
+                    ring.refresh()
             self._emit_stats()
             # drop clients for destinations that left the ring
             with self._clients_lock:
@@ -297,6 +445,8 @@ class ProxyServer:
 
     def shutdown(self) -> None:
         self._shutdown.set()
+        if self.trace_client is not None:
+            self.trace_client.close()
         if self.grpc_server is not None:
             self.grpc_server.stop(0.5)
         if self._httpd is not None:

@@ -3,5 +3,6 @@
 ``sharded`` for the design)."""
 
 from veneur_tpu.parallel.sharded import (  # noqa: F401
-    SHARD, SERIES, ShardedAggregator, ShardedConfig, empty_state,
-    make_merge_step, make_mesh, make_update_step, readout)
+    SHARD, SERIES, ShardedAggregator, ShardedConfig, ShardedTable,
+    empty_state, make_merge_step, make_mesh, make_update_step,
+    readout)

@@ -150,6 +150,7 @@ def batch_specs():
         "counter_rows", "counter_vals", "counter_wts",
         "gauge_rows", "gauge_vals", "gauge_ticket",
         "histo_rows", "histo_vals", "histo_wts",
+        "rsum_rows", "rsum_vals",
         "set_rows", "set_idx", "set_rank")}
 
 
@@ -228,6 +229,13 @@ def make_update_step(mesh: Mesh, cfg: ShardedConfig):
             hs[:, STAT_RSUM].at[hrow].add(incoming[:, STAT_RSUM],
                                           mode="drop"),
         ], axis=1)
+
+        # forwarded-digest reciprocal-sum corrections land directly
+        # in the RSUM column (centroid means alone misstate it; the
+        # import path stages the exact delta)
+        rrow = _localize(batch["rsum_rows"][0], r_local, SERIES)
+        hs = hs.at[rrow, STAT_RSUM].add(batch["rsum_vals"][0],
+                                        mode="drop")
 
         dense_v, dense_w = tdigest.densify(hrow, hv, hwt, r_local,
                                            cfg.slots)
@@ -347,6 +355,7 @@ class ShardedAggregator:
             "counter_rows", "counter_vals", "counter_wts",
             "gauge_rows", "gauge_vals", "gauge_ticket",
             "histo_rows", "histo_vals", "histo_wts",
+            "rsum_rows", "rsum_vals",
             "set_rows", "set_idx", "set_rank")}
 
     def next_ticket(self, n: int = 1) -> np.ndarray:
@@ -363,7 +372,9 @@ class ShardedAggregator:
                "counter_wts": np.float32, "gauge_rows": np.int32,
                "gauge_vals": np.float32, "gauge_ticket": np.int32,
                "histo_rows": np.int32, "histo_vals": np.float32,
-               "histo_wts": np.float32, "set_rows": np.int32,
+               "histo_wts": np.float32,
+               "rsum_rows": np.int32, "rsum_vals": np.float32,
+               "set_rows": np.int32,
                "set_idx": np.int32, "set_rank": np.int32}
 
     def step(self) -> None:
@@ -437,7 +448,9 @@ class ShardedAggregator:
                     "counter_wts": "counter", "gauge_rows": "gauge",
                     "gauge_vals": "gauge", "gauge_ticket": "gauge",
                     "histo_rows": "histo", "histo_vals": "histo",
-                    "histo_wts": "histo", "set_rows": "set",
+                    "histo_wts": "histo",
+                    "rsum_rows": "rsum", "rsum_vals": "rsum",
+                    "set_rows": "set",
                     "set_idx": "set", "set_rank": "set"}
         sels: dict[tuple[str, int], list[np.ndarray]] = {}
         n_calls = 0
@@ -445,11 +458,12 @@ class ShardedAggregator:
             sels[("histo", si)] = _histo_sels(cols["histo_rows"][si])
             for grp, key in (("counter", "counter_rows"),
                              ("gauge", "gauge_rows"),
+                             ("rsum", "rsum_rows"),
                              ("set", "set_rows")):
                 sels[(grp, si)] = _pos_sels(len(cols[key][si]))
             n_calls = max(n_calls, *(len(sels[(g, si)]) for g in
                                      ("histo", "counter", "gauge",
-                                      "set")), 0)
+                                      "rsum", "set")), 0)
 
         specs = batch_specs()
         for ci in range(n_calls):
@@ -458,6 +472,7 @@ class ShardedAggregator:
                 fill = {"counter_rows": self.cfg.c_rows(),
                         "gauge_rows": self.cfg.g_rows(),
                         "histo_rows": self.cfg.rows,
+                        "rsum_rows": self.cfg.rows,
                         "set_rows": self.cfg.set_rows,
                         "gauge_ticket": -1}.get(key, 0)
                 planes = []
@@ -641,34 +656,57 @@ class ShardedTable:
         """Forwarded digest: centroids re-enter as weighted samples
         (a centroid IS a weighted sample; min/max ride separately as
         two weight-epsilon anchor samples so the merged stats keep the
-        true extremes)."""
+        true extremes, and the reciprocal-sum delta lands in a direct
+        RSUM correction — centroid means alone misstate it)."""
         import numpy as _np
         from veneur_tpu.ops import segment
+        # shapes validated BEFORE anything stages, matching the
+        # single-chip contract (table.py import_histo): a malformed
+        # item must not leave half its state staged
+        stats = _np.asarray(stats, _np.float32)
+        means = _np.asarray(means, _np.float32)
+        weights = _np.asarray(weights, _np.float32)
+        if stats.shape != (segment.HISTO_STAT_COLS,):
+            raise ValueError(f"bad stats shape {stats.shape}")
+        if means.shape != weights.shape or means.ndim != 1:
+            raise ValueError(
+                f"centroid shape mismatch {means.shape}/"
+                f"{weights.shape}")
         row = self.import_histo_row(name, mtype, tags, scope)
         if row is None:
             return False
-        means = _np.asarray(means, _np.float32)
-        weights = _np.asarray(weights, _np.float32)
         live = weights > 0
+        n_live = int(live.sum())
         sh = self._next_shard()
-        if live.any():
+        eps = _np.float32(1e-6)
+        rsum_from_samples = 0.0
+        if n_live:
             self.agg.stage(sh,
-                           histo_rows=_np.full(int(live.sum()), row,
-                                               _np.int32),
+                           histo_rows=_np.full(n_live, row, _np.int32),
                            histo_vals=means[live],
                            histo_wts=weights[live])
-        st = _np.asarray(stats, _np.float32)
-        w = float(st[segment.STAT_WEIGHT])
+            nz = live & (means != 0)
+            rsum_from_samples = float(
+                (weights[nz] / means[nz]).sum())
+        w = float(stats[segment.STAT_WEIGHT])
         if w > 0:
             # zero-ish-weight anchors carry the forwarded min/max into
             # the stat plane without perturbing sums
-            eps = _np.float32(1e-6)
-            self.agg.stage(sh,
-                           histo_rows=[row, row],
-                           histo_vals=[float(st[segment.STAT_MIN]),
-                                       float(st[segment.STAT_MAX])],
-                           histo_wts=[eps, eps])
-        self._staged_n += 1
+            mn = float(stats[segment.STAT_MIN])
+            mx = float(stats[segment.STAT_MAX])
+            self.agg.stage(sh, histo_rows=[row, row],
+                           histo_vals=[mn, mx], histo_wts=[eps, eps])
+            if mn != 0:
+                rsum_from_samples += float(eps) / mn
+            if mx != 0:
+                rsum_from_samples += float(eps) / mx
+        # exact forwarded rsum minus what the staged samples will add
+        corr = float(stats[segment.STAT_RSUM]) - rsum_from_samples
+        if corr:
+            self.agg.stage(sh, rsum_rows=[row], rsum_vals=[corr])
+        # count every staged centroid: the staging-memory bound that
+        # triggers device_step rides on this counter (table.py:694)
+        self._staged_n += n_live + 2
         return True
 
     def import_histo_batch(self, rows, stats, cent_rows, cent_means,
@@ -698,19 +736,21 @@ class ShardedTable:
         positions (a register IS the max rank seen at that index)."""
         import numpy as _np
         from veneur_tpu.protocol import dogstatsd as dsd
+        regs = _np.asarray(regs, _np.uint8)
+        if regs.shape != (hll_ops.M,):
+            raise ValueError(f"bad register plane shape {regs.shape}")
         scope = scope or dsd.SCOPE_DEFAULT
         row = self.set_idx.lookup((name, dsd.SET, tags, scope), name,
                                   tags, scope, dsd.SET, self.gen)
         if row is None:
             return False
-        regs = _np.asarray(regs, _np.uint8)
         nz = _np.nonzero(regs)[0]
         if len(nz):
             self.agg.stage(self._next_shard(),
                            set_rows=_np.full(len(nz), row, _np.int32),
                            set_idx=nz.astype(_np.int32),
                            set_rank=regs[nz].astype(_np.int32))
-        self._staged_n += 1
+        self._staged_n += max(1, len(nz))
         return True
 
     # -- lifecycle -----------------------------------------------------

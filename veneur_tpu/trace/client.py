@@ -70,12 +70,14 @@ class PacketBackend:
         if scheme == "udp":
             self._target = (host, port)
             self._family = socket.AF_INET
-        elif scheme == "unix":
+        elif scheme in ("unix", "unixgram"):
+            # the reference's documented datagram form is unixgram://
             self._target = path
             self._family = socket.AF_UNIX
         else:
             raise ValueError(
-                f"packet backend needs udp:// or unix://, got {address}")
+                f"packet backend needs udp://, unix:// or "
+                f"unixgram://, got {address}")
         self._sock: socket.socket | None = None
 
     def send(self, span) -> None:
