@@ -310,8 +310,9 @@ def test_proxy_separate_grpc_ring():
 
 
 def test_proxy_trace_routing(tmp_path):
-    """POST /spans bodies hash by trace id and re-PUT to the trace
-    destinations' /v0.3/traces (proxy.go:543 ProxyTraces)."""
+    """POST /spans bodies hash by trace id and re-POST flat span
+    arrays to the trace destinations' /spans — the reference's exact
+    wire (proxy.go:543-567 ProxyTraces)."""
     import http.server
     import threading
     import urllib.request
@@ -322,7 +323,7 @@ def test_proxy_trace_routing(tmp_path):
         def log_message(self, *a):
             pass
 
-        def do_PUT(self):
+        def do_POST(self):
             n = int(self.headers.get("Content-Length", 0))
             got.append((self.path, json.loads(self.rfile.read(n))))
             self.send_response(200)
@@ -352,10 +353,12 @@ def test_proxy_trace_routing(tmp_path):
         deadline = time.monotonic() + 5
         while not got and time.monotonic() < deadline:
             time.sleep(0.02)
-        assert got and got[0][0] == "/v0.3/traces"
-        delivered = [s[0]["trace_id"] for batch in
-                     (g[1] for g in got) for s in batch]
+        assert got and got[0][0] == "/spans"
+        # flat span arrays (no per-trace nesting on the wire)
+        delivered = [sp["trace_id"] for _, batch in got
+                     for sp in batch]
         assert sorted(delivered) == [7, 9]
+        assert all(isinstance(sp, dict) for _, b in got for sp in b)
     finally:
         p.shutdown()
         httpd.shutdown()
@@ -387,3 +390,16 @@ def test_proxy_ssf_self_telemetry(tmp_path):
     finally:
         p.shutdown()
         sock.close()
+
+
+def test_proxy_trace_only_config_starts():
+    """A trace-only proxy (no forward_address) is reference-valid
+    (AcceptingForwards=false, proxy.go:131-139)."""
+    from veneur_tpu.core.proxy import ProxyServer
+
+    p = ProxyServer(ProxyConfig(trace_address="t:8126"))
+    assert p.trace_ring is not None
+    # metric routing drops-and-counts on the empty main ring
+    p.route_json_items([{"name": "x", "type": "counter",
+                         "tags": [], "value": 1.0}])
+    assert p.stats["metrics_dropped"] == 1

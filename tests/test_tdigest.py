@@ -261,3 +261,36 @@ def test_reference_interpolation_mode_preserved():
         means, wts, qs, mins, maxs))[0, 0])
     assert interp == pytest.approx(
         float(np.quantile(np.array([10.0, 20.0]), 0.5)))
+
+
+def test_dfcumsum_merge_mode_matches_scatter(monkeypatch):
+    """VENEUR_TPU_MERGE=dfcumsum (scatter-free per-cluster sums via
+    compensated cumulative sums) must produce the SAME merged planes
+    as the scatter path — including at large accumulated weights,
+    where a plain f32 cumsum-diff loses tail clusters."""
+    def build(mode):
+        monkeypatch.setattr(tdigest, "_MERGE_MODE", mode)
+        # fresh jit cache per mode: _merge_impl branches on the
+        # module flag at trace time
+        impl = jax.jit(tdigest._merge_impl,
+                       static_argnames=("compression",))
+        rng = np.random.default_rng(5)
+        R, per = 64, 4096
+        data = [(rng.pareto(3.0, per) * 100 + 1).astype(np.float32)
+                for _ in range(R)]
+        means, wts = tdigest.empty_state(R)
+        k = per // 8
+        for i in range(8):
+            dense = np.stack([d[i * k:(i + 1) * k] for d in data])
+            dw = np.full_like(dense, 1000.0)
+            means, wts = impl(means, wts, jnp.asarray(dense),
+                              jnp.asarray(dw), compression=100.0)
+        return np.asarray(means), np.asarray(wts)
+
+    import jax
+    m1, w1 = build("scatter")
+    m2, w2 = build("dfcumsum")
+    np.testing.assert_allclose(w1, w2, rtol=1e-6, atol=1e-3)
+    np.testing.assert_allclose(m1, m2, rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(w2.sum(axis=1), 4096 * 1000.0,
+                               rtol=1e-6)

@@ -457,10 +457,19 @@ class ProxyConfig:
 
     def validate(self) -> list[str]:
         problems = []
+        # any ONE routing surface suffices (the reference runs
+        # trace-only or grpc-only proxies with AcceptingForwards
+        # false, proxy.go:131-139)
         if not (self.forward_address or
-                self.consul_forward_service_name):
-            problems.append("proxy needs forward_address or "
-                            "consul_forward_service_name")
+                self.consul_forward_service_name or
+                self.grpc_forward_address or
+                self.consul_forward_grpc_service_name or
+                self.trace_address or
+                self.consul_trace_service_name):
+            problems.append(
+                "proxy needs at least one destination surface: "
+                "forward_address / grpc_forward_address / "
+                "trace_address (or their consul service names)")
         try:
             if self.consul_refresh_interval_seconds() <= 0:
                 problems.append(

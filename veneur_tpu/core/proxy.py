@@ -42,52 +42,47 @@ class ProxyServer:
         problems = config.validate()
         if problems:
             raise ValueError("; ".join(problems))
-        if config.consul_forward_service_name:
-            disc = ConsulDiscoverer(config.consul_url)
-            service = config.consul_forward_service_name
-        else:
-            disc = StaticDiscoverer(
-                [a.strip() for a in
-                 config.forward_address.split(",") if a.strip()])
-            service = "static"
         if config.debug:
             logging.getLogger("veneur_tpu").setLevel(logging.DEBUG)
-        self.ring = DestinationRing(disc, service)
-        if not self.ring.refresh():
-            log.warning("initial discovery refresh failed; starting "
-                        "with an empty ring")
 
+        def _make_ring(static_addrs: str, consul_service: str,
+                       required: bool = False):
+            """One discovery ring from a static list XOR a consul
+            service; None when neither is configured (and not
+            required)."""
+            if not static_addrs and not consul_service and \
+                    not required:
+                return None
+            if consul_service:
+                disc = ConsulDiscoverer(config.consul_url)
+                service = consul_service
+            else:
+                disc = StaticDiscoverer(
+                    [a.strip() for a in static_addrs.split(",")
+                     if a.strip()])
+                service = "static"
+            ring = DestinationRing(disc, service)
+            if not ring.refresh():
+                log.warning("initial discovery refresh failed for "
+                            "%s; starting with an empty ring",
+                            service)
+            return ring
+
+        # main (HTTP /import) destination set; a trace-only or
+        # grpc-only proxy legally leaves it empty (reference
+        # AcceptingForwards=false, proxy.go:131-139)
+        self.ring = _make_ring(config.forward_address,
+                               config.consul_forward_service_name,
+                               required=True)
         # SEPARATE gRPC-forward destination set (reference
         # ForwardGRPCDestinations, proxy.go:138); unset -> main ring
-        self.grpc_ring = None
-        if (config.grpc_forward_address or
-                config.consul_forward_grpc_service_name):
-            if config.consul_forward_grpc_service_name:
-                gdisc = ConsulDiscoverer(config.consul_url)
-                gservice = config.consul_forward_grpc_service_name
-            else:
-                gdisc = StaticDiscoverer(
-                    [a.strip() for a in
-                     config.grpc_forward_address.split(",")
-                     if a.strip()])
-                gservice = "static"
-            self.grpc_ring = DestinationRing(gdisc, gservice)
-            self.grpc_ring.refresh()
-
+        self.grpc_ring = _make_ring(
+            config.grpc_forward_address,
+            config.consul_forward_grpc_service_name)
         # datadog-format trace destinations (reference
         # TraceDestinations, proxy.go:543 ProxyTraces)
-        self.trace_ring = None
-        if config.trace_address or config.consul_trace_service_name:
-            if config.consul_trace_service_name:
-                tdisc = ConsulDiscoverer(config.consul_url)
-                tservice = config.consul_trace_service_name
-            else:
-                tdisc = StaticDiscoverer(
-                    [a.strip() for a in
-                     config.trace_address.split(",") if a.strip()])
-                tservice = "static"
-            self.trace_ring = DestinationRing(tdisc, tservice)
-            self.trace_ring.refresh()
+        self.trace_ring = _make_ring(config.trace_address,
+                                     config.consul_trace_service_name)
 
         # the proxy's OWN telemetry as SSF spans (proxy.go:219-250):
         # packet backend for udp/unixgram addresses, framed stream for
@@ -332,22 +327,26 @@ class ProxyServer:
             log.warning("proxy forward to %s failed: %s", dest, e)
 
     def route_traces(self, traces: list) -> None:
-        """Datadog-format trace spans hash by trace id across the
-        trace destinations and re-PUT to each dest's /v0.3/traces
-        (reference proxy.go:543-566 ProxyTraces)."""
+        """Datadog-format trace spans hash INDIVIDUALLY by trace id
+        across the trace destinations and re-POST as flat span arrays
+        to each dest's /spans — the reference's exact wire
+        (proxy.go:543-567 ProxyTraces; the endpoint takes a flat
+        []DatadogTraceSpan and no deflate).  Nested span lists are
+        flattened for callers that batch per trace."""
         groups: dict[str, list] = defaultdict(list)
         routed = dropped = 0
         for t in traces:
             spans = t if isinstance(t, list) else [t]
-            if not spans or not isinstance(spans[0], dict):
-                dropped += 1
-                continue
-            tid = str(spans[0].get("trace_id", 0))
-            try:
-                groups[self.trace_ring.get(tid)].append(spans)
-                routed += 1
-            except LookupError:
-                dropped += 1
+            for sp in spans:
+                if not isinstance(sp, dict):
+                    dropped += 1
+                    continue
+                tid = str(sp.get("trace_id", 0))
+                try:
+                    groups[self.trace_ring.get(tid)].append(sp)
+                    routed += 1
+                except LookupError:
+                    dropped += 1
         self.bump("traces_routed", routed)
         if dropped:
             self.bump("traces_dropped", dropped)
@@ -359,9 +358,9 @@ class ProxyServer:
         body = json.dumps(batch).encode()
         url = dest if dest.startswith("http") else f"http://{dest}"
         req = urllib.request.Request(
-            url.rstrip("/") + "/v0.3/traces", data=body,
+            url.rstrip("/") + "/spans", data=body,
             headers={"Content-Type": "application/json"},
-            method="PUT")
+            method="POST")
         try:
             with urllib.request.urlopen(
                     req, timeout=self.config.forward_timeout) as r:
@@ -389,10 +388,35 @@ class ProxyServer:
         tmetrics.report_batch(self.trace_client, samples)
 
     def _runtime_metrics_loop(self) -> None:
+        from veneur_tpu.core.config import parse_duration
+        from veneur_tpu.trace import metrics as tmetrics
         interval = self.config.runtime_metrics_interval_seconds()
-        while not self._shutdown.wait(interval):
+        client_iv = parse_duration(
+            self.config.tracing_client_metrics_interval or "1s")
+        tick = min(interval, client_iv)
+        next_runtime = next_client = 0.0
+        while not self._shutdown.wait(tick):
+            now = time.monotonic()
             try:
-                self._emit_ssf_stats()
+                if now >= next_runtime:
+                    next_runtime = now + interval
+                    self._emit_ssf_stats()
+                if now >= next_client and self.trace_client is not None:
+                    # the trace CLIENT's own backpressure counters at
+                    # their configured cadence (the reference's
+                    # tracing_client_metrics_interval)
+                    next_client = now + client_iv
+                    c = self.trace_client
+                    tmetrics.report_batch(c, [
+                        tmetrics.gauge(
+                            "veneur_proxy.trace_client.records_sent",
+                            float(c.sent)),
+                        tmetrics.gauge(
+                            "veneur_proxy.trace_client."
+                            "records_dropped", float(c.dropped)),
+                        tmetrics.gauge(
+                            "veneur_proxy.trace_client.errors",
+                            float(c.errors))])
             except Exception:
                 log.exception("proxy runtime metrics emission failed")
 
@@ -434,9 +458,11 @@ class ProxyServer:
                 if ring is not None:
                     ring.refresh()
             self._emit_stats()
-            # drop clients for destinations that left the ring
+            # drop clients for destinations that left the ring the
+            # gRPC forwarders actually route on
+            grpc_members = (self.grpc_ring or self.ring).ring.members
             with self._clients_lock:
-                gone = set(self._clients) - set(self.ring.ring.members)
+                gone = set(self._clients) - set(grpc_members)
                 for dest in gone:
                     try:
                         self._clients.pop(dest).close()

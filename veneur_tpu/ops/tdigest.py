@@ -40,6 +40,7 @@ gather of centroid planes followed by one merge step.
 from __future__ import annotations
 
 import math
+import os
 from functools import partial
 
 import jax
@@ -48,6 +49,18 @@ import jax.numpy as jnp
 from veneur_tpu.utils import jitopts
 
 Array = jax.Array
+
+# Cluster-reduction strategy for the merge kernel.  "scatter"
+# (default): per-cluster sums via scatter-add — exact, but the
+# 18M-element scatter was measured at ~60% of the merge on a v5e
+# (round-2 profile).  "dfcumsum": double-float (two-f32 compensated)
+# cumulative sums + sorted-boundary gather — no scatter at all, and
+# the compensation keeps per-cluster sums exact-in-practice (~2^-48
+# relative; a plain f32 cumsum-diff was measured to corrupt p999 by
+# perturbing tail cluster contents).  CPUs prefer scatter (cheap
+# scatter-add, costly multi-op scan); set VENEUR_TPU_MERGE=dfcumsum to
+# A/B on accelerator hardware.
+_MERGE_MODE = os.environ.get("VENEUR_TPU_MERGE", "scatter")
 
 DEFAULT_COMPRESSION = 100.0
 # Plane capacity for the default compression (see module docstring);
@@ -126,6 +139,61 @@ def k_scale_np(q: "np.ndarray | float", compression: float):
     return body + np.maximum(tail, 0.0)
 
 
+def _two_sum(a: Array, b: Array) -> tuple[Array, Array]:
+    """Error-free transform: a+b = s+err exactly (Knuth two-sum)."""
+    s = a + b
+    bb = s - a
+    err = (a - (s - bb)) + (b - bb)
+    return s, err
+
+
+def _df_add(x, y):
+    """Double-float addition: (hi, lo) pairs carrying ~2^-48 relative
+    precision in pure f32 — associative_scan's combine op."""
+    s, e = _two_sum(x[0], y[0])
+    e = e + (x[1] + y[1])
+    hi = s + e
+    lo = e - (hi - s)
+    return (hi, lo)
+
+
+def _df_take(df, pos, valid):
+    hi = jnp.where(valid, jnp.take_along_axis(df[0], pos, axis=1), 0.0)
+    lo = jnp.where(valid, jnp.take_along_axis(df[1], pos, axis=1), 0.0)
+    return hi, lo
+
+
+def _df_diff(a, b) -> Array:
+    """Compensated a-b of double-floats -> f32 (the boundary diff is
+    where a plain f32 cumsum loses the tail clusters)."""
+    s, e = _two_sum(a[0], -b[0])
+    return s + (e + (a[1] - b[1]))
+
+
+def _seg_sums_dfcumsum(m: Array, w: Array, cluster: Array,
+                       cap: int) -> tuple[Array, Array]:
+    """Per-cluster (w*m, w) sums WITHOUT a scatter: compensated
+    cumulative sums along the sorted axis + a searchsorted boundary
+    gather per cluster slot (cluster ids are non-decreasing per row
+    after the sort by mean)."""
+    zeros = jnp.zeros_like(w)
+    cw = jax.lax.associative_scan(_df_add, (w, zeros), axis=1)
+    cwm = jax.lax.associative_scan(_df_add, (w * m, zeros), axis=1)
+    cs = jnp.arange(cap, dtype=cluster.dtype)
+    pos = jax.vmap(
+        lambda cl: jnp.searchsorted(cl, cs, side="right"))(cluster) - 1
+    posc = jnp.maximum(pos, 0)
+    valid = pos >= 0
+    W_at = _df_take(cw, posc, valid)
+    WM_at = _df_take(cwm, posc, valid)
+    zcol = jnp.zeros((m.shape[0], 1), jnp.float32)
+    W_prev = (jnp.concatenate([zcol, W_at[0][:, :-1]], axis=1),
+              jnp.concatenate([zcol, W_at[1][:, :-1]], axis=1))
+    WM_prev = (jnp.concatenate([zcol, WM_at[0][:, :-1]], axis=1),
+               jnp.concatenate([zcol, WM_at[1][:, :-1]], axis=1))
+    return _df_diff(WM_at, WM_prev), _df_diff(W_at, W_prev)
+
+
 def _merge_impl(means: Array, weights: Array, new_means: Array,
                 new_weights: Array, compression: float
                 ) -> tuple[Array, Array]:
@@ -157,16 +225,18 @@ def _merge_impl(means: Array, weights: Array, new_means: Array,
          _k_scale(jnp.float32(0.0), delta, compression))
     cluster = jnp.clip(jnp.floor(k).astype(jnp.int32), 0, cap - 1)
 
-    rows = jnp.arange(num_rows, dtype=jnp.int32)[:, None]
-    flat = (rows * cap + cluster).ravel()
-    out_w = jnp.zeros((num_rows * cap,), jnp.float32).at[flat].add(
-        w.ravel())
-    out_wm = jnp.zeros((num_rows * cap,), jnp.float32).at[flat].add(
-        (w * m).ravel())
-    out_w = out_w.reshape(num_rows, cap)
+    if _MERGE_MODE == "dfcumsum":
+        out_wm, out_w = _seg_sums_dfcumsum(m, w, cluster, cap)
+    else:
+        rows = jnp.arange(num_rows, dtype=jnp.int32)[:, None]
+        flat = (rows * cap + cluster).ravel()
+        out_w = jnp.zeros((num_rows * cap,), jnp.float32).at[flat].add(
+            w.ravel()).reshape(num_rows, cap)
+        out_wm = jnp.zeros((num_rows * cap,),
+                           jnp.float32).at[flat].add(
+            (w * m).ravel()).reshape(num_rows, cap)
     out_m = jnp.where(out_w > 0,
-                      out_wm.reshape(num_rows, cap) /
-                      jnp.maximum(out_w, _EPS), 0.0)
+                      out_wm / jnp.maximum(out_w, _EPS), 0.0)
 
     # Re-pack so occupied slots are contiguous and mean-sorted (cluster
     # ids are monotone in mean, but sparse rows leave embedded gaps).

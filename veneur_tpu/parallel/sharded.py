@@ -704,32 +704,64 @@ class ShardedTable:
         corr = float(stats[segment.STAT_RSUM]) - rsum_from_samples
         if corr:
             self.agg.stage(sh, rsum_rows=[row], rsum_vals=[corr])
-        # count every staged centroid: the staging-memory bound that
-        # triggers device_step rides on this counter (table.py:694)
-        self._staged_n += n_live + 2
+        # count every ACTUALLY staged item: the staging-memory bound
+        # that triggers device_step rides on this counter (table.py:694)
+        self._staged_n += (n_live + (2 if w > 0 else 0) +
+                           (1 if corr else 0))
         return True
 
     def import_histo_batch(self, rows, stats, cent_rows, cent_means,
                            cent_weights) -> None:
+        """Columnar sibling of import_histo with the SAME fidelity:
+        min/max eps anchors and an exact per-row RSUM correction (the
+        gRPC import fast path must not diverge from the scalar
+        path)."""
         import numpy as _np
         from veneur_tpu.ops import segment
+        rows = _np.ascontiguousarray(rows, _np.int64)
         sh = self._next_shard()
+        n_staged = 0
+        nrows = self.cfg.rows
+        # per-row rsum contribution of the staged centroids
+        rsum_samples = _np.zeros(nrows, _np.float64)
         if len(cent_rows):
             self.agg.stage(sh, histo_rows=cent_rows,
                            histo_vals=cent_means,
                            histo_wts=cent_weights)
+            n_staged += len(cent_rows)
+            cr = _np.ascontiguousarray(cent_rows, _np.int64)
+            nz = cent_means != 0
+            rsum_samples += _np.bincount(
+                cr[nz], weights=cent_weights[nz] / cent_means[nz],
+                minlength=nrows)[:nrows]
         live = stats[:, segment.STAT_WEIGHT] > 0
         if live.any():
             eps = _np.float32(1e-6)
-            r = _np.asarray(rows)[live]
+            r = rows[live]
+            mns = stats[live, segment.STAT_MIN]
+            mxs = stats[live, segment.STAT_MAX]
             self.agg.stage(
                 sh,
-                histo_rows=_np.concatenate([r, r]),
-                histo_vals=_np.concatenate(
-                    [stats[live, segment.STAT_MIN],
-                     stats[live, segment.STAT_MAX]]),
+                histo_rows=_np.concatenate([r, r]).astype(_np.int32),
+                histo_vals=_np.concatenate([mns, mxs]),
                 histo_wts=_np.full(2 * len(r), eps, _np.float32))
-        self._staged_n += len(rows) + len(cent_rows)
+            n_staged += 2 * len(r)
+            for vals in (mns, mxs):
+                vnz = vals != 0
+                rsum_samples += _np.bincount(
+                    r[vnz], weights=float(eps) / vals[vnz],
+                    minlength=nrows)[:nrows]
+        # exact forwarded rsum per row minus what the samples will add
+        rsum_true = _np.bincount(
+            rows, weights=stats[:, segment.STAT_RSUM].astype(
+                _np.float64), minlength=nrows)[:nrows]
+        corr = rsum_true - rsum_samples
+        crows = _np.nonzero(corr)[0]
+        if len(crows):
+            self.agg.stage(sh, rsum_rows=crows.astype(_np.int32),
+                           rsum_vals=corr[crows].astype(_np.float32))
+            n_staged += len(crows)
+        self._staged_n += n_staged
 
     def import_set(self, name, tags, regs, scope=None) -> bool:
         """Forwarded HLL plane: registers convert to (idx, rank)
