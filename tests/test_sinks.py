@@ -533,3 +533,55 @@ def test_prometheus_repeater_tcp():
     assert conn.recv(1024) == b"prom.t:2.0|c\n"
     conn.close()
     lsock.close()
+
+
+def test_kafka_events_and_checks_deliver():
+    """kafka_check_topic / kafka_event_topic actually deliver (the
+    reference stores these topics but leaves FlushOtherSamples a
+    TODO, kafka.go:222)."""
+    from veneur_tpu.protocol.dogstatsd import Event, ServiceCheck
+    from veneur_tpu.sinks.kafka import KafkaMetricSink
+
+    broker = _FakeKafkaBroker()
+    s = KafkaMetricSink(broker.addr, metric_topic="vm",
+                        check_topic="vc", event_topic="ve")
+    s.flush_other_samples([
+        Event(title="deploy", text="v2 out", tags=("env:prod",)),
+        ServiceCheck(name="db.up", status=0, message="fine"),
+    ])
+    by_topic = {}
+    for t, _, b in broker.produced:
+        for v in _decode_record_values(b):
+            by_topic.setdefault(t, []).append(json.loads(v))
+    assert by_topic["ve"][0]["title"] == "deploy"
+    assert by_topic["vc"][0]["name"] == "db.up"
+    assert by_topic["vc"][0]["status"] == 0
+
+
+def test_datadog_events_and_checks_deliver(http_capture):
+    """Events -> /intake, service checks -> /api/v1/check_run
+    (reference datadog.go FlushOtherSamples, :122/:234)."""
+    from veneur_tpu.protocol.dogstatsd import Event, ServiceCheck
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
+
+    s = DatadogMetricSink("key", _url(http_capture), 10.0,
+                          hostname="h1")
+    s.flush_other_samples([
+        Event(title="deploy", text="v2", tags=("env:prod",)),
+        ServiceCheck(name="db.up", status=2, message="down"),
+    ])
+    by_path = {p.split("?")[0]: (m, json.loads(b))
+               for m, p, h, b in http_capture.requests}
+    checks = by_path["/api/v1/check_run"][1]
+    assert checks[0]["check"] == "db.up"
+    assert checks[0]["status"] == 2
+    assert checks[0]["host_name"] == "h1"
+    intake = by_path["/intake"][1]
+    ev = intake["events"]["api"][0]
+    # reference DDEvent field tags: msg_title/msg_text, omitempty on
+    # unset optionals (no "timestamp": null)
+    assert ev["msg_title"] == "deploy"
+    assert ev["msg_text"] == "v2"
+    assert ev["alert_type"] == "info"
+    assert "timestamp" not in ev
+    assert "timestamp" not in checks[0]

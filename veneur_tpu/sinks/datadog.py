@@ -83,6 +83,64 @@ class DatadogMetricSink(SinkBase):
         for i in range(0, len(series), self.max_per_body):
             self._post(series[i:i + self.max_per_body])
 
+    def flush_other_samples(self, samples: list) -> None:
+        """Events -> the /intake endpoint, service checks ->
+        /api/v1/check_run (reference datadog.go:122,:234
+        FlushOtherSamples; neither endpoint takes deflate).  Field
+        names and omitempty semantics follow DDEvent/DDServiceCheck
+        (datadog.go:49-82): events carry msg_title/msg_text, unset
+        optionals are OMITTED rather than serialized null."""
+        from veneur_tpu.protocol.dogstatsd import ServiceCheck
+
+        def drop_empty(d: dict) -> dict:
+            return {k: v for k, v in d.items()
+                    if v not in (None, "", [])}
+
+        events, checks = [], []
+        for s in samples:
+            if isinstance(s, ServiceCheck):
+                # check/status/host_name have no omitempty in the
+                # reference struct — always present
+                checks.append({
+                    "check": s.name,
+                    "status": int(s.status),
+                    "host_name": s.hostname or self.hostname,
+                } | drop_empty({
+                    "timestamp": s.timestamp,
+                    "message": s.message,
+                    "tags": list(s.tags)}))
+            else:
+                events.append(drop_empty({
+                    "msg_title": s.title,
+                    "msg_text": s.text,
+                    "timestamp": s.timestamp,
+                    "host": s.hostname or self.hostname,
+                    "aggregation_key": s.aggregation_key,
+                    "priority": s.priority or "normal",
+                    "source_type_name": s.source_type,
+                    "alert_type": s.alert_type or "info",
+                    "tags": list(s.tags)}))
+        if checks:
+            self._post_raw(
+                f"{self.api_hostname}/api/v1/check_run"
+                f"?api_key={self.api_key}", checks)
+        if events:
+            # the reference wraps events in the undocumented intake
+            # shape {"events": {"api": [...]}} (datadog.go:234)
+            self._post_raw(
+                f"{self.api_hostname}/intake?api_key={self.api_key}",
+                {"events": {"api": events}})
+
+    def _post_raw(self, url: str, payload) -> None:
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                r.read()
+        except urllib.error.URLError as e:
+            log.warning("datadog event/check flush failed: %s", e)
+
     def _post(self, chunk: list[dict]) -> None:
         body = zlib.compress(
             json.dumps({"series": chunk}).encode())

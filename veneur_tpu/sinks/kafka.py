@@ -336,6 +336,62 @@ class KafkaMetricSink(SinkBase):
         except OSError as e:
             log.warning("kafka metric flush failed: %s", e)
 
+    def flush_other_samples(self, samples: list) -> None:
+        """Events -> kafka_event_topic, service checks ->
+        kafka_check_topic, as JSON records keyed on title/name.  (The
+        reference's KafkaMetricSink stores these topics but leaves
+        FlushOtherSamples a TODO, kafka.go:222-225 — here they
+        deliver.)"""
+        from veneur_tpu.protocol.dogstatsd import ServiceCheck
+        if not (self.check_topic or self.event_topic) or not samples:
+            return
+        by_topic: dict[str, list] = {}
+        for s in samples:
+            if isinstance(s, ServiceCheck):
+                if not self.check_topic:
+                    continue
+                rec = {"name": s.name, "status": int(s.status),
+                       "timestamp": s.timestamp,
+                       "hostname": s.hostname, "message": s.message,
+                       "tags": list(s.tags)}
+                by_topic.setdefault(self.check_topic, []).append(
+                    (s.name.encode(), json.dumps(rec).encode()))
+            else:
+                if not self.event_topic:
+                    continue
+                rec = {"title": s.title, "text": s.text,
+                       "timestamp": s.timestamp,
+                       "hostname": s.hostname,
+                       "aggregation_key": s.aggregation_key,
+                       "priority": s.priority,
+                       "source_type": s.source_type,
+                       "alert_type": s.alert_type,
+                       "tags": list(s.tags)}
+                by_topic.setdefault(self.event_topic, []).append(
+                    (s.title.encode(), json.dumps(rec).encode()))
+        import time as _t
+        ts = int(_t.time() * 1000)
+        # per-topic isolation: a dead check topic must not drop the
+        # same flush's events bound for a healthy event topic
+        for topic, records in by_topic.items():
+            try:
+                n_parts = self.client.partitions_for(topic)
+                groups: dict[int, list] = {}
+                for key, value in records:
+                    part = partition_for(key, n_parts,
+                                         self.partitioner)
+                    groups.setdefault(part, []).append((key, value))
+                for part, recs in groups.items():
+                    for chunk in bound_batches(
+                            recs, self.buffer_bytes,
+                            self.buffer_messages):
+                        produce_with_retry(
+                            self.client, topic, part,
+                            record_batch(chunk, ts), self.acks,
+                            self.retry_max)
+            except OSError as e:
+                log.warning("kafka %s flush failed: %s", topic, e)
+
 
 class KafkaSpanSink:
     """Spans as protobuf or JSON records (reference kafka.go span
