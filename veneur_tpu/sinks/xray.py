@@ -15,7 +15,10 @@ log = logging.getLogger("veneur_tpu.sinks")
 _HEADER = b'{"format": "json", "version": 1}\n'
 
 
-class XRaySpanSink:
+from veneur_tpu.sinks.base import SpanTagExcluder
+
+
+class XRaySpanSink(SpanTagExcluder):
     name = "xray"
 
     def __init__(self, daemon_address: str = "127.0.0.1:2000",
@@ -53,7 +56,8 @@ class XRaySpanSink:
             "end_time": span.end_timestamp / 1e9,
             "error": bool(span.error),
             "annotations": {
-                k: v for k, v in span.tags.items()
+                k: v for k, v in
+                self.filter_span_tags(span.tags).items()
                 if not self.annotation_tags or k in
                 self.annotation_tags},
         }
