@@ -146,3 +146,54 @@ def test_flush_during_heavy_staging_is_linearizable():
                 if m.name == "mid.ctr")
     assert total == n, total
     srv.shutdown()
+
+
+def test_ticker_and_manual_flush_serialize_and_conserve():
+    """The real flush TICKER racing manual flush_once calls and
+    lockless-looking ingest: flushes serialize (_flush_serial) and
+    conservation holds across BOTH flush streams.  This is the bug
+    class where an in-flight ticker flush swapped the table while a
+    test-style caller flushed concurrently.  Every flush — ticker and
+    manual — passes through the serialized _flush_once_locked, so
+    wrapping IT captures both streams' FlushResults; sink streams are
+    deliberately at-most-once and not asserted (module docstring)."""
+    srv = Server(read_config(data={"interval": "150ms",
+                                   "hostname": "h"}))
+    results = []
+    results_lock = threading.Lock()
+    orig = srv._flush_once_locked
+
+    def recording(*a, **kw):
+        res = orig(*a, **kw)
+        with results_lock:
+            results.append(res)
+        return res
+
+    srv._flush_once_locked = recording
+    srv.start()  # ticker live
+    writers = 4
+    batches = 40
+    per_batch = 25
+    try:
+        def writer(wid):
+            for b in range(batches):
+                lines = [f"tick.ctr:1|c|#w:{wid}".encode()
+                         for _ in range(per_batch)]
+                srv.handle_packet(b"\n".join(lines))
+                if b % 10 == 0:
+                    srv.flush_once()
+
+        threads = [threading.Thread(target=writer, args=(i,))
+                   for i in range(writers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        srv.flush_once()  # drain the final interval
+        total = writers * batches * per_batch
+        with results_lock:
+            got = sum(m.value for r in results for m in r.metrics
+                      if m.name == "tick.ctr")
+        assert got == total, (got, total)
+    finally:
+        srv.shutdown()
