@@ -414,3 +414,62 @@ def test_enable_profiling_writes_trace(tmp_path, monkeypatch):
     for root, _dirs, files in os.walk(tmp_path):
         found.extend(f for f in files if "xplane" in f or "trace" in f)
     assert found, "no profiler artifact written"
+
+
+def test_emit_event_and_sc_modes():
+    """veneur-emit -mode event / -mode sc build reference-grammar
+    packets that a server parses into Event/ServiceCheck and delivers
+    via FlushOtherSamples (cmd/veneur-emit buildEventPacket /
+    buildSCPacket)."""
+    import time as _time
+
+    from veneur_tpu.cli import emit
+    from veneur_tpu.core.config import read_config
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.sinks.simple import CaptureSink
+
+    class OtherCap(CaptureSink):
+        def __init__(self):
+            super().__init__()
+            self.other = []
+
+        def flush_other_samples(self, samples):
+            self.other.extend(samples)
+
+    cap = OtherCap()
+    srv = Server(read_config(data={
+        "statsd_listen_addresses": ["udp://127.0.0.1:0"],
+        "interval": "10s"}), extra_sinks=[cap])
+    srv.start()
+    try:
+        port = srv.statsd_ports[0]
+        rc = emit.main(["-hostport", f"udp://127.0.0.1:{port}",
+                        "-mode", "event",
+                        "-e_title", "deploy",
+                        "-e_text", "went\\nfine",
+                        "-e_aggr_key", "dep-1",
+                        "-e_alert_type", "success",
+                        "-e_event_tags", "env:prod"])
+        assert rc == 0
+        rc = emit.main(["-hostport", f"udp://127.0.0.1:{port}",
+                        "-mode", "sc",
+                        "-sc_name", "db.up", "-sc_status", "1",
+                        "-sc_msg", "degraded",
+                        "-sc_tags", "shard:3"])
+        assert rc == 0
+        deadline = _time.monotonic() + 5
+        while len(srv.events) + len(srv.checks) < 2 and \
+                _time.monotonic() < deadline:
+            _time.sleep(0.02)
+        srv.flush_once()
+    finally:
+        srv.shutdown()
+    events = [s for s in cap.other if hasattr(s, "title")]
+    checks = [s for s in cap.other if hasattr(s, "status")]
+    assert events and events[0].title == "deploy"
+    assert events[0].aggregation_key == "dep-1"
+    assert events[0].alert_type == "success"
+    assert "env:prod" in events[0].tags
+    assert checks and checks[0].name == "db.up"
+    assert checks[0].status == 1
+    assert checks[0].message == "degraded"

@@ -75,8 +75,15 @@ def run_bench(sock, datagram: bool, count: int, names: int,
 def _build_span(args, samples, tags: dict, start_ns: int,
                 end_ns: int):
     """SSFSpan wrapping the requested samples (and/or command timing),
-    the shape the reference's -ssf mode produces."""
+    the shape the reference's -ssf mode produces.  -span_starttime /
+    -span_endtime override the measured window; -span_tags add
+    span-only tags (useful for high-cardinality values kept off the
+    metrics)."""
     from veneur_tpu.protocol.gen import ssf_pb2
+    if args.span_starttime:
+        start_ns = _parse_when(args.span_starttime)
+    if args.span_endtime:
+        end_ns = _parse_when(args.span_endtime)
     span = ssf_pb2.SSFSpan(
         trace_id=args.trace_id or random.getrandbits(63),
         id=random.getrandbits(63),
@@ -88,6 +95,10 @@ def _build_span(args, samples, tags: dict, start_ns: int,
     span.metrics.extend(samples)
     for k, v in tags.items():
         span.tags[k] = v
+    for t in (args.span_tags.split(",") if args.span_tags else ()):
+        k, _, v = t.partition(":")
+        if k:
+            span.tags[k] = v
     return span
 
 
@@ -151,7 +162,7 @@ def _emit_ssf_or_grpc(args) -> int:
             lines.append(build_line(
                 args.name or "command.duration",
                 round(command_ms, 3), "ms", args.tag))
-        chan = grpclib.insecure_channel(args.hostport)
+        chan = grpclib.insecure_channel(args.proxy or args.hostport)
         send = chan.unary_unary(
             "/dogstatsd.DogstatsdGRPC/SendPacket",
             request_serializer=(
@@ -168,7 +179,7 @@ def _emit_ssf_or_grpc(args) -> int:
 
         from veneur_tpu.protocol.gen import dogstatsd_grpc_pb2 as dpb
         from veneur_tpu.protocol.gen import ssf_pb2
-        chan = grpclib.insecure_channel(args.hostport)
+        chan = grpclib.insecure_channel(args.proxy or args.hostport)
         send = chan.unary_unary(
             "/ssf.SSFGRPC/SendSpan",
             request_serializer=ssf_pb2.SSFSpan.SerializeToString,
@@ -180,9 +191,72 @@ def _emit_ssf_or_grpc(args) -> int:
     return rc
 
 
+def build_event_packet(args) -> bytes:
+    """DogStatsD event wire (_e{...}; reference buildEventPacket,
+    cmd/veneur-emit/main.go:844)."""
+    # real newlines escape to literal \n sequences (the parser's
+    # inverse, dogstatsd.py:251) and the header lengths describe the
+    # UTF-8 BYTES as transmitted
+    title = args.e_title.replace("\n", "\\n")
+    text = args.e_text.replace("\n", "\\n")
+    parts = [f"_e{{{len(title.encode())},{len(text.encode())}}}"
+             f":{title}|{text}"]
+    if args.e_time:
+        parts.append(f"d:{_parse_when(args.e_time) // 1_000_000_000}")
+    if args.e_hostname:
+        parts.append(f"h:{args.e_hostname}")
+    if args.e_aggr_key:
+        parts.append(f"k:{args.e_aggr_key}")
+    if args.e_priority and args.e_priority != "normal":
+        parts.append(f"p:{args.e_priority}")
+    if args.e_source_type:
+        parts.append(f"s:{args.e_source_type}")
+    if args.e_alert_type and args.e_alert_type != "info":
+        parts.append(f"t:{args.e_alert_type}")
+    tags = list(args.tag)
+    if args.e_event_tags:
+        tags += args.e_event_tags.split(",")
+    if tags:
+        parts.append("#" + ",".join(tags))
+    return "|".join(parts).encode()
+
+
+def build_sc_packet(args) -> bytes:
+    """DogStatsD service-check wire (_sc|...; reference
+    buildSCPacket, cmd/veneur-emit/main.go:909)."""
+    parts = [f"_sc|{args.sc_name}|{args.sc_status}"]
+    if args.sc_time:
+        parts.append(f"d:{_parse_when(args.sc_time) // 1_000_000_000}")
+    if args.sc_hostname:
+        parts.append(f"h:{args.sc_hostname}")
+    tags = list(args.tag)
+    if args.sc_tags:
+        tags += args.sc_tags.split(",")
+    if tags:
+        parts.append("#" + ",".join(tags))
+    if args.sc_msg:
+        parts.append("m:" + args.sc_msg.replace("\n", "\\n"))
+    return "|".join(parts).encode()
+
+
+def _parse_when(text: str) -> int:
+    """Date/time flag -> unix nanoseconds: unix epoch seconds or an
+    ISO-8601 string (the reference accepts dateparse's formats)."""
+    try:
+        return int(float(text) * 1e9)
+    except ValueError:
+        from datetime import datetime
+        return int(datetime.fromisoformat(text).timestamp() * 1e9)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="veneur-emit")
     ap.add_argument("-hostport", required=True)
+    ap.add_argument("-mode", default="metric",
+                    choices=["metric", "event", "sc"],
+                    help="metric (default), event or sc "
+                         "(service check); event/sc are statsd-only")
+    ap.add_argument("-debug", action="store_true")
     ap.add_argument("-name")
     ap.add_argument("-count", type=float)
     ap.add_argument("-gauge", type=float)
@@ -200,13 +274,71 @@ def main(argv=None) -> int:
                     help="send as an SSF span with attached samples")
     ap.add_argument("-grpc", action="store_true",
                     help="send over gRPC (DogstatsdGRPC / SSFGRPC)")
-    ap.add_argument("-span-service", default="veneur-emit")
-    ap.add_argument("-span-name", default="")
-    ap.add_argument("-trace-id", type=int, default=0)
-    ap.add_argument("-parent-span-id", type=int, default=0)
+    # -proxy (reference: authority override for proxied emission) —
+    # used as the dial target for gRPC sends when set
+    ap.add_argument("-proxy", default="")
+    ap.add_argument("-span-service", "-span_service",
+                    dest="span_service", default="veneur-emit")
+    ap.add_argument("-span-name", "-span_name", dest="span_name",
+                    default="")
+    ap.add_argument("-span_starttime", dest="span_starttime",
+                    default="")
+    ap.add_argument("-span_endtime", dest="span_endtime", default="")
+    ap.add_argument("-span_tags", dest="span_tags", default="")
+    ap.add_argument("-trace-id", "-trace_id", dest="trace_id",
+                    type=int, default=0)
+    ap.add_argument("-parent-span-id", "-parent_span_id",
+                    dest="parent_span_id", type=int, default=0)
     ap.add_argument("-indicator", action="store_true")
     ap.add_argument("-error", action="store_true")
+    # event flags (reference e_* family)
+    ap.add_argument("-e_title", default="")
+    ap.add_argument("-e_text", default="")
+    ap.add_argument("-e_time", default="")
+    ap.add_argument("-e_hostname", default="")
+    ap.add_argument("-e_aggr_key", default="")
+    ap.add_argument("-e_priority", default="normal")
+    ap.add_argument("-e_source_type", default="")
+    ap.add_argument("-e_alert_type", default="info")
+    ap.add_argument("-e_event_tags", default="")
+    # service-check flags (reference sc_* family)
+    ap.add_argument("-sc_name", default="")
+    ap.add_argument("-sc_status", default="")
+    ap.add_argument("-sc_time", default="")
+    ap.add_argument("-sc_hostname", default="")
+    ap.add_argument("-sc_tags", default="")
+    ap.add_argument("-sc_msg", default="")
     args = ap.parse_args(argv)
+
+    if args.debug:
+        import logging
+        logging.basicConfig(level=logging.DEBUG)
+
+    if args.mode in ("event", "sc"):
+        # events/checks are statsd-wire only (the reference rejects
+        # -ssf with these modes, main.go:215-219)
+        if args.ssf or args.grpc:
+            print(f"mode {args.mode} is unsupported with -ssf/-grpc",
+                  file=sys.stderr)
+            return 1
+        if args.mode == "event" and not (args.e_title and
+                                         args.e_text):
+            print("event mode needs -e_title and -e_text",
+                  file=sys.stderr)
+            return 1
+        if args.mode == "sc" and not (args.sc_name and
+                                      args.sc_status != ""):
+            print("sc mode needs -sc_name and -sc_status",
+                  file=sys.stderr)
+            return 1
+        sock, datagram = _open(args.hostport)
+        pkt = (build_event_packet(args) if args.mode == "event"
+               else build_sc_packet(args))
+        if args.debug:
+            print(f"sending to {args.hostport}: {pkt!r}",
+                  file=sys.stderr)
+        _send(sock, datagram, pkt)
+        return 0
 
     if args.ssf or args.grpc:
         return _emit_ssf_or_grpc(args)
