@@ -162,3 +162,42 @@ def test_label_unescape_single_pass():
     (line,) = translate(samples, {})
     assert b"\n" not in line
     assert b"path:C:\\new" in line
+
+
+def test_short_flags_and_unix_socket(tmp_path, monkeypatch):
+    """The reference's short flags (-h/-i/-p/-s/-d/-socket,
+    cmd/veneur-prometheus/main.go:12-24) work, -p prepends verbatim,
+    and -socket routes over a unix datagram socket."""
+    import http.server
+    import socket as _socket
+    import threading
+
+    from veneur_tpu.cli import prometheus as prom
+
+    class Metrics(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = b"# TYPE depth gauge\ndepth 42\n"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Metrics)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    path = str(tmp_path / "statsd.sock")
+    recv = _socket.socket(_socket.AF_UNIX, _socket.SOCK_DGRAM)
+    recv.bind(path)
+    recv.settimeout(5.0)
+    try:
+        rc = prom.main([
+            "-h", f"http://127.0.0.1:{httpd.server_port}/metrics",
+            "-p", "svc.", "-i", "1s", "-socket", path, "-once"])
+        assert rc == 0
+        data, _ = recv.recvfrom(65536)
+        assert data.startswith(b"svc.depth:")
+    finally:
+        recv.close()
+        httpd.shutdown()

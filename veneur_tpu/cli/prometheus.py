@@ -145,15 +145,28 @@ def scrape(url: str, cert=None, key=None, cacert=None,
 
 
 def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(prog="veneur-prometheus")
-    ap.add_argument("-host", dest="host",
+    # add_help=False frees -h for the reference's metrics-host flag
+    # (cmd/veneur-prometheus/main.go:13); --help still works
+    ap = argparse.ArgumentParser(prog="veneur-prometheus",
+                                 add_help=False)
+    ap.add_argument("--help", action="help",
+                    help="show this help message and exit")
+    ap.add_argument("-host", "-h", dest="host",
                     default="http://localhost:9090/metrics",
                     help="prometheus metrics endpoint URL")
-    ap.add_argument("-statsd-host", dest="statsd",
+    ap.add_argument("-statsd-host", "-s", dest="statsd",
                     default="127.0.0.1:8126",
                     help="UDP statsd target host:port")
-    ap.add_argument("-interval", default="10s")
-    ap.add_argument("-prefix", default="")
+    ap.add_argument("-interval", "-i", default="10s")
+    ap.add_argument("-prefix", "-p", default="",
+                    help="prefix prepended VERBATIM to every metric "
+                         "(include a trailing period, per the "
+                         "reference)")
+    ap.add_argument("-d", dest="debug", action="store_true",
+                    help="debug logging")
+    ap.add_argument("-socket", default="",
+                    help="unix datagram socket path used as the "
+                         "statsd transport instead of UDP")
     ap.add_argument("-ignored-labels", default="",
                     help="comma-separated label names to drop")
     ap.add_argument("-added-labels", default="",
@@ -164,13 +177,21 @@ def main(argv=None) -> int:
     ap.add_argument("-once", action="store_true",
                     help="single scrape (for testing)")
     args = ap.parse_args(argv)
+    if args.debug:
+        logging.getLogger().setLevel(logging.DEBUG)
 
     iv = args.interval
     seconds = float(iv[:-1]) * {"s": 1, "m": 60, "h": 3600}.get(
         iv[-1], 1) if iv and iv[-1] in "smh" else float(iv)
-    host, _, port = args.statsd.partition(":")
-    target = (host, int(port or 8126))
-    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    if args.socket:
+        # unix datagram transport (-socket; the reference supports it
+        # for proxy setups)
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+        target = args.socket
+    else:
+        host, _, port = args.statsd.partition(":")
+        target = (host, int(port or 8126))
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
     ignored = tuple(x for x in args.ignored_labels.split(",") if x)
     added = tuple(x for x in args.added_labels.split(",") if x)
     cache: dict = {}
@@ -182,7 +203,9 @@ def main(argv=None) -> int:
                             ignored, added)
             for line in out:
                 if args.prefix:
-                    line = args.prefix.encode() + b"." + line
+                    # verbatim: the reference's contract is that the
+                    # prefix carries its own trailing period
+                    line = args.prefix.encode() + line
                 sock.sendto(line, target)
             log.info("scraped %s: %d metrics emitted", args.host,
                      len(out))
