@@ -1,0 +1,27 @@
+#!/bin/bash
+# Device-link watcher: probe in a loop; on the first healthy probe,
+# run the full bench with a generous budget and save everything.
+# Output: bench_results/watch.log + the orchestrator's own artifacts.
+cd /root/repo
+LOG=bench_results/watch.log
+echo "$(date -u +%FT%TZ) watcher start" >> "$LOG"
+for i in $(seq 1 200); do
+  out=$(timeout 120 python -c "
+from veneur_tpu.utils import devprobe
+print(devprobe.probe_device(45) or 'HEALTHY')" 2>&1 | tail -1)
+  echo "$(date -u +%FT%TZ) probe[$i]: $out" >> "$LOG"
+  if [ "$out" = "HEALTHY" ]; then
+    echo "$(date -u +%FT%TZ) link healthy -> full bench" >> "$LOG"
+    VENEUR_BENCH_BUDGET=1800 timeout 2100 python bench.py \
+        > bench_results/watch_bench_stdout.json 2>> "$LOG"
+    echo "$(date -u +%FT%TZ) bench done rc=$?" >> "$LOG"
+    # A/B the dfcumsum merge on the real device, timers config only
+    VENEUR_TPU_MERGE=dfcumsum VENEUR_BENCH_BUDGET=600 timeout 700 \
+        python bench.py --config 2_timers_10k_series \
+        > bench_results/watch_dfcumsum_c2.json 2>> "$LOG"
+    echo "$(date -u +%FT%TZ) dfcumsum A/B done rc=$?" >> "$LOG"
+    exit 0
+  fi
+  sleep 90
+done
+echo "$(date -u +%FT%TZ) watcher exhausted" >> "$LOG"

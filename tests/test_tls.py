@@ -34,9 +34,14 @@ def certs(tmp_path_factory):
         crt = str(d / f"{name}.crt")
         _openssl("req", "-newkey", "rsa:2048", "-nodes", "-keyout",
                  key, "-out", csr, "-subj", f"/CN=127.0.0.1")
+        # SAN required by gRPC's peer verification (CN fallback is
+        # disabled there)
+        ext = str(d / f"{name}.ext")
+        with open(ext, "w") as f:
+            f.write("subjectAltName=IP:127.0.0.1,DNS:localhost\n")
         _openssl("x509", "-req", "-in", csr, "-CA", ca_crt,
                  "-CAkey", ca_key, "-CAcreateserial", "-out", crt,
-                 "-days", "1")
+                 "-days", "1", "-extfile", ext)
         out[f"{name}_key"] = key
         out[f"{name}_crt"] = crt
     return out
@@ -131,3 +136,80 @@ def test_authority_without_key_is_config_error(certs):
             "statsd_listen_addresses": [],
             "tls_authority_certificate": certs["ca"],
             "interval": "10s"}), extra_sinks=[CaptureSink()])
+
+
+def test_grpc_listener_serves_under_tls(certs):
+    """The gRPC import listener serves under the server's TLS config
+    (reference networking.go:333-340 startGRPCTCP): a TLS client
+    forwards successfully, a plaintext client fails."""
+    import grpc
+
+    from veneur_tpu.core.flusher import Flusher
+    from veneur_tpu.core.table import MetricTable, TableConfig
+    from veneur_tpu.forward.grpc_forward import ForwardClient
+    from veneur_tpu.protocol import dogstatsd as dsd
+
+    cap = CaptureSink()
+    srv = Server(read_config(data={
+        "grpc_listen_addresses": ["tcp://127.0.0.1:0"],
+        "interval": "10s",
+        "tls_key": certs["server_key"],
+        "tls_certificate": certs["server_crt"]}),
+        extra_sinks=[cap])
+    srv.start()
+    try:
+        src = MetricTable(TableConfig())
+        src.ingest(dsd.Sample(name="tlsm", type=dsd.COUNTER,
+                              value=3.0, scope=dsd.SCOPE_GLOBAL))
+        rows = Flusher(is_local=True).flush(src.swap()).forward
+
+        with open(certs["ca"], "rb") as f:
+            creds = grpc.ssl_channel_credentials(f.read())
+        client = ForwardClient(f"127.0.0.1:{srv.grpc_ports[0]}",
+                               credentials=creds)
+        client.send(rows)
+        client.close()
+        assert _wait(lambda: srv.stats.get("imports_received", 0) >= 1)
+
+        plain = ForwardClient(f"127.0.0.1:{srv.grpc_ports[0]}",
+                              timeout=2.0)
+        with pytest.raises(grpc.RpcError):
+            plain.send(rows)
+        plain.close()
+    finally:
+        srv.shutdown()
+
+
+def test_grpc_forward_client_dials_tls_global(certs):
+    """A local with forward_grpc_tls_ca reaches a TLS gRPC global
+    through the ordinary forward path (the client half of the
+    TLS-capable listener)."""
+    cap = CaptureSink()
+    glob = Server(read_config(data={
+        "grpc_listen_addresses": ["tcp://127.0.0.1:0"],
+        "interval": "10s",
+        "tls_key": certs["server_key"],
+        "tls_certificate": certs["server_crt"]}),
+        extra_sinks=[cap])
+    glob.start()
+    try:
+        local = Server(read_config(data={
+            "statsd_listen_addresses": [],
+            "forward_address": f"127.0.0.1:{glob.grpc_ports[0]}",
+            "forward_use_grpc": True,
+            "forward_grpc_tls_ca": certs["ca"],
+            "interval": "10s"}))
+        try:
+            from veneur_tpu.protocol import dogstatsd as dsd
+            local.table.ingest(dsd.parse_metric(
+                b"tfwd:9|c|#veneurglobalonly"))
+            local.flush_once()
+            assert _wait(lambda: glob.stats.get(
+                "imports_received", 0) >= 1)
+            glob.flush_once()
+            assert any(m.name == "tfwd" and m.value == 9.0
+                       for m in cap.metrics)
+        finally:
+            local.shutdown()
+    finally:
+        glob.shutdown()

@@ -91,6 +91,14 @@ class Config:
     # forwarding / tiering
     forward_address: str = ""
     forward_use_grpc: bool = False
+    # dial the gRPC global over TLS (no reference equivalent — the
+    # reference always dials insecure, server.go:983, though its own
+    # listener is TLS-capable).  forward_grpc_tls uses system roots;
+    # forward_grpc_tls_ca pins a CA (file path or inline PEM); the
+    # node's tls_key/tls_certificate double as the client pair for
+    # mutual auth when present.
+    forward_grpc_tls: bool = False
+    forward_grpc_tls_ca: str = ""
     # HTTP /import wire schema when forwarding: "native" (default)
     # carries scope; "reference" emits the reference's JSONMetric
     # format (gob digests, LE counter/gauge, axiomhq HLL binary) so an

@@ -58,6 +58,19 @@ def _is_oom_error(e: BaseException) -> bool:
     return any(m in msg for m in _OOM_MARKERS)
 
 
+def _is_inline_pem(value: str) -> bool:
+    """TLS config values are either PEM material inline (the
+    reference's example.yaml style) or file paths."""
+    return value.lstrip().startswith("-----BEGIN")
+
+
+def _pem_bytes(value: str) -> bytes:
+    if _is_inline_pem(value):
+        return value.encode()
+    with open(value, "rb") as f:
+        return f.read()
+
+
 def generate_excluded_tags(rules: list[str],
                            sink_name: str) -> list[str]:
     """tags_exclude rules -> tag names excluded for one sink:
@@ -394,7 +407,7 @@ class Server:
             # (example.yaml tls_key); file paths also accepted.  Inline
             # material is spilled 0600 and unlinked at exit so private
             # keys never persist in /tmp
-            if value.lstrip().startswith("-----BEGIN"):
+            if _is_inline_pem(value):
                 import atexit
                 f = tempfile.NamedTemporaryFile(
                     mode="w", suffix=".pem", delete=False)
@@ -591,14 +604,51 @@ class Server:
                 f"already; refusing to take over {path!r}")
         self._socket_locks.append((lockname, fd))
 
+    def _forward_grpc_credentials(self):
+        """Channel credentials for dialing a TLS gRPC global
+        (forward_grpc_tls / forward_grpc_tls_ca; the reference always
+        dials insecure, server.go:983 — this is the client half its
+        TLS-capable listener never got)."""
+        c = self.config
+        if not (c.forward_grpc_tls or c.forward_grpc_tls_ca):
+            return None
+        import grpc
+        root = (_pem_bytes(c.forward_grpc_tls_ca)
+                if c.forward_grpc_tls_ca else None)
+        key = cert = None
+        if c.tls_key and c.tls_certificate:
+            key = _pem_bytes(c.tls_key)
+            cert = _pem_bytes(c.tls_certificate)
+        return grpc.ssl_channel_credentials(
+            root_certificates=root, private_key=key,
+            certificate_chain=cert)
+
+    def _grpc_credentials(self):
+        """grpc server credentials from the config's TLS material
+        (the reference serves gRPC under the same tlsConfig as TCP
+        statsd, networking.go:333-340; client CA => mutual auth)."""
+        c = self.config
+        if not (c.tls_key and c.tls_certificate):
+            return None
+        import grpc
+
+        root = (_pem_bytes(c.tls_authority_certificate)
+                if c.tls_authority_certificate else None)
+        return grpc.ssl_server_credentials(
+            [(_pem_bytes(c.tls_key), _pem_bytes(c.tls_certificate))],
+            root_certificates=root,
+            require_client_auth=root is not None)
+
     def _start_grpc(self, addr: str) -> None:
         """gRPC Forward import listener — the importsrv role
-        (reference networking.go:295 StartGRPC, importsrv/server.go)."""
+        (reference networking.go:295 StartGRPC, importsrv/server.go);
+        TLS-aware under the server's TLS config."""
         from veneur_tpu.forward.grpc_forward import ImportServer
         scheme, host, port, _ = parse_addr(addr)
         if scheme != "tcp":
             raise ValueError(f"grpc listener must be tcp://: {addr!r}")
-        srv = ImportServer(self, f"{host}:{port}")
+        srv = ImportServer(self, f"{host}:{port}",
+                           credentials=self._grpc_credentials())
         srv.start()
         self.grpc_servers.append(srv)
         self.grpc_ports.append(srv.port)
@@ -1256,7 +1306,8 @@ class Server:
         if self._grpc_client is None:
             self._grpc_client = ForwardClient(
                 self.config.forward_address,
-                compression=float(self.config.tpu_compression))
+                compression=float(self.config.tpu_compression),
+                credentials=self._forward_grpc_credentials())
         try:
             self._grpc_client.send(rows)
         except _grpc.RpcError as e:
