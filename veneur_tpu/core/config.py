@@ -456,6 +456,10 @@ class ProxyConfig:
     # Go pprof profiling flag: no-op (the proxy does no device work)
     enable_profiling: bool = False
     sentry_dsn: str = ""
+    # dial TLS gRPC globals (same semantics as the server's
+    # forward_grpc_tls_ca)
+    forward_grpc_tls: bool = False
+    forward_grpc_tls_ca: str = ""
 
     def consul_refresh_interval_seconds(self) -> float:
         return parse_duration(self.consul_refresh_interval)

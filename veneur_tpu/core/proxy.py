@@ -272,6 +272,18 @@ class ProxyServer:
         for dest, batch in groups.items():
             self._pool.submit(self._send_grpc, dest, batch)
 
+    def _grpc_channel_credentials(self):
+        c = self.config
+        if not (getattr(c, "forward_grpc_tls", False) or
+                getattr(c, "forward_grpc_tls_ca", "")):
+            return None
+        import grpc
+
+        from veneur_tpu.core.server import _pem_bytes
+        root = (_pem_bytes(c.forward_grpc_tls_ca)
+                if c.forward_grpc_tls_ca else None)
+        return grpc.ssl_channel_credentials(root_certificates=root)
+
     def _send_grpc(self, dest: str, batch: list) -> None:
         from veneur_tpu.forward.gen import forward_pb2
         from veneur_tpu.forward.grpc_forward import ForwardClient
@@ -281,7 +293,9 @@ class ProxyServer:
                 client = self._clients.get(dest)
                 if client is None:
                     client = ForwardClient(
-                        dest, timeout=self.config.forward_timeout)
+                        dest, timeout=self.config.forward_timeout,
+                        credentials=(
+                            self._grpc_channel_credentials()))
                     self._clients[dest] = client
             client._call(forward_pb2.MetricList(metrics=batch),
                          timeout=self.config.forward_timeout)
