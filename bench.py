@@ -70,6 +70,39 @@ from veneur_tpu.utils import compile_cache  # noqa: E402
 CACHE_WARM = compile_cache.enable(compile_cache.default_cache_dir())
 
 
+# A/B levers that change what the kernels compute or ship; their
+# state must travel with every artifact (a gated capture must be as
+# unmistakable as a CPU one) and keys their checkpoint filenames so
+# variant runs never overwrite the baseline checkpoint.
+_GATES = {
+    "merge": os.environ.get("VENEUR_TPU_MERGE", "scatter"),
+    "tail_refine": os.environ.get("VENEUR_TPU_TAIL_REFINE", "1"),
+    "f16_plane": os.environ.get("VENEUR_TPU_F16_PLANE", "1"),
+}
+_GATES_DEFAULT = {"merge": "scatter", "tail_refine": "1",
+                  "f16_plane": "1"}
+_GATE_TAG = "".join(f".{k}-{v}" for k, v in sorted(_GATES.items())
+                    if v != _GATES_DEFAULT[k])
+
+
+def _backend_info() -> dict:
+    """Platform stamp for artifacts: what backend did THIS process
+    actually run on.  A CPU capture must be unmistakable for a device
+    capture — the platform/device_kind travel with every number."""
+    info: dict = {"platform_pin": _PLATFORM_PIN or None,
+                  "gates": dict(_GATES)}
+    try:
+        import jax
+        d = jax.devices()[0]
+        info.update({"platform": d.platform,
+                     "device_kind": getattr(d, "device_kind", "?"),
+                     "num_devices": jax.device_count(),
+                     "jax_version": jax.__version__})
+    except Exception as e:  # pragma: no cover - dead-link path
+        info.update({"platform": "unknown", "platform_error": str(e)})
+    return info
+
+
 def _mk_table(**kw):
     from veneur_tpu.core.table import MetricTable, TableConfig
     return MetricTable(TableConfig(**kw))
@@ -478,8 +511,9 @@ CKPT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 
 def _ckpt_path(key: str) -> str:
-    return os.path.join(CKPT_DIR, f"{key}{'.quick' if QUICK else ''}"
-                        ".json")
+    return os.path.join(
+        CKPT_DIR,
+        f"{key}{_GATE_TAG}{'.quick' if QUICK else ''}.json")
 
 
 def _run_one_config(key: str) -> None:
@@ -491,6 +525,9 @@ def _run_one_config(key: str) -> None:
     fn = dict(CONFIGS)[key]
     res = fn()
     res["captured_unix"] = round(time.time(), 1)
+    # the child ran real device work, so this stamp records the
+    # backend the numbers above were measured on
+    res.update(_backend_info())
     os.makedirs(CKPT_DIR, exist_ok=True)
     tmp = _ckpt_path(key) + ".tmp"
     with open(tmp, "w") as f:
@@ -537,16 +574,37 @@ def _spawn_config(key: str, timeout_s: float) -> dict:
         return {"error": f"checkpoint unreadable after run: {e}"}
 
 
-def _assemble(configs: dict, t_start: float) -> dict:
+def _assemble(configs: dict, t_start: float,
+              probe_info: dict | None = None) -> dict:
     c0 = configs.get("0_counters_1k_names") or {}
     headline = c0.get("samples_per_sec")
     target = 10_000_000.0
+    # top-level platform stamp: consensus of the config children's own
+    # stamps (each child measured on a live backend), falling back to
+    # the orchestrator's probe result
+    platforms = {v.get("platform") for v in configs.values()
+                 if isinstance(v, dict) and v.get("platform")}
+    stamp = dict(probe_info or {})
+    for v in configs.values():
+        if isinstance(v, dict) and v.get("platform"):
+            stamp = {k2: v[k2] for k2 in
+                     ("platform", "device_kind", "num_devices",
+                      "jax_version") if k2 in v}
+            break
     out = {
         "metric": "aggregation_samples_per_sec_chip",
         "value": round(headline, 1) if headline else None,
         "unit": "samples/sec",
         "vs_baseline": (round(headline / target, 4)
                         if headline else None),
+        "platform": stamp.get("platform", "unknown"),
+        "device_kind": stamp.get("device_kind", "?"),
+        "num_devices": stamp.get("num_devices"),
+        "jax_version": stamp.get("jax_version"),
+        "platform_pin": _PLATFORM_PIN or None,
+        "gates": dict(_GATES),
+        "platform_mixed": sorted(platforms) if len(platforms) > 1
+        else None,
         "quick": QUICK,
         "compile_cache_warm": CACHE_WARM,
         "wall_seconds": round(time.time() - t_start, 1),
@@ -568,7 +626,7 @@ def main() -> None:
     t_start = time.time()
     from veneur_tpu.utils import devprobe
     probe_budget = min(240.0, _BUDGET / 2 if _BUDGET > 0 else 240.0)
-    err = devprobe.probe_device_retry(
+    err, probe_info = devprobe.probe_device_retry_info(
         probe_budget, attempt_s=30.0,
         on_attempt=lambda i, rem: print(
             f"# probe attempt {i} ({rem:.0f}s left)", file=sys.stderr))
@@ -577,6 +635,8 @@ def main() -> None:
             "metric": "aggregation_samples_per_sec_chip",
             "value": None, "unit": "samples/sec", "vs_baseline": None,
             "error": err,
+            "platform": "unreachable",
+            "platform_pin": _PLATFORM_PIN or None,
             "probe_budget_seconds": round(probe_budget, 1),
             "wall_seconds": round(time.time() - t_start, 1)}))
         return
@@ -611,7 +671,7 @@ def main() -> None:
                         "reason": "device link down mid-run"}
                 break
 
-    out = _assemble(configs, t_start)
+    out = _assemble(configs, t_start, probe_info)
     # preserve the raw artifact (transcriptions are not evidence)
     try:
         os.makedirs(os.path.dirname(CKPT_DIR), exist_ok=True)

@@ -1,0 +1,133 @@
+"""Assemble the device A/B table from the watcher's run artifacts.
+
+Reads the baseline full-bench stdout and the A/B config runs (each a
+platform-stamped JSON produced by ``bench.py``), and writes
+``bench_results/ab_table.md`` choosing a production default per lever
+with the device-measured medians.  Safe to re-run; it only reports
+what exists on disk and labels every number with the platform it was
+measured on.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+RUNS = {
+    "baseline (scatter, tail-refine on, f16 auto)":
+        "watch_bench_stdout.json",
+    "VENEUR_TPU_MERGE=dfcumsum (c2)": "watch_ab_dfcumsum_c2.json",
+    "VENEUR_TPU_TAIL_REFINE=0 (c2, 312-slot)":
+        "watch_ab_tailoff_c2.json",
+    "VENEUR_TPU_F16_PLANE=0 (c2)": "watch_ab_f16off_c2.json",
+    "VENEUR_TPU_MERGE=dfcumsum (c4)": "watch_ab_dfcumsum_c4.json",
+}
+
+
+def _load(fname: str) -> dict | None:
+    path = os.path.join(HERE, fname)
+    try:
+        with open(path) as f:
+            text = f.read().strip()
+    except OSError:
+        return None
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
+def _config_row(d: dict, key: str) -> dict | None:
+    """Pull one config's result out of either artifact shape
+    (full-run `configs` map, or single-config `{key: res}`)."""
+    if d is None:
+        return None
+    cfgs = d.get("configs", d)
+    row = cfgs.get(key)
+    # a row may be an error/skipped marker or a partial capture with
+    # no rate — all must render as "no artifact", not crash the
+    # watcher's summarize step
+    if (isinstance(row, dict) and "error" not in row and
+            not row.get("skipped") and
+            (row.get("samples_per_sec") or row.get("items_per_sec"))):
+        return row
+    return None
+
+
+def main() -> None:
+    lines = ["# Device A/B results (watcher-captured)", ""]
+    base_doc = _load("watch_bench_stdout.json")
+    rows = []  # (label, config_key, result|None, baseline_row|None)
+    for label, fname in RUNS.items():
+        key = ("4_global_merge_64_locals" if "(c4)" in label
+               else "2_timers_10k_series")
+        d = base_doc if fname == "watch_bench_stdout.json" else \
+            _load(fname)
+        r = _config_row(d, key)
+        base = (None if fname == "watch_bench_stdout.json"
+                else _config_row(base_doc, key))
+        if r is not None:
+            r = {
+                "rate": (r.get("samples_per_sec") or
+                         r.get("items_per_sec")),
+                "platform": r.get("platform", "?"),
+                "device_kind": r.get("device_kind", "?"),
+                "p99_err_max": r.get("p99_err_max"),
+            }
+        if base is not None:
+            base = {"rate": (base.get("samples_per_sec") or
+                             base.get("items_per_sec")),
+                    "platform": base.get("platform", "?")}
+        rows.append((label, key, r, base))
+    lines.append("| Variant | config | rate | platform | "
+                 "p99 err max | vs baseline |")
+    lines.append("|---|---|---|---|---|---|")
+    for label, key, r, base in rows:
+        if r is None:
+            lines.append(f"| {label} | {key} | (no artifact) "
+                         "| — | — | — |")
+            continue
+        err = (f"{r['p99_err_max']:.4%}"
+               if r.get("p99_err_max") is not None else "—")
+        vs = "—"
+        if base and base["rate"] and r["rate"] and \
+                base["platform"] == r["platform"]:
+            vs = f"{r['rate'] / base['rate'] - 1.0:+.1%}"
+        lines.append(
+            f"| {label} | {key} | {r['rate']:,.0f}/s | "
+            f"{r['platform']} ({r['device_kind']}) | {err} | {vs} |")
+    lines.append("")
+    # Decision rule, applied only over device-measured rows: a lever
+    # becomes the production default when it wins throughput without
+    # pushing p99 max error past the 1% budget.
+    device_rows = [(lb, k, r, b) for lb, k, r, b in rows[1:]
+                   if r and b and r["platform"] == "tpu" and
+                   b["platform"] == "tpu" and r["rate"] and b["rate"]]
+    if device_rows:
+        lines.append("## Production-default picks (device-measured)")
+        for label, key, r, base in device_rows:
+            win = r["rate"] / base["rate"] - 1.0
+            ok_acc = (r.get("p99_err_max") is None or
+                      r["p99_err_max"] <= 0.01)
+            verdict = ("ADOPT" if win > 0.05 and ok_acc else
+                       "keep baseline")
+            lines.append(f"- {label}: {win:+.1%} vs baseline, "
+                         f"acc {'ok' if ok_acc else 'OVER BUDGET'} "
+                         f"→ {verdict}")
+    else:
+        lines.append("_No device-measured baseline yet; table above "
+                     "reports whatever artifacts exist (platform "
+                     "column tells you what they ran on)._")
+    out = os.path.join(HERE, "ab_table.md")
+    with open(out, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

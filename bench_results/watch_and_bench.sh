@@ -1,27 +1,49 @@
 #!/bin/bash
 # Device-link watcher: probe in a loop; on the first healthy probe,
-# run the full bench with a generous budget and save everything.
-# Output: bench_results/watch.log + the orchestrator's own artifacts.
+# run the full bench plus the prepared device A/Bs (merge kernel,
+# tail refinement capacity, f16 plane shipping) in the same healthy
+# window, then summarize into ab_table.md.
+# Output: bench_results/watch.log + per-run JSON artifacts (every one
+# platform-stamped by bench.py itself).
 cd /root/repo
 LOG=bench_results/watch.log
-echo "$(date -u +%FT%TZ) watcher start" >> "$LOG"
-for i in $(seq 1 200); do
+echo "$(date -u +%FT%TZ) watcher start (round 4)" >> "$LOG"
+for i in $(seq 1 400); do
   out=$(timeout 120 python -c "
 from veneur_tpu.utils import devprobe
-print(devprobe.probe_device(45) or 'HEALTHY')" 2>&1 | tail -1)
+import json
+err, info = devprobe.probe_device_info(45)
+print(err or 'HEALTHY ' + json.dumps(info))" 2>&1 | tail -1)
   echo "$(date -u +%FT%TZ) probe[$i]: $out" >> "$LOG"
-  if [ "$out" = "HEALTHY" ]; then
+  case "$out" in HEALTHY*)
     echo "$(date -u +%FT%TZ) link healthy -> full bench" >> "$LOG"
     VENEUR_BENCH_BUDGET=1800 timeout 2100 python bench.py \
         > bench_results/watch_bench_stdout.json 2>> "$LOG"
     echo "$(date -u +%FT%TZ) bench done rc=$?" >> "$LOG"
-    # A/B the dfcumsum merge on the real device, timers config only
-    VENEUR_TPU_MERGE=dfcumsum VENEUR_BENCH_BUDGET=600 timeout 700 \
+    # A/B 1: dfcumsum merge vs scatter, timers config
+    VENEUR_TPU_MERGE=dfcumsum VENEUR_BENCH_BUDGET=420 timeout 500 \
         python bench.py --config 2_timers_10k_series \
-        > bench_results/watch_dfcumsum_c2.json 2>> "$LOG"
+        > bench_results/watch_ab_dfcumsum_c2.json 2>> "$LOG"
     echo "$(date -u +%FT%TZ) dfcumsum A/B done rc=$?" >> "$LOG"
+    # A/B 2: tail refinement off (312-slot plane) — capacity cost
+    VENEUR_TPU_TAIL_REFINE=0 VENEUR_BENCH_BUDGET=420 timeout 500 \
+        python bench.py --config 2_timers_10k_series \
+        > bench_results/watch_ab_tailoff_c2.json 2>> "$LOG"
+    echo "$(date -u +%FT%TZ) tail-refine A/B done rc=$?" >> "$LOG"
+    # A/B 3: f16 plane shipping off — transfer-width cost
+    VENEUR_TPU_F16_PLANE=0 VENEUR_BENCH_BUDGET=420 timeout 500 \
+        python bench.py --config 2_timers_10k_series \
+        > bench_results/watch_ab_f16off_c2.json 2>> "$LOG"
+    echo "$(date -u +%FT%TZ) f16 A/B done rc=$?" >> "$LOG"
+    # dfcumsum also on the global-merge config (centroid-heavy)
+    VENEUR_TPU_MERGE=dfcumsum VENEUR_BENCH_BUDGET=420 timeout 500 \
+        python bench.py --config 4_global_merge_64_locals \
+        > bench_results/watch_ab_dfcumsum_c4.json 2>> "$LOG"
+    echo "$(date -u +%FT%TZ) dfcumsum c4 A/B done rc=$?" >> "$LOG"
+    python bench_results/summarize_ab.py >> "$LOG" 2>&1
+    echo "$(date -u +%FT%TZ) watcher complete" >> "$LOG"
     exit 0
-  fi
+  ;; esac
   sleep 90
 done
 echo "$(date -u +%FT%TZ) watcher exhausted" >> "$LOG"

@@ -29,6 +29,7 @@ matching their modest role in the reference (samplers/samplers.go:307).
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any
@@ -63,6 +64,13 @@ _histo_stats_fold = jax.jit(tdigest._combine_row_stats,
 
 _MIN_BUCKET = 256
 _MIN_BUCKET_WIDE = 8  # for batches whose rows are whole planes
+
+# Device A/B gate: VENEUR_TPU_F16_PLANE=0 forces f32 value planes even
+# for batches whose range fits f16 — for measuring the half-width
+# transfer's throughput win against its ~0.05% mean quantization on
+# real accelerator hardware.
+_F16_PLANE = os.environ.get("VENEUR_TPU_F16_PLANE", "1").lower() \
+    not in ("0", "false", "off")
 
 
 def _bucket_len(n: int, wide: bool = False) -> int:
@@ -1033,7 +1041,7 @@ class MetricTable:
         # overflow to inf.  Stats stay exact either way.  The range
         # scan is skipped for weighted batches (always f32 there).
         f16 = False
-        if unit:
+        if unit and _F16_PLANE:
             av = np.abs(vals)
             vmax = float(av.max(initial=0.0))
             nz = av[av > 0]

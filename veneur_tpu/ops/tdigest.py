@@ -63,9 +63,6 @@ Array = jax.Array
 _MERGE_MODE = os.environ.get("VENEUR_TPU_MERGE", "scatter")
 
 DEFAULT_COMPRESSION = 100.0
-# Plane capacity for the default compression (see module docstring);
-# asin body (300) + clamped tail refinement (305) + slack.
-DEFAULT_CAPACITY = 616
 
 _EPS = 1e-30
 
@@ -97,6 +94,15 @@ _TAIL_Q0 = 0.2     # refinement active where (1-q) < _TAIL_Q0 (p80 up,
 #                    so p90 sits fully inside the refined region)
 _TAIL_QMIN = 1e-4  # clamp: no extra resolution beyond p9999
 
+# Device A/B gate: VENEUR_TPU_TAIL_REFINE=0 turns the tail log-term
+# off, shrinking the plane to the plain-asin 312 slots — for measuring
+# the refinement's capacity cost (sort width) against its accuracy win
+# on real accelerator hardware (it cost ~24% CPU timer throughput at
+# quick scale; the device trade was never measured).
+if os.environ.get("VENEUR_TPU_TAIL_REFINE", "1").lower() in (
+        "0", "false", "off"):
+    _TAIL_MULT = 0.0
+
 
 def capacity_for(compression: float) -> int:
     """Slot capacity: cluster count of the internal scale — the asin
@@ -106,6 +112,12 @@ def capacity_for(compression: float) -> int:
                 int(math.ceil(_TAIL_MULT * compression *
                               math.log(_TAIL_Q0 / _TAIL_QMIN))) + 8)
     return ((clusters + 7) // 8) * 8
+
+
+# Plane capacity for the default compression (see module docstring):
+# asin body (300) + clamped tail refinement (305) + slack = 616, or
+# 312 with the refinement gated off (VENEUR_TPU_TAIL_REFINE=0).
+DEFAULT_CAPACITY = capacity_for(DEFAULT_COMPRESSION)
 
 
 def empty_state(num_rows: int,

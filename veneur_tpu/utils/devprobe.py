@@ -16,6 +16,7 @@ environment:
 
 from __future__ import annotations
 
+import json
 import os
 import random
 import subprocess
@@ -26,20 +27,33 @@ import time
 # The dev image's sitecustomize force-registers the accelerator
 # platform with jax.config.update at interpreter start, overriding the
 # JAX_PLATFORMS env var — so the override knob must itself use
-# jax.config.update after import.
-_PROBE_CODE = ("import os, jax, numpy, jax.numpy as jnp;"
+# jax.config.update after import.  On success the probe prints one
+# JSON line describing the backend it actually touched, so every
+# caller (bench orchestrator, link watcher) can stamp its artifacts
+# with the platform the number was measured on — a CPU capture must
+# never be mistakable for a device capture.
+_PROBE_CODE = ("import os, json, jax, numpy, jax.numpy as jnp;"
                "p = os.environ.get('VENEUR_PROBE_PLATFORM');"
                "p and jax.config.update('jax_platforms', p);"
                "a = jnp.asarray(numpy.zeros(8, numpy.float32));"
-               "a.block_until_ready()")
+               "a.block_until_ready();"
+               "d = jax.devices()[0];"
+               "print(json.dumps({'platform': d.platform,"
+               " 'device_kind': getattr(d, 'device_kind', '?'),"
+               " 'num_devices': jax.device_count(),"
+               " 'jax_version': jax.__version__}))")
 
 
-def probe_device(timeout_s: float) -> str | None:
-    """Returns None when the default backend is reachable, else a
-    one-line error description."""
-    with tempfile.TemporaryFile() as errf:
+def probe_device_info(timeout_s: float) -> tuple[str | None, dict]:
+    """Probe the default backend in a killable subprocess.
+
+    Returns ``(None, info)`` when reachable — ``info`` holds the
+    platform/device_kind/jax_version the probe touched — or
+    ``(error, {})`` with a one-line description otherwise."""
+    with tempfile.TemporaryFile() as errf, \
+            tempfile.TemporaryFile() as outf:
         p = subprocess.Popen([sys.executable, "-c", _PROBE_CODE],
-                             stdout=subprocess.DEVNULL, stderr=errf)
+                             stdout=outf, stderr=errf)
         try:
             rc = p.wait(timeout=timeout_s)
         except subprocess.TimeoutExpired:
@@ -49,25 +63,40 @@ def probe_device(timeout_s: float) -> str | None:
             except subprocess.TimeoutExpired:
                 pass  # uninterruptible child: abandon it
             return (f"probe did not finish in {timeout_s:.0f}s "
-                    "(device link hung)")
+                    "(device link hung)"), {}
         if rc == 0:
-            return None
+            outf.seek(0)
+            line = outf.read().decode(errors="replace").strip()
+            try:
+                info = json.loads(line.splitlines()[-1])
+            except (ValueError, IndexError):
+                info = {}
+            return None, info
         errf.seek(0)
         tail = errf.read().decode(errors="replace").strip()
         lines = tail.splitlines()
         return ("probe failed (rc={}): {}".format(
-            rc, lines[-1] if lines else "no stderr"))
+            rc, lines[-1] if lines else "no stderr")), {}
 
 
-def probe_device_retry(budget_s: float, attempt_s: float = 30.0,
-                       on_attempt=None) -> str | None:
-    """Retry ``probe_device`` in short attempts until one succeeds or
-    ``budget_s`` of wall-clock is spent.  The tunnel link's service
+def probe_device(timeout_s: float) -> str | None:
+    """Returns None when the default backend is reachable, else a
+    one-line error description."""
+    err, _ = probe_device_info(timeout_s)
+    return err
+
+
+def probe_device_retry_info(budget_s: float, attempt_s: float = 30.0,
+                            on_attempt=None
+                            ) -> tuple[str | None, dict]:
+    """Retry ``probe_device_info`` in short attempts until one succeeds
+    or ``budget_s`` of wall-clock is spent.  The tunnel link's service
     quality swings 10-100x and flaps on minute timescales, so one
     monolithic long attempt both wastes the healthy windows (a live
     probe finishes in seconds) and surrenders to a transient stall;
     many short attempts with jittered gaps have materially better
-    odds.  Returns None on the first success, else the LAST error."""
+    odds.  Returns ``(None, info)`` on the first success, else
+    ``(last_error, {})``."""
     deadline = time.monotonic() + budget_s
     last_err: str | None = "probe budget is zero"
     attempt = 0
@@ -78,13 +107,22 @@ def probe_device_retry(budget_s: float, attempt_s: float = 30.0,
         attempt += 1
         if on_attempt is not None:
             on_attempt(attempt, remaining)
-        last_err = probe_device(min(attempt_s, max(remaining, 5.0)))
+        last_err, info = probe_device_info(
+            min(attempt_s, max(remaining, 5.0)))
         if last_err is None:
-            return None
+            return None, info
         # jittered gap so retry cadence doesn't phase-lock with a
         # periodic link stall; never sleep past the deadline
         gap = min(random.uniform(1.0, 4.0),
                   max(deadline - time.monotonic(), 0.0))
         if gap > 0:
             time.sleep(gap)
-    return last_err
+    return last_err, {}
+
+
+def probe_device_retry(budget_s: float, attempt_s: float = 30.0,
+                       on_attempt=None) -> str | None:
+    """Compatibility wrapper: ``probe_device_retry_info`` minus the
+    backend info."""
+    err, _ = probe_device_retry_info(budget_s, attempt_s, on_attempt)
+    return err
