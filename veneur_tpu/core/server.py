@@ -154,6 +154,7 @@ class Server:
         """Everything downstream of table construction — shared by the
         single-chip and mesh-sharded table paths."""
         self.lock = threading.Lock()
+        self.sentry = None  # set by _build_sinks when sentry_dsn is
         self.flusher = Flusher(
             is_local=self.is_local,
             percentiles=tuple(config.percentiles),
@@ -382,10 +383,22 @@ class Server:
                 access_key=c.aws_access_key_id,
                 secret_key=c.aws_secret_access_key))
         if c.sentry_dsn:
-            # no sentry SDK in this build: honest no-op, loudly
-            log.warning("sentry_dsn set but no sentry SDK is "
-                        "available in this build; crash reporting "
-                        "disabled (panics still log with tracebacks)")
+            # SDK-free DSN client (core/sentry.py), matching the
+            # reference's init-if-configured (server.go:357-365) +
+            # error-level log hook attached once (server.go:391-403)
+            from veneur_tpu.core.sentry import (
+                SentryClient, SentryLogHandler)
+            self.sentry = SentryClient(c.sentry_dsn,
+                                       server_name=c.hostname)
+            # latest init wins, like the SDK's global hub: drop any
+            # handler a previous Server attached (it points at a dead
+            # client), then add ours; shutdown() removes it again
+            root = logging.getLogger("veneur_tpu")
+            for h in list(root.handlers):
+                if isinstance(h, SentryLogHandler):
+                    root.removeHandler(h)
+            self._sentry_handler = SentryLogHandler(self.sentry)
+            root.addHandler(self._sentry_handler)
 
     def _build_tls(self):
         """TLS (optionally mutual) for the TCP statsd listener
@@ -500,6 +513,25 @@ class Server:
     # ------------------------------------------------------------------
     # listeners
 
+    def _crashguard(self, fn):
+        """Wrap a thread target so a crashing exception is reported to
+        Sentry (with stack, flushed within the timeout) before it
+        propagates — the reference defers ConsumePanic in every
+        long-lived goroutine (server.go:434,897,994,1040,1376)."""
+        from veneur_tpu.core import sentry as _sentry
+
+        def run(*a, **kw):
+            try:
+                return fn(*a, **kw)
+            except BaseException as e:
+                # consume_panic re-raises; threading.excepthook then
+                # prints the traceback (the analog of panic's stack
+                # dump).  Not logged through the veneur_tpu logger —
+                # the SentryLogHandler would double-report it.
+                _sentry.consume_panic(
+                    self.sentry, self.flusher.hostname, e)
+        return run
+
     def start(self) -> None:
         for addr in self.config.statsd_listen_addresses:
             self._start_statsd(addr)
@@ -514,12 +546,14 @@ class Server:
             s.start()
         if self.config.enable_profiling:
             self._start_profiling()
-        t = threading.Thread(target=self._flush_loop, daemon=True,
+        t = threading.Thread(target=self._crashguard(self._flush_loop),
+                             daemon=True,
                              name="flush")
         t.start()
         self._threads.append(t)
         if self.config.flush_watchdog_missed_flushes > 0:
-            t = threading.Thread(target=self._watchdog, daemon=True,
+            t = threading.Thread(target=self._crashguard(self._watchdog),
+                                 daemon=True,
                                  name="watchdog")
             t.start()
             self._threads.append(t)
@@ -544,7 +578,7 @@ class Server:
                 sock.bind((host, port))
                 port = sock.getsockname()[1]  # resolve port 0 once
                 self._sockets.append(sock)
-                t = threading.Thread(target=self._udp_reader,
+                t = threading.Thread(target=self._crashguard(self._udp_reader),
                                      args=(sock, "dogstatsd-udp"),
                                      daemon=True,
                                      name=f"udp-reader-{i}")
@@ -565,7 +599,7 @@ class Server:
                     do_handshake_on_connect=False)
             self._sockets.append(sock)
             self.statsd_ports.append(sock.getsockname()[1])
-            t = threading.Thread(target=self._tcp_acceptor,
+            t = threading.Thread(target=self._crashguard(self._tcp_acceptor),
                                  args=(sock,), daemon=True,
                                  name="tcp-acceptor")
             t.start()
@@ -577,7 +611,7 @@ class Server:
             sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
             sock.bind(path)
             self._sockets.append(sock)
-            t = threading.Thread(target=self._udp_reader,
+            t = threading.Thread(target=self._crashguard(self._udp_reader),
                                  args=(sock, "dogstatsd-unixgram"),
                                  daemon=True,
                                  name="unixgram-reader")
@@ -663,7 +697,7 @@ class Server:
             sock.bind((host, port))
             self._sockets.append(sock)
             self.ssf_ports.append(sock.getsockname()[1])
-            t = threading.Thread(target=self._ssf_packet_reader,
+            t = threading.Thread(target=self._crashguard(self._ssf_packet_reader),
                                  args=(sock,), daemon=True,
                                  name="ssf-udp")
             t.start()
@@ -676,7 +710,7 @@ class Server:
             sock.bind(path)
             sock.listen(64)
             self._sockets.append(sock)
-            t = threading.Thread(target=self._ssf_stream_acceptor,
+            t = threading.Thread(target=self._crashguard(self._ssf_stream_acceptor),
                                  args=(sock,), daemon=True,
                                  name="ssf-unix")
             t.start()
@@ -1339,10 +1373,22 @@ class Server:
                     "flush watchdog: %.1f intervals without a flush "
                     "(allowed %d) — exiting for supervisor restart",
                     missed, allowed)
+                if self.sentry is not None:
+                    # the log handler above already queued the fatal
+                    # event; bound the drain like ConsumePanic's
+                    # Flush(SentryFlushTimeout) before dying
+                    from veneur_tpu.core.sentry import FLUSH_TIMEOUT
+                    self.sentry.flush(FLUSH_TIMEOUT)
                 os._exit(2)
 
     def shutdown(self) -> None:
         self._shutdown.set()
+        if getattr(self, "_sentry_handler", None) is not None:
+            # don't leave error logs mirroring to a dead client (and
+            # blocking the next Server's handler)
+            logging.getLogger("veneur_tpu").removeHandler(
+                self._sentry_handler)
+            self._sentry_handler = None
         # wake every datagram reader BEFORE closing: on Linux a
         # close() does NOT interrupt a thread blocked in recv, so the
         # reader would sit in the dead syscall until killed mid-C-call

@@ -153,6 +153,13 @@ class Telemetry:
                   tally.get(mtype, 0), (f"metric_type:{mtype}",))
         count("veneur.forward.post_metrics_total",
               self._delta("forward_post_metrics"))
+        sentry_client = getattr(self.server, "sentry", None)
+        if sentry_client is not None:
+            # reference sentry.go:61 reports sentry.errors_total per
+            # delivered crash event
+            self.server.stats["sentry_errors"] = \
+                sentry_client.errors_total
+            count("sentry.errors_total", self._delta("sentry_errors"))
         fwd_ns = self._delta("forward_duration_ns")
         if fwd_ns:
             timer("veneur.forward.duration_ns", fwd_ns)
