@@ -119,6 +119,43 @@ print("SUM", float(s[:64, 0].sum()))
     assert outs["1"] == outs["0"] == float(len(np.arange(64)) * 200)
 
 
+def test_accuracy_soak_quick_smoke():
+    """bench.py --accuracy (VERDICT r3 item 3) runs device-free and
+    emits the full error distribution; quick scale here keeps the
+    suite fast — the committed full-scale artifact
+    (bench_results/accuracy_soak.json) carries the asserted budgets."""
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--accuracy", "--quick"],
+        env={**_ENV, "VENEUR_BENCH_PLATFORM": "cpu"},
+        capture_output=True, text=True, timeout=560,
+        cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr[-2000:]
+    d = json.loads(out.stdout.strip().splitlines()[-1])
+    assert d["mode"] == "accuracy" and d["platform"] == "cpu"
+    t = d["timers"]
+    assert t["p99_err_max"] <= 0.01, t
+    assert d["sets"]["hll_err_mean"] <= 0.02
+
+
+def test_full_scale_accuracy_artifact_committed():
+    """The full-scale soak's artifact must exist, be platform-stamped,
+    and record asserted budgets (the 'committed results file' half of
+    VERDICT item 3)."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench_results", "accuracy_soak.json")
+    with open(path) as f:
+        d = json.load(f)
+    assert d["budgets_asserted"] is True
+    assert d["quick"] is False
+    assert d["timers"]["samples"] == 10_000_000
+    assert d["timers"]["p99_err_max"] <= 0.01
+    assert d["sets"]["uniques_per_series"] == 1000
+    assert d["sets"]["hll_err_mean"] <= 0.01
+    assert "platform" in d and "gates" in d
+
+
 def test_bench_error_line_carries_platform_fields():
     """The dead-link JSON line must still say what it failed to
     reach (bench.py main error path)."""

@@ -403,3 +403,95 @@ def test_proxy_trace_only_config_starts():
     p.route_json_items([{"name": "x", "type": "counter",
                          "tags": [], "value": 1.0}])
     assert p.stats["metrics_dropped"] == 1
+
+
+# ----------------------------------------------------------------------
+# end-to-end: emit wire -> local UDP -> proxy gRPC -> MESH-SHARDED
+# global -> flush (VERDICT r3 item 5 / missing #3; the composition
+# forward_grpc_test.go:19-57 exercises, with the mesh global from
+# SURVEY §2.2 at the end of the chain)
+
+def test_full_chain_emit_to_mesh_sharded_global():
+    """Every tier composed over real loopback sockets, public entry
+    points only: the emit CLI writes DogStatsD wire into the local's
+    UDP socket, the local flush forwards digests/HLLs over gRPC to
+    the proxy, the proxy hash-routes onto the mesh-sharded global
+    (tpu_mesh_shards=4 over the 8 virtual devices), and the global's
+    flush must produce percentiles and cardinalities matching exact
+    values computed host-side."""
+    import socket
+
+    import numpy as np
+
+    from veneur_tpu.cli import emit as emit_cli
+
+    gcap = CaptureSink()
+    g = Server(read_config(data={
+        "grpc_listen_addresses": ["tcp://127.0.0.1:0"],
+        "tpu_mesh_shards": 4,
+        "tpu_histo_rows": 256, "tpu_set_rows": 16,
+        "percentiles": [0.5, 0.99],
+        "interval": "10s",
+        "accelerator_probe_timeout": "0s"}), extra_sinks=[gcap])
+    g.start()
+    proxy = ProxyServer(ProxyConfig(
+        forward_address=f"127.0.0.1:{g.grpc_ports[0]}",
+        grpc_address="127.0.0.1:0"))
+    proxy.start()
+    lcap = CaptureSink()
+    local = Server(read_config(data={
+        "statsd_listen_addresses": ["udp://127.0.0.1:0"],
+        "forward_address": f"127.0.0.1:{proxy.grpc_port}",
+        "forward_use_grpc": True, "interval": "10s",
+        "accelerator_probe_timeout": "0s"}), extra_sinks=[lcap])
+    local.start()
+    try:
+        port = local.statsd_ports[0]
+        hp = f"udp://127.0.0.1:{port}"
+        # the emit CLI generates the wire for one counter and one set
+        # member (public entry point #1)
+        assert emit_cli.main(["-hostport", hp, "-name", "chain.hits",
+                              "-count", "7", "-tag", "env:e2e"]) == 0
+        assert emit_cli.main(["-hostport", hp, "-name", "chain.uniq",
+                              "-set", "member-from-cli"]) == 0
+        # timer volume + set cardinality as raw DogStatsD wire (the
+        # same bytes emit would build, batched for speed)
+        rng = np.random.default_rng(5)
+        vals = rng.gamma(2.0, 30.0, 2000)
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        addr = ("127.0.0.1", port)
+        for i in range(0, 2000, 25):
+            lines = [f"chain.lat:{v}|ms".encode()
+                     for v in vals[i:i + 25]]
+            sock.sendto(b"\n".join(lines), addr)
+        for i in range(400):
+            sock.sendto(f"chain.uniq:u{i}|s".encode(), addr)
+        sock.close()
+        # 2402 datagram-lines ride the kernel socket (2000 timers +
+        # 400 sets + 2 from the CLI); wait for the reader threads to
+        # drain them
+        assert _wait(lambda: local.stats.get("metrics_processed", 0)
+                     >= 2402), local.stats
+        local.flush_once()
+        assert _wait(lambda: g.stats.get("imports_received", 0) >= 1)
+        g.flush_once()
+
+        # local tier: counter value + timer count flush locally
+        lm = {x.name: x for x in lcap.metrics}
+        assert lm["chain.hits"].value == 7.0
+        assert lm["chain.lat.count"].value == 2000.0
+        assert "chain.lat.50percentile" not in lm  # global-only
+
+        # global tier: merged digest percentiles + HLL cardinality
+        gm = {x.name: x for x in gcap.metrics}
+        for q, p in ((0.5, "50percentile"), (0.99, "99percentile")):
+            exact = float(np.quantile(vals, q))
+            got = gm[f"chain.lat.{p}"].value
+            assert abs(got - exact) <= 0.02 * exact, (p, got, exact)
+        # 400 raw members + 1 CLI member; p=14 HLL at this scale
+        assert abs(gm["chain.uniq"].value - 401) <= 12
+        assert proxy.stats["metrics_routed"] >= 2
+    finally:
+        local.shutdown()
+        proxy.shutdown()
+        g.shutdown()

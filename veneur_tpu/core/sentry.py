@@ -92,6 +92,7 @@ class SentryClient:
         self.errors_total = 0  # reported as sentry.errors_total
         self.dropped_total = 0
         self._q: queue.Queue = queue.Queue(maxsize=max_queue)
+        self._closed = False
         self._worker = threading.Thread(target=self._drain,
                                         daemon=True, name="sentry")
         self._worker.start()
@@ -134,6 +135,9 @@ class SentryClient:
             event["extra"] = {k: repr(v) for k, v in extra.items()}
         if tags:
             event["tags"] = {k: str(v) for k, v in tags.items()}
+        if self._closed:
+            self.dropped_total += 1
+            return event_id
         try:
             self._q.put_nowait(event)
         except queue.Full:
@@ -162,9 +166,34 @@ class SentryClient:
 
     # -- transport -----------------------------------------------------
 
+    def close(self) -> None:
+        """Stop the delivery worker (drains what's already queued
+        first).  Without this every Server built with a sentry_dsn
+        would leak a blocked daemon thread per construct/shutdown
+        cycle.  The closed flag (not just a queue sentinel) guarantees
+        the worker exits even when the queue is too full to accept
+        the sentinel — it re-checks the flag before every blocking
+        get."""
+        self._closed = True
+        try:
+            self._q.put_nowait(None)  # pop a blocked get() promptly
+        except queue.Full:
+            pass  # worker is busy; it checks _closed between events
+        self._worker.join(timeout=5.0)
+
     def _drain(self) -> None:
         while True:
-            event = self._q.get()
+            if self._closed and self._q.empty():
+                return
+            try:
+                event = self._q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            if event is None:  # close() sentinel
+                self._q.task_done()
+                if self._closed:
+                    return
+                continue
             try:
                 self._send(event)
                 self.errors_total += 1

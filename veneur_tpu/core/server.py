@@ -71,6 +71,12 @@ def _pem_bytes(value: str) -> bytes:
         return f.read()
 
 
+def tags_to_dict(tags) -> dict[str, str]:
+    """``["k:v", ...]`` config tags -> dict, skipping bare tags — the
+    shape span sinks and the span worker share for common tags."""
+    return dict(t.split(":", 1) for t in tags if ":" in t)
+
+
 def generate_excluded_tags(rules: list[str],
                            sink_name: str) -> list[str]:
     """tags_exclude rules -> tag names excluded for one sink:
@@ -181,8 +187,7 @@ class Server:
             objective_timer_name=config.objective_span_timer_name))
         self.span_worker = SpanWorker(
             self.span_sinks,
-            common_tags=dict(t.split(":", 1) for t in config.tags
-                             if ":" in t),
+            common_tags=tags_to_dict(config.tags),
             capacity=config.span_channel_capacity,
             stats_cb=self.bump,
             workers=config.num_span_workers)
@@ -358,7 +363,11 @@ class Server:
             self.span_sinks.append(XRaySpanSink(
                 c.xray_address,
                 sample_percentage=c.xray_sample_percentage,
-                annotation_tags=tuple(c.xray_annotation_tags)))
+                annotation_tags=tuple(c.xray_annotation_tags),
+                # server-wide tags ride in segment metadata
+                # (reference server.go passes Config.Tags as the
+                # sink's commonTags)
+                common_tags=tags_to_dict(c.tags)))
         if c.lightstep_access_token:
             from veneur_tpu.core.config import parse_duration
             from veneur_tpu.sinks.lightstep import LightStepSpanSink
@@ -1389,6 +1398,9 @@ class Server:
             logging.getLogger("veneur_tpu").removeHandler(
                 self._sentry_handler)
             self._sentry_handler = None
+        if self.sentry is not None:
+            self.sentry.close()
+            self.sentry = None
         # wake every datagram reader BEFORE closing: on Linux a
         # close() does NOT interrupt a thread blocked in recv, so the
         # reader would sit in the dead syscall until killed mid-C-call
