@@ -756,3 +756,68 @@ def test_datadog_events_and_checks_deliver(http_capture):
     assert ev["alert_type"] == "info"
     assert "timestamp" not in ev
     assert "timestamp" not in checks[0]
+
+
+# ----------------------------------------------------------------------
+# flush-file reference schema (plugins/s3/csv.go + csv_test.go goldens)
+
+def test_reference_tsv_golden_rows():
+    """Byte-exact rows from the reference's own csv_test.go cases:
+    gauge passthrough, counter->rate conversion, and csv-quoting of a
+    field containing the delimiter."""
+    import time as _time
+
+    from veneur_tpu.core.metrics import InterMetric
+    from veneur_tpu.sinks.simple import _tsv_rows_reference
+
+    part = _time.strftime("%Y%m%d", _time.gmtime())
+    gauge = InterMetric(name="a.b.c.max", timestamp=1476119058,
+                        value=100.0, tags=("foo:bar", "baz:quz"),
+                        type="gauge")
+    counter = InterMetric(name="a.b.c.max", timestamp=1476119058,
+                          value=100.0, tags=("foo:bar", "baz:quz"),
+                          type="counter")
+    tabbed = InterMetric(name="a.b.c.count", timestamp=1476119058,
+                         value=100.0, tags=("foo:b\tar", "baz:quz"),
+                         type="counter")
+    out = _tsv_rows_reference([gauge, counter, tabbed],
+                              "testbox-c3eac9", 10.0)
+    rows = out.splitlines()
+    assert rows[0] == ("a.b.c.max\t{foo:bar,baz:quz}\tgauge\t"
+                       f"testbox-c3eac9\t10\t2016-10-10 05:04:18\t"
+                       f"100\t{part}")
+    assert rows[1] == ("a.b.c.max\t{foo:bar,baz:quz}\trate\t"
+                       f"testbox-c3eac9\t10\t2016-10-10 05:04:18\t"
+                       f"10\t{part}")
+    # field containing a tab is csv-quoted whole (csv_test.go TabTag)
+    assert rows[2] == ("a.b.c.count\t\"{foo:b\tar,baz:quz}\"\trate\t"
+                       f"testbox-c3eac9\t10\t2016-10-10 05:04:18\t"
+                       f"10\t{part}")
+
+
+def test_flush_file_format_reference_end_to_end(tmp_path):
+    """flush_file_format: reference drives the server's localfile
+    plugin through the reference schema."""
+    from veneur_tpu.core.config import read_config
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.protocol import dogstatsd as dsd
+
+    path = tmp_path / "flush.tsv"
+    srv = Server(read_config(data={
+        "interval": "10s", "hostname": "h0",
+        "flush_file": str(path),
+        "flush_file_format": "reference",
+        "accelerator_probe_timeout": "0s"}))
+    try:
+        srv.table.ingest(dsd.Sample(name="ref.hits", type=dsd.COUNTER,
+                                    value=20.0))
+        srv.flush_once()
+    finally:
+        srv.shutdown()
+    rows = [r.split("\t") for r in path.read_text().splitlines()]
+    hit = [r for r in rows if r[0] == "ref.hits"]
+    assert hit, rows
+    # 8 reference columns; counter arrives as a 2.0/s rate
+    assert len(hit[0]) == 8
+    assert hit[0][2] == "rate" and hit[0][6] == "2"
+    assert hit[0][4] == "10"

@@ -157,6 +157,71 @@ def test_indicator_metrics():
         _span(), "a", "b") == []
 
 
+def test_span_uniqueness_metrics():
+    """reference ConvertSpanUniquenessMetrics (samplers/parser.go:
+    183-208): a delivery-sampled ssf.names_unique Set tagged by
+    service/indicator/root-ness."""
+    span = _span(indicator=True)
+    # rate=1 (deterministic accept)
+    out = ssf_convert.convert_span_uniqueness_metrics(span, rate=1.1)
+    assert len(out) == 1
+    m = out[0]
+    assert m.name == "ssf.names_unique" and m.type == dsd.SET
+    assert m.value == span.name.encode()
+    assert "service:svc" in m.tags and "indicator:true" in m.tags
+    root_tag = [t for t in m.tags if t.startswith("root_span:")]
+    assert root_tag == [
+        f"root_span:{'true' if span.id == span.trace_id else 'false'}"]
+    # deterministic reject
+    assert ssf_convert.convert_span_uniqueness_metrics(
+        span, rate=0.01, _random=lambda: 0.5) == []
+    # accepted roll below rate
+    assert len(ssf_convert.convert_span_uniqueness_metrics(
+        span, rate=0.01, _random=lambda: 0.001)) == 1
+    # no service -> nothing
+    ns = _span()
+    ns.service = ""
+    assert ssf_convert.convert_span_uniqueness_metrics(
+        ns, rate=1.1) == []
+
+
+def test_extraction_sink_counts_and_error_total():
+    """ssfmetrics counts spans/metrics and self-reports invalid
+    extraction as ssf.error_total into its own pipeline (reference
+    metrics.go:82-137); the telemetry tick emits per-span-sink
+    veneur.sink.* counters (sinks.go MetricKeyTotal*)."""
+    from veneur_tpu.core.config import read_config
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.sinks.simple import CaptureSink
+
+    cap = CaptureSink()
+    srv = Server(read_config(data={
+        "interval": "10s", "hostname": "h",
+        "accelerator_probe_timeout": "0s"}), extra_sinks=[cap])
+    ext = srv.span_sinks[0]
+    assert ext.name == "ssfmetrics"
+    span = _span(indicator=False)
+    span.metrics.append(_sample())
+    span.metrics.append(ssf_pb2.SSFSample(name="", value=1))  # invalid
+    ext.ingest(span)
+    assert ext.submitted == 1
+    assert ext.metrics_generated >= 2  # valid sample + error counter
+    srv.flush_once()
+    srv.flush_once()  # telemetry loopback surfaces next interval
+    metrics = [m for b in cap.batches for m in b]
+    names = {m.name for m in metrics}
+    assert "ssf.error_total" in names
+    flushed = [m for m in metrics
+               if m.name == "veneur.sink.spans_flushed_total"
+               and "sink:ssfmetrics" in m.tags]
+    assert flushed and flushed[0].value >= 1
+    gen = [m for m in metrics
+           if m.name == "veneur.sink.metrics_flushed_total"
+           and "sink:ssfmetrics" in m.tags]
+    assert gen and gen[0].value >= 2
+    srv.shutdown()
+
+
 # ----------------------------------------------------------------------
 # server integration over real sockets
 

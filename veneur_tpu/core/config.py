@@ -167,6 +167,10 @@ class Config:
     prometheus_repeater_address: str = ""
     prometheus_network_type: str = "tcp"
     flush_file: str = ""  # localfile plugin
+    # "native" (readable raw values) or "reference" (byte-exact
+    # plugins/s3/csv.go schema: rate conversion, Redshift timestamp,
+    # partition column) — applies to flush_file AND the s3 plugin
+    flush_file_format: str = "native"
     aws_s3_bucket: str = ""
     aws_region: str = ""
     # SigV4 credentials for the s3 plugin; empty falls back to the
@@ -366,6 +370,9 @@ class Config:
         if self.forward_json_schema not in ("reference", "native"):
             problems.append(
                 "forward_json_schema must be 'reference' or 'native'")
+        if self.flush_file_format not in ("native", "reference"):
+            problems.append(
+                "flush_file_format must be 'native' or 'reference'")
         if self.percentile_naming not in ("precise", "reference"):
             problems.append(
                 "percentile_naming must be 'precise' or 'reference'")

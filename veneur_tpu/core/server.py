@@ -382,15 +382,17 @@ class Server:
             from veneur_tpu.sinks.grpsink import FalconerSpanSink
             self.span_sinks.append(FalconerSpanSink(c.falconer_address))
         if c.flush_file:
-            self.plugins.append(LocalFilePlugin(c.flush_file,
-                                                c.hostname))
+            self.plugins.append(LocalFilePlugin(
+                c.flush_file, c.hostname,
+                fmt=c.flush_file_format, interval=self.interval))
         if c.aws_s3_bucket:
             from veneur_tpu.sinks.s3 import S3Plugin
             self.plugins.append(S3Plugin(
                 c.aws_s3_bucket, hostname=c.hostname,
                 region=c.aws_region, endpoint=c.aws_s3_endpoint,
                 access_key=c.aws_access_key_id,
-                secret_key=c.aws_secret_access_key))
+                secret_key=c.aws_secret_access_key,
+                fmt=c.flush_file_format, interval=self.interval))
         if c.sentry_dsn:
             # SDK-free DSN client (core/sentry.py), matching the
             # reference's init-if-configured (server.go:357-365) +

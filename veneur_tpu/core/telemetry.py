@@ -176,6 +176,24 @@ class Telemetry:
         for sink_name, dur_ns in sink_durations.items():
             timer("veneur.sink.metric_flush_total_duration_ns", dur_ns,
                   (f"sink:{sink_name}",))
+        # per-span-sink delivery counters (reference sinks.go
+        # MetricKeyTotalSpansFlushed/Dropped/Skipped, reported by each
+        # sink's Flush via the trace client; here the sinks keep plain
+        # counters and the tick reads the deltas)
+        for sink in getattr(self.server, "span_sinks", []):
+            sname = getattr(sink, "name", type(sink).__name__)
+            for attr, metric in (
+                    ("submitted", "veneur.sink.spans_flushed_total"),
+                    ("dropped", "veneur.sink.spans_dropped_total"),
+                    ("skipped", "veneur.sink.spans_skipped_total"),
+                    ("metrics_generated",
+                     "veneur.sink.metrics_flushed_total")):
+                cur = getattr(sink, attr, None)
+                if cur is None:
+                    continue
+                key = f"span_sink_{sname}_{attr}"
+                self.server.stats[key] = int(cur)
+                count(metric, self._delta(key), (f"sink:{sname}",))
 
         # import response timing (reference README:
         # veneur.import.response_duration_ns)

@@ -114,3 +114,27 @@ def convert_indicator_metrics(span: ssf_pb2.SSFSpan,
                               type=dsd.TIMER, value=duration_ns,
                               tags=tags, scope=dsd.SCOPE_GLOBAL))
     return out
+
+
+def convert_span_uniqueness_metrics(span: ssf_pb2.SSFSpan,
+                                    rate: float = 0.01,
+                                    _random=None) -> list[dsd.Sample]:
+    """Span-population uniqueness sketch (reference
+    ConvertSpanUniquenessMetrics, samplers/parser.go:183-208): a Set
+    sample ``ssf.names_unique`` counting unique span NAMES per
+    service, tagged by indicator and root-ness, delivery-sampled at
+    ``rate`` (reference ssf.RandomlySample, ssf/samples.go:128 — sets
+    dedupe, so sampling thins delivery, not the count's meaning)."""
+    if not span.service:
+        return []
+    import random as _rand
+    roll = (_random if _random is not None else _rand.random)()
+    if roll >= rate:
+        return []
+    is_root = span.id == span.trace_id
+    tags = tuple(sorted((
+        f"indicator:{'true' if span.indicator else 'false'}",
+        f"service:{span.service}",
+        f"root_span:{'true' if is_root else 'false'}")))
+    return [dsd.Sample(name="ssf.names_unique", type=dsd.SET,
+                       value=span.name.encode(), tags=tags)]

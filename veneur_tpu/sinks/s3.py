@@ -109,7 +109,8 @@ class S3Plugin:
                  region: str = "", endpoint: str = "",
                  access_key: str = "", secret_key: str = "",
                  session_token: str = "", spool_dir: str = "s3_spool",
-                 timeout: float = 10.0):
+                 timeout: float = 10.0, fmt: str = "native",
+                 interval: float = 10.0):
         self.bucket = bucket
         self.hostname = hostname
         self.region = region or "us-east-1"
@@ -123,17 +124,20 @@ class S3Plugin:
                               env.get("AWS_SESSION_TOKEN", ""))
         self.spool_dir = spool_dir
         self.timeout = timeout
+        self.fmt = fmt
+        self.interval = interval
         self.errors = 0
 
     def _key(self, host: str) -> str:
         return f"{host}/{int(time.time() * 1e9)}.tsv.gz"
 
     def flush(self, metrics: list, hostname: str = "") -> None:
-        from veneur_tpu.sinks.simple import _tsv_rows
+        from veneur_tpu.sinks.simple import encode_flush_rows
         host = hostname or self.hostname or "unknown"
         buf = io.BytesIO()
         with gzip.GzipFile(fileobj=buf, mode="wb") as gz:
-            gz.write(_tsv_rows(metrics, host).encode())
+            gz.write(encode_flush_rows(metrics, host, self.fmt,
+                                       self.interval).encode())
         body = buf.getvalue()
         key = self._key(host)
         if self.access_key and self.secret_key:

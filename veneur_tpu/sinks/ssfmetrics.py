@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import logging
 
-from veneur_tpu.protocol import ssf_convert
+from veneur_tpu.protocol import dogstatsd as dsd, ssf_convert
 
 log = logging.getLogger("veneur_tpu.sinks")
 
@@ -20,10 +20,15 @@ class MetricExtractionSink:
     name = "ssfmetrics"
 
     def __init__(self, server, indicator_timer_name: str = "",
-                 objective_timer_name: str = ""):
+                 objective_timer_name: str = "",
+                 uniqueness_rate: float = 0.01):
         self._server = server
         self.indicator_timer_name = indicator_timer_name
         self.objective_timer_name = objective_timer_name
+        self.uniqueness_rate = uniqueness_rate
+        self.submitted = 0         # spans processed
+        self.metrics_generated = 0
+        self.dropped = 0           # extracted but table-dropped
 
     def start(self) -> None:
         pass
@@ -33,10 +38,31 @@ class MetricExtractionSink:
         samples.extend(ssf_convert.convert_indicator_metrics(
             span, self.indicator_timer_name,
             self.objective_timer_name))
+        # span-population uniqueness sketch, delivery-sampled
+        # (reference metrics.go:128 ConvertSpanUniquenessMetrics at
+        # a fixed 1% rate)
+        samples.extend(ssf_convert.convert_span_uniqueness_metrics(
+            span, self.uniqueness_rate))
         if invalid:
+            # counted into the pipeline itself like the reference's
+            # self-reported ssf.error_total (metrics.go:92-106)
             self._server.bump("ssf_invalid_samples", invalid)
+            samples.append(dsd.Sample(
+                name="ssf.error_total", type=dsd.COUNTER,
+                value=float(invalid),
+                tags=("packet_type:ssf_metric",
+                      "reason:invalid_metrics",
+                      "step:extract_metrics")))
+        self.submitted += 1
         for s in samples:
-            self._server.ingest_parsed(s)
+            # flushed-vs-dropped must track what the TABLE accepted,
+            # or the metrics_flushed_total counter hides data loss in
+            # exactly the overload window it exists for
+            _, was_dropped = self._server.ingest_parsed(s)
+            if was_dropped:
+                self.dropped += 1
+            else:
+                self.metrics_generated += 1
 
     def flush(self) -> None:
         pass
