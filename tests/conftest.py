@@ -30,3 +30,59 @@ import pytest  # noqa: E402
 @pytest.fixture(scope="session")
 def devices():
     return jax.devices()
+
+
+# ----------------------------------------------------------------------
+# shared fake Sentry DSN endpoint (used by test_sentry and
+# test_failure; envelope protocol per core/sentry.py)
+
+import http.server as _http_server  # noqa: E402
+import json as _json  # noqa: E402
+import threading as _threading  # noqa: E402
+
+
+class FakeDSNServer:
+    """Collects Sentry envelope POSTs: (path, auth header, event)."""
+
+    def __init__(self):
+        received = self.received = []
+
+        class Handler(_http_server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+                lines = body.split(b"\n")
+                event = (_json.loads(lines[2])
+                         if len(lines) >= 3 else {})
+                received.append((self.path,
+                                 self.headers.get("X-Sentry-Auth", ""),
+                                 event))
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = _http_server.HTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        _threading.Thread(target=self.httpd.serve_forever,
+                          daemon=True).start()
+
+    @property
+    def events(self):
+        return [e for _, _, e in self.received]
+
+    def dsn(self, project: int = 42) -> str:
+        return f"http://pubkey@127.0.0.1:{self.port}/{project}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture
+def dsn_server():
+    s = FakeDSNServer()
+    yield s
+    s.close()

@@ -50,7 +50,57 @@ def test_watchdog_exits_after_missed_flushes(make_server, monkeypatch):
     deadline = time.monotonic() + 2.0
     while not exits and time.monotonic() < deadline:
         time.sleep(0.02)
-    assert exits == [2]
+    # disarm AND join before monkeypatch teardown restores the real
+    # os._exit (the watchdog thread outlives the test body otherwise)
+    _join_watchdog(server)
+    assert exits and set(exits) == {2}
+
+
+def _join_watchdog(server, timeout=15.0):
+    """Disarm the watchdog and JOIN its thread: teardown restores the
+    real os._exit before the server fixture shuts down (LIFO), so a
+    watchdog mid-loop-body would kill the pytest process itself.
+    Setting the flags is not enough — the thread must be DEAD before
+    the test returns."""
+    server._shutdown.set()
+    server.last_flush = time.monotonic()
+    for t in server._threads:
+        if t.name == "watchdog":
+            t.join(timeout)
+            assert not t.is_alive(), "watchdog thread failed to stop"
+
+
+def test_watchdog_reports_to_sentry_before_exit(make_server,
+                                                monkeypatch,
+                                                dsn_server):
+    """The watchdog's fatal event must be AT the DSN endpoint before
+    os._exit fires (the sentry flush in the exit path; reference
+    sentry.go's Flush-before-die contract)."""
+    server, _ = make_server(flush_watchdog_missed_flushes=2,
+                            sentry_dsn=dsn_server.dsn(3))
+    try:
+        exits = []
+        events_at_exit = []
+
+        def fake_exit(code):
+            # snapshot what had ARRIVED when exit fired — delivery
+            # after the exit would be lost in a real process
+            events_at_exit.append(list(dsn_server.events))
+            exits.append(code)
+
+        monkeypatch.setattr("os._exit", fake_exit)
+        server.last_flush = time.monotonic() - 10 * server.interval
+        deadline = time.monotonic() + 5.0
+        while not exits and time.monotonic() < deadline:
+            time.sleep(0.02)
+        # the loop can fire again in the polling gap; every exit is 2
+        assert exits and set(exits) == {2}
+        fatal = [e for e in events_at_exit[0]
+                 if e.get("level") == "fatal"]
+        assert fatal, events_at_exit[0]
+        assert "watchdog" in fatal[0]["message"]["formatted"]
+    finally:
+        _join_watchdog(server)
 
 
 def test_forward_to_dead_global_drops_and_counts(make_server):

@@ -11,8 +11,6 @@ method was called.
 
 from __future__ import annotations
 
-import http.server
-import json
 import logging
 import threading
 import time
@@ -21,47 +19,8 @@ import pytest
 
 from veneur_tpu.core import sentry as vsentry
 
-
-class _FakeDSNServer:
-    """Collects Sentry envelope POSTs: (path, auth header, event)."""
-
-    def __init__(self):
-        received = self.received = []
-
-        class Handler(http.server.BaseHTTPRequestHandler):
-            def do_POST(self):
-                body = self.rfile.read(
-                    int(self.headers.get("Content-Length", 0)))
-                lines = body.split(b"\n")
-                event = json.loads(lines[2]) if len(lines) >= 3 else {}
-                received.append((self.path,
-                                 self.headers.get("X-Sentry-Auth", ""),
-                                 event))
-                self.send_response(200)
-                self.end_headers()
-                self.wfile.write(b"{}")
-
-            def log_message(self, *a):
-                pass
-
-        self.httpd = http.server.HTTPServer(("127.0.0.1", 0), Handler)
-        self.port = self.httpd.server_address[1]
-        threading.Thread(target=self.httpd.serve_forever,
-                         daemon=True).start()
-
-    def dsn(self, project: int = 42) -> str:
-        return f"http://pubkey@127.0.0.1:{self.port}/{project}"
-
-    def close(self):
-        self.httpd.shutdown()
-        self.httpd.server_close()
-
-
-@pytest.fixture
-def dsn_server():
-    s = _FakeDSNServer()
-    yield s
-    s.close()
+# the fake DSN endpoint + dsn_server fixture live in conftest.py
+# (FakeDSNServer), shared with test_failure's watchdog test
 
 
 def test_parse_dsn_shapes():
