@@ -628,6 +628,106 @@ def accuracy_soak() -> dict:
     return out
 
 
+def sockets_bench() -> dict:
+    """``--sockets``: end-to-end UDP ingest over real loopback
+    sockets — the surface behind the reference's only published
+    ingest number (>60k packets/sec in production,
+    /root/reference/README.md:310-312).  A loadgen thread blasts
+    DogStatsD datagrams at a live Server (SO_REUSEPORT readers,
+    recvmmsg drain, native parse, device table) and the server's own
+    stats report what was received and aggregated.  Loadgen and
+    server share the host core here, so the figure UNDERSTATES an
+    isolated server.  Two shapes: single-metric packets (the
+    reference's production shape) and 25-line batched packets."""
+    import socket as socket_mod
+    import threading
+
+    from veneur_tpu.core.config import read_config
+    from veneur_tpu.core.server import Server
+
+    out: dict = {"mode": "sockets", "quick": QUICK}
+    duration = 5.0 if QUICK else 12.0
+
+    for label, lines_per_packet in (("single_line", 1),
+                                    ("batch_25", 25)):
+        srv = Server(read_config(data={
+            "statsd_listen_addresses": ["udp://127.0.0.1:0"],
+            "interval": "3s",
+            "hostname": "bench",
+            "accelerator_probe_timeout": "5s"}))
+        srv.start()
+        try:
+            port = srv.statsd_ports[0]
+            # pre-built datagrams: 1k names, realistic counter lines
+            pkts = []
+            for i in range(4096):
+                lines = [
+                    f"svc.req.count."
+                    f"{(i * lines_per_packet + j) % 1000}:"
+                    f"{1 + (j % 9)}|c".encode()
+                    for j in range(lines_per_packet)]
+                pkts.append(b"\n".join(lines))
+            sent = [0]
+            stop = threading.Event()
+
+            def blast():
+                s = socket_mod.socket(socket_mod.AF_INET,
+                                      socket_mod.SOCK_DGRAM)
+                s.connect(("127.0.0.1", port))
+                n = 0
+                while not stop.is_set():
+                    # burst between stop checks; send() can drop at
+                    # rcvbuf pressure — that's the measurement
+                    for p in pkts:
+                        try:
+                            s.send(p)
+                        except OSError:
+                            pass
+                        n += 1
+                    sent[0] = n
+                s.close()
+
+            base_pkts = srv.stats.get("packets_received", 0)
+            base_metrics = srv.stats.get("metrics_processed", 0)
+            t = threading.Thread(target=blast, daemon=True)
+            t0 = time.perf_counter()
+            t.start()
+            time.sleep(duration)
+            stop.set()
+            t.join(10.0)
+            dt = time.perf_counter() - t0
+            # let in-flight reader batches drain before reading stats
+            time.sleep(0.5)
+            got_pkts = srv.stats.get("packets_received", 0) - base_pkts
+            got_metrics = (srv.stats.get("metrics_processed", 0) -
+                           base_metrics)
+            out[label] = {
+                "seconds": round(dt, 3),
+                "offered_packets": sent[0],
+                "received_packets": got_pkts,
+                "received_pct": round(100.0 * got_pkts /
+                                      max(sent[0], 1), 1),
+                "packets_per_sec": round(got_pkts / dt, 1),
+                "metrics_per_sec": round(got_metrics / dt, 1),
+                "vs_reference_60k": round(got_pkts / dt / 60_000.0, 2),
+            }
+        finally:
+            srv.shutdown()
+
+    out.update(_backend_info())
+    out["captured_unix"] = round(time.time(), 1)
+    try:
+        os.makedirs(os.path.dirname(CKPT_DIR), exist_ok=True)
+        path = os.path.join(
+            os.path.dirname(CKPT_DIR),
+            f"sockets_bench{'.quick' if QUICK else ''}.json")
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+    except OSError:
+        pass
+    return out
+
+
 CONFIGS = (
     ("0_counters_1k_names", bench_counters),
     ("1_cardinality_100k", bench_cardinality),
@@ -821,6 +921,10 @@ if __name__ == "__main__":
             import jax
             jax.config.update("jax_platforms", "cpu")
         print(json.dumps(accuracy_soak()))
+    elif "--sockets" in sys.argv:
+        # the server probes and falls back on its own; the pin (when
+        # set) is honored via the module-top jax.config.update
+        print(json.dumps(sockets_bench()))
     elif "--config" in sys.argv:
         _run_one_config(sys.argv[sys.argv.index("--config") + 1])
     else:

@@ -156,6 +156,24 @@ def test_full_scale_accuracy_artifact_committed():
     assert "platform" in d and "gates" in d
 
 
+def test_sockets_bench_artifact_committed():
+    """bench.py --sockets captures the real-socket ingest surface
+    behind the reference's 60k packets/s production headline
+    (README.md:310-312); the committed artifact must beat it and be
+    platform-stamped."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench_results", "sockets_bench.json")
+    with open(path) as f:
+        d = json.load(f)
+    assert d["mode"] == "sockets" and d["quick"] is False
+    single = d["single_line"]
+    assert single["packets_per_sec"] > 60_000  # the reference bar
+    assert single["received_pct"] > 80.0
+    assert d["batch_25"]["metrics_per_sec"] > 1_000_000
+    assert "platform" in d and "gates" in d
+
+
 def test_bench_error_line_carries_platform_fields():
     """The dead-link JSON line must still say what it failed to
     reach (bench.py main error path)."""
