@@ -607,6 +607,48 @@ def accuracy_soak() -> dict:
         "hll_err_p99": float(np.quantile(hll_err, 0.99)),
     }
 
+    # ---- distribution sweep (reference tdigest/analysis model:
+    # uniform/normal/exponential + heavy tails; SURVEY §4d) ---------
+    dists = {
+        "uniform": lambda r, k: r.uniform(0.0, 1000.0, k),
+        "normal": lambda r, k: r.normal(500.0, 120.0, k),
+        "exponential": lambda r, k: r.exponential(200.0, k),
+        "pareto_a3": lambda r, k: (r.pareto(3.0, k) + 1.0) * 100.0,
+        "lognormal_s2": lambda r, k: r.lognormal(3.0, 2.0, k),
+    }
+    d_series = 100 // (SCALE if QUICK else 1) or 1
+    d_per = 20_000
+    out["distributions"] = {}
+    import zlib as _zlib
+    for dname, gen in dists.items():
+        # crc32, not hash(): string hashing is per-process randomized
+        rngd = np.random.default_rng(_zlib.crc32(dname.encode()))
+        table = _mk_table(histo_rows=d_series, histo_slots=2048,
+                          histo_merge_samples=1 << 30)
+        all_vals = gen(rngd, d_series * d_per).astype(np.float32)
+        rows_d = np.repeat(np.arange(d_series, dtype=np.int32), d_per)
+        for i in range(0, len(rows_d), chunk):
+            table._histo_stage.append(
+                rows_d[i:i + chunk], all_vals[i:i + chunk],
+                np.ones(len(rows_d[i:i + chunk]), np.float32))
+            table.device_step()
+        snap = table.swap()
+        quant_d = np.asarray(tdigest.quantile(
+            snap.histo_means, snap.histo_weights, qs_dev,
+            snap.histo_stats[:, 1], snap.histo_stats[:, 2]))
+        errs = {p: [] for p in ps}
+        for s in range(d_series):
+            sv = all_vals[s * d_per:(s + 1) * d_per]
+            exact = np.quantile(sv, ps)
+            for qi, p in enumerate(ps):
+                errs[p].append(abs(quant_d[s, qi] - exact[qi]) /
+                               max(abs(exact[qi]), 1e-9))
+        out["distributions"][dname] = {
+            **{f"{labels[p]}_err_max": float(np.max(errs[p]))
+               for p in ps},
+            **{f"{labels[p]}_err_mean": float(np.mean(errs[p]))
+               for p in ps}}
+
     out.update(_backend_info())
     out["captured_unix"] = round(time.time(), 1)
     if not QUICK:
@@ -630,6 +672,19 @@ def accuracy_soak() -> dict:
         s = out["sets"]
         assert s["hll_err_mean"] <= 0.01, s
         assert s["hll_err_max"] <= 0.04, s
+        # every distribution inside the 1% budget at every tracked
+        # quantile, max over all series — except lognormal sigma=2,
+        # whose p99 value-space tail span is so extreme that the
+        # reference's own k1 scale would sit near 3.5% there; the
+        # refined tail holds its worst series to ~1.1% (mean far
+        # below), budgeted at 2%
+        for dname, derr in out["distributions"].items():
+            budget = 0.02 if dname == "lognormal_s2" else 0.01
+            for k, v in derr.items():
+                if k.endswith("_err_max"):
+                    assert v <= budget, (dname, k, v)
+                else:
+                    assert v <= 0.005, (dname, k, v)
         out["budgets_asserted"] = True
     _save_artifact("accuracy_soak", out)
     return out
@@ -652,8 +707,11 @@ def sockets_bench() -> dict:
     from veneur_tpu.core.config import read_config
     from veneur_tpu.core.server import Server
 
+    import resource
+
     out: dict = {"mode": "sockets", "quick": QUICK}
     duration = 5.0 if QUICK else 12.0
+    rss0_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
 
     for label, lines_per_packet in (("single_line", 1),
                                     ("batch_25", 25)):
@@ -721,6 +779,11 @@ def sockets_bench() -> dict:
         finally:
             srv.shutdown()
 
+    # memory story (reference publishes memory.png): peak process RSS
+    # across both load shapes — server + loadgen + parser scratch
+    rss1_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    out["peak_rss_mb"] = round(rss1_kb / 1024.0, 1)
+    out["rss_grew_mb"] = round((rss1_kb - rss0_kb) / 1024.0, 1)
     out.update(_backend_info())
     out["captured_unix"] = round(time.time(), 1)
     _save_artifact("sockets_bench", out)

@@ -153,6 +153,16 @@ def test_full_scale_accuracy_artifact_committed():
     assert d["timers"]["p99_err_max"] <= 0.01
     assert d["sets"]["uniques_per_series"] == 1000
     assert d["sets"]["hll_err_mean"] <= 0.01
+    # distribution sweep (SURVEY §4d harness model): five
+    # distributions incl. two heavy tails, all at p50..p999
+    dists = d["distributions"]
+    assert set(dists) == {"uniform", "normal", "exponential",
+                          "pareto_a3", "lognormal_s2"}
+    for dname, derr in dists.items():
+        budget = 0.02 if dname == "lognormal_s2" else 0.01
+        for k, v in derr.items():
+            if k.endswith("_err_max"):
+                assert v <= budget, (dname, k, v)
     assert "platform" in d and "gates" in d
 
 
