@@ -163,6 +163,8 @@ def test_full_scale_accuracy_artifact_committed():
         for k, v in derr.items():
             if k.endswith("_err_max"):
                 assert v <= budget, (dname, k, v)
+            else:
+                assert v <= 0.005, (dname, k, v)
     assert "platform" in d and "gates" in d
 
 

@@ -686,7 +686,9 @@ def test_prometheus_repeater_udp():
     s.flush([_metric("prom.c", 4.0, COUNTER, tags=("a:b",)),
              _metric("prom.g", 1.5)])
     got = {sock.recv(1024).decode().strip() for _ in range(2)}
-    assert got == {"prom.c:4.0|c|#a:b", "prom.g:1.5|g"}
+    # "|#" always present, tags or not (reference prometheus.go:27);
+    # integral values render Go-%v style without a decimal point
+    assert got == {"prom.c:4|c|#a:b", "prom.g:1.5|g|#"}
     sock.close()
 
 
@@ -701,7 +703,7 @@ def test_prometheus_repeater_tcp():
                                network_type="tcp")
     s.flush([_metric("prom.t", 2.0, COUNTER)])
     conn, _ = lsock.accept()
-    assert conn.recv(1024) == b"prom.t:2.0|c\n"
+    assert conn.recv(1024) == b"prom.t:2|c|#\n"
     conn.close()
     lsock.close()
 

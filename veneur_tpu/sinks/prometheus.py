@@ -43,10 +43,23 @@ class PrometheusRepeaterSink(SinkBase):
         self.network_type = network_type
 
     @staticmethod
-    def _line(m: InterMetric) -> bytes:
+    def _fmt_value(v: float) -> str:
+        """Go %v float rendering (template Execute -> FormatFloat
+        'g' -1): integral values print WITHOUT a decimal point.
+        Python repr agrees with Go's shortest form elsewhere in the
+        value ranges metrics occupy (both flip to e-notation for
+        tiny magnitudes)."""
+        if v == int(v) and abs(v) < 1e15:
+            return str(int(v))
+        return repr(v)
+
+    def _line(self, m: InterMetric) -> bytes:
+        # the reference's template is "{Name}:{Value}|{Type}|#{Tags}"
+        # (prometheus.go:27) — the "|#" section is ALWAYS present,
+        # even with no tags; keep byte parity
         token = "c" if m.type == COUNTER else "g"
-        tags = f"|#{','.join(m.tags)}" if m.tags else ""
-        return f"{m.name}:{m.value}|{token}{tags}\n".encode()
+        return (f"{m.name}:{self._fmt_value(m.value)}|{token}|#"
+                f"{','.join(m.tags)}\n").encode()
 
     def flush(self, metrics: list[InterMetric]) -> None:
         if not metrics:
