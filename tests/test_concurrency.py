@@ -197,3 +197,61 @@ def test_ticker_and_manual_flush_serialize_and_conserve():
         assert got == total, (got, total)
     finally:
         srv.shutdown()
+
+
+def test_mesh_sharded_server_conserves_under_concurrent_flushes():
+    """The single-chip conservation property must hold on the
+    MESH-SHARDED server path too (tpu_mesh_shards; ShardedTable
+    staging + collective merge behind the same server lock): writer
+    threads racing a flusher thread across swap boundaries must
+    account for exactly every counter sample and every timer count,
+    and set cardinality within estimator error."""
+    srv = _mk(tpu_mesh_shards=4, tpu_histo_rows=256, tpu_set_rows=32,
+              accelerator_probe_timeout="0s")
+    writers = 4
+    batches = 20
+    per_batch = 25
+    stop = threading.Event()
+    results = []
+
+    def writer(wid: int):
+        for b in range(batches):
+            lines = [f"mrace.ctr:2|c|#w:{wid}".encode()
+                     for _ in range(per_batch)]
+            lines += [f"mrace.lat:{(b * 13 + i) % 90}|ms".encode()
+                      for i in range(per_batch)]
+            lines += [f"mrace.uniq:m{wid}-{b}-{i}|s".encode()
+                      for i in range(5)]
+            srv.handle_packet(b"\n".join(lines))
+
+    def flusher():
+        while not stop.is_set():
+            results.append(srv.flush_once())
+            time.sleep(0.01)
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(writers)]
+    ft = threading.Thread(target=flusher)
+    ft.start()
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        stop.set()
+        ft.join()
+    results.append(srv.flush_once())
+
+    total = writers * batches * per_batch
+    ctr = sum(m.value for r in results for m in r.metrics
+              if m.name == "mrace.ctr")
+    cnt = sum(m.value for r in results for m in r.metrics
+              if m.name == "mrace.lat.count")
+    uniq = sum(m.value for r in results for m in r.metrics
+               if m.name == "mrace.uniq")
+    assert ctr == 2.0 * total, (ctr, total)
+    assert cnt == total, (cnt, total)
+    n_uniq = writers * batches * 5
+    assert uniq >= 0.97 * n_uniq, (uniq, n_uniq)
+    srv.shutdown()
