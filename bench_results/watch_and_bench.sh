@@ -1,13 +1,29 @@
 #!/bin/bash
-# Device-link watcher: probe in a loop; on the first healthy probe,
-# run the full bench plus the prepared device A/Bs (merge kernel,
-# tail refinement capacity, f16 plane shipping) in the same healthy
-# window, then summarize into ab_table.md.
+# Device-link watcher: probe in a loop; on a healthy probe, run the
+# full bench plus the prepared device A/Bs (merge kernel, tail
+# refinement capacity, f16 plane shipping) in the same healthy
+# window, then summarize into ab_table.md.  If the window dies before
+# the HEADLINE bench lands a real number, go back to probing — a
+# flapping link must not consume the watcher's one shot.
 # Output: bench_results/watch.log + per-run JSON artifacts (every one
 # platform-stamped by bench.py itself).
 cd /root/repo
 LOG=bench_results/watch.log
 echo "$(date -u +%FT%TZ) watcher start (round 4)" >> "$LOG"
+
+headline_ok() {
+  python - <<'EOF'
+import json, sys
+try:
+    with open("bench_results/watch_bench_stdout.json") as f:
+        lines = [l for l in f.read().splitlines() if l.startswith("{")]
+    d = json.loads(lines[-1])
+    sys.exit(0 if d.get("value") else 1)
+except Exception:
+    sys.exit(1)
+EOF
+}
+
 for i in $(seq 1 400); do
   out=$(timeout 120 python -c "
 from veneur_tpu.utils import devprobe
@@ -20,6 +36,12 @@ print(err or 'HEALTHY ' + json.dumps(info))" 2>&1 | tail -1)
     VENEUR_BENCH_BUDGET=1800 timeout 2100 python bench.py \
         > bench_results/watch_bench_stdout.json 2>> "$LOG"
     echo "$(date -u +%FT%TZ) bench done rc=$?" >> "$LOG"
+    if ! headline_ok; then
+      echo "$(date -u +%FT%TZ) window died before a headline number;" \
+           "resuming probe loop" >> "$LOG"
+      sleep 90
+      continue
+    fi
     # A/B 1: dfcumsum merge vs scatter, timers config
     VENEUR_TPU_MERGE=dfcumsum VENEUR_BENCH_BUDGET=420 timeout 500 \
         python bench.py --config 2_timers_10k_series \
