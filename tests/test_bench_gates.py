@@ -210,3 +210,21 @@ def test_bench_error_line_carries_platform_fields():
     from veneur_tpu.utils import devprobe as dp
     err, info = dp.probe_device_info(0.001)
     assert err is not None and info == {}
+
+
+def test_chain_bench_artifact_committed():
+    """bench.py --chain: full local->proxy->global wire chain.  The
+    committed artifact must show complete delivery and a per-local
+    forward latency far inside the 10s interval (the shape behind
+    config 4's 2,048 items/s aggregate requirement; the global's
+    intake capacity itself is bench config 4)."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench_results", "chain_bench.json")
+    with open(path) as f:
+        d = json.load(f)
+    assert d["mode"] == "chain" and d["quick"] is False
+    assert d["timed_out"] is False
+    assert d["items_forwarded"] == d["items_expected"]
+    assert d["local_interval_headroom_x"] >= 5.0
+    assert "platform" in d and "gates" in d

@@ -294,3 +294,61 @@ def test_dfcumsum_merge_mode_matches_scatter(monkeypatch):
     np.testing.assert_allclose(m1, m2, rtol=1e-4, atol=1e-3)
     np.testing.assert_allclose(w2.sum(axis=1), 4096 * 1000.0,
                                rtol=1e-6)
+
+
+def test_subset_row_merge_matches_full_plane():
+    """The touched-row-subset kernels (gather/merge/scatter-back)
+    must produce bit-identical planes to the full-plane kernels for
+    the touched rows and leave every other row untouched."""
+    R, n = 512, 4000
+    rng = np.random.default_rng(42)
+    rows = np.sort(rng.choice(R, 24, replace=False))[
+        rng.integers(0, 24, n)].astype(np.int32)
+    vals = rng.gamma(2.0, 30.0, n).astype(np.float32)
+    wts = rng.uniform(1.0, 3.0, n).astype(np.float32)
+
+    # pre-populated state so the merge isn't trivially empty
+    m0, w0 = tdigest.empty_state(R)
+    seed_rows = np.arange(R, dtype=np.int32)
+    seed_vals = rng.gamma(2.0, 30.0, R).astype(np.float32)
+    m0, w0 = tdigest.add_samples_unit(m0, w0,
+                                      jnp.asarray(seed_rows),
+                                      jnp.asarray(seed_vals),
+                                      slots=8)
+    s0 = jnp.zeros((R, 5), jnp.float32)
+
+    from veneur_tpu.core import table as table_mod
+    rank = np.empty(n, np.int32)
+    order = np.argsort(rows, kind="stable")
+    sr = rows[order]
+    first = np.ones(n, bool)
+    first[1:] = sr[1:] != sr[:-1]
+    start = np.maximum.accumulate(np.where(first, np.arange(n), 0))
+    rank[order] = np.arange(n) - start
+    slots = int(rank.max()) + 1
+
+    uniq = np.unique(rows)
+    mb = table_mod._bucket_len(len(uniq))
+    local = np.searchsorted(uniq, rows).astype(np.int32)
+    idx = jnp.asarray(table_mod._pad_np(
+        uniq.astype(np.int32), mb, R))
+
+    # with-stats pair (weighted)
+    full = tdigest.ingest_ranked(
+        m0, w0, s0, jnp.asarray(rows), jnp.asarray(rank),
+        jnp.asarray(vals), jnp.asarray(wts), slots=slots)
+    sub = tdigest.ingest_ranked_rows(
+        m0, w0, s0, idx, jnp.asarray(local), jnp.asarray(rank),
+        jnp.asarray(vals), jnp.asarray(wts), slots=slots)
+    for a, b in zip(full, sub):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # no-stats unit pair
+    full2 = tdigest.add_samples_ranked_unit(
+        m0, w0, jnp.asarray(rows), jnp.asarray(rank),
+        jnp.asarray(vals), slots=slots)
+    sub2 = tdigest.add_samples_ranked_unit_rows(
+        m0, w0, idx, jnp.asarray(local), jnp.asarray(rank),
+        jnp.asarray(vals), slots=slots)
+    for a, b in zip(full2, sub2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -416,6 +416,113 @@ def ingest_ranked_unit(means: Array, weights: Array, stats: Array,
     return m, w, stats
 
 
+# ---- touched-row-subset variants -----------------------------------
+# A batch touching m rows of an R-row plane pays the k-scale merge
+# (sort + scan over R x (C+slots)) for every row, live or not; when
+# m << R the gather/merge-compact/scatter-back trio below makes the
+# interval cost O(m), not O(table capacity).  ``row_idx`` is the
+# padded array of ABSOLUTE row ids (pad entries use an out-of-range
+# id: take fills zeros, the scatter-back drops them); ``row_ids`` are
+# batch sample ids REMAPPED into the subset's local space (pad
+# samples use row_idx.shape[0], densify's drop contract).
+
+
+def _take_rows(plane: Array, row_idx: Array) -> Array:
+    return jnp.take(plane, row_idx, axis=0, mode="fill",
+                    fill_value=0.0)
+
+
+@partial(jax.jit, static_argnames=("slots", "compression"),
+         donate_argnums=jitopts.donate(0, 1))
+def add_samples_ranked_rows(means: Array, weights: Array,
+                            row_idx: Array, row_ids: Array,
+                            ranks: Array, values: Array,
+                            sample_weights: Array, slots: int = 256,
+                            compression: float = DEFAULT_COMPRESSION
+                            ) -> tuple[Array, Array]:
+    num_sub = row_idx.shape[0]
+    sub_m = _take_rows(means, row_idx)
+    sub_w = _take_rows(weights, row_idx)
+    dense_v = jnp.zeros((num_sub, slots), jnp.float32).at[
+        row_ids, ranks].set(values, mode="drop")
+    dense_w = jnp.zeros((num_sub, slots), jnp.float32).at[
+        row_ids, ranks].set(sample_weights, mode="drop")
+    sub_m, sub_w = _merge_impl(sub_m, sub_w, dense_v, dense_w,
+                               compression=compression)
+    return (means.at[row_idx].set(sub_m, mode="drop"),
+            weights.at[row_idx].set(sub_w, mode="drop"))
+
+
+@partial(jax.jit, static_argnames=("slots", "compression"),
+         donate_argnums=jitopts.donate(0, 1))
+def add_samples_ranked_unit_rows(means: Array, weights: Array,
+                                 row_idx: Array, row_ids: Array,
+                                 ranks: Array, values: Array,
+                                 slots: int = 256,
+                                 compression: float =
+                                 DEFAULT_COMPRESSION
+                                 ) -> tuple[Array, Array]:
+    num_sub = row_idx.shape[0]
+    sub_m = _take_rows(means, row_idx)
+    sub_w = _take_rows(weights, row_idx)
+    dense_v = jnp.zeros((num_sub, slots), jnp.float32).at[
+        row_ids, ranks].set(values, mode="drop")
+    dense_w = jnp.zeros((num_sub, slots), jnp.float32).at[
+        row_ids, ranks].set(jnp.ones_like(values), mode="drop")
+    sub_m, sub_w = _merge_impl(sub_m, sub_w, dense_v, dense_w,
+                               compression=compression)
+    return (means.at[row_idx].set(sub_m, mode="drop"),
+            weights.at[row_idx].set(sub_w, mode="drop"))
+
+
+@partial(jax.jit, static_argnames=("slots", "compression"),
+         donate_argnums=jitopts.donate(0, 1, 2))
+def ingest_ranked_rows(means: Array, weights: Array, stats: Array,
+                       row_idx: Array, row_ids: Array, ranks: Array,
+                       values: Array, sample_weights: Array,
+                       slots: int = 256,
+                       compression: float = DEFAULT_COMPRESSION
+                       ) -> tuple[Array, Array, Array]:
+    num_sub = row_idx.shape[0]
+    sub_m = _take_rows(means, row_idx)
+    sub_w = _take_rows(weights, row_idx)
+    sub_s = _take_rows(stats, row_idx)
+    dense_v = jnp.zeros((num_sub, slots), jnp.float32).at[
+        row_ids, ranks].set(values, mode="drop")
+    dense_w = jnp.zeros((num_sub, slots), jnp.float32).at[
+        row_ids, ranks].set(sample_weights, mode="drop")
+    sub_s = _stats_from_dense(sub_s, dense_v, dense_w)
+    sub_m, sub_w = _merge_impl(sub_m, sub_w, dense_v, dense_w,
+                               compression=compression)
+    return (means.at[row_idx].set(sub_m, mode="drop"),
+            weights.at[row_idx].set(sub_w, mode="drop"),
+            stats.at[row_idx].set(sub_s, mode="drop"))
+
+
+@partial(jax.jit, static_argnames=("slots", "compression"),
+         donate_argnums=jitopts.donate(0, 1, 2))
+def ingest_ranked_unit_rows(means: Array, weights: Array,
+                            stats: Array, row_idx: Array,
+                            row_ids: Array, ranks: Array,
+                            values: Array, slots: int = 256,
+                            compression: float = DEFAULT_COMPRESSION
+                            ) -> tuple[Array, Array, Array]:
+    num_sub = row_idx.shape[0]
+    sub_m = _take_rows(means, row_idx)
+    sub_w = _take_rows(weights, row_idx)
+    sub_s = _take_rows(stats, row_idx)
+    dense_v = jnp.zeros((num_sub, slots), jnp.float32).at[
+        row_ids, ranks].set(values, mode="drop")
+    dense_w = jnp.zeros((num_sub, slots), jnp.float32).at[
+        row_ids, ranks].set(jnp.ones_like(values), mode="drop")
+    sub_s = _stats_from_dense(sub_s, dense_v, dense_w)
+    sub_m, sub_w = _merge_impl(sub_m, sub_w, dense_v, dense_w,
+                               compression=compression)
+    return (means.at[row_idx].set(sub_m, mode="drop"),
+            weights.at[row_idx].set(sub_w, mode="drop"),
+            stats.at[row_idx].set(sub_s, mode="drop"))
+
+
 def _combine_row_stats(stats: Array, batch_stats: Array) -> Array:
     """Elementwise fold of per-row batch aggregates (host-accumulated
     by vtpu_dense_plane) into the stats plane — columns follow
