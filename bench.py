@@ -75,11 +75,11 @@ CACHE_WARM = compile_cache.enable(compile_cache.default_cache_dir())
 # unmistakable as a CPU one) and keys their checkpoint filenames so
 # variant runs never overwrite the baseline checkpoint.
 _GATES = {
-    "merge": os.environ.get("VENEUR_TPU_MERGE", "scatter"),
+    "merge": os.environ.get("VENEUR_TPU_MERGE", "auto"),
     "tail_refine": os.environ.get("VENEUR_TPU_TAIL_REFINE", "1"),
     "f16_plane": os.environ.get("VENEUR_TPU_F16_PLANE", "1"),
 }
-_GATES_DEFAULT = {"merge": "scatter", "tail_refine": "1",
+_GATES_DEFAULT = {"merge": "auto", "tail_refine": "1",
                   "f16_plane": "1"}
 _GATE_TAG = "".join(f".{k}-{v}" for k, v in sorted(_GATES.items())
                     if v != _GATES_DEFAULT[k])
@@ -91,6 +91,16 @@ def _backend_info() -> dict:
     capture — the platform/device_kind travel with every number."""
     info: dict = {"platform_pin": _PLATFORM_PIN or None,
                   "gates": dict(_GATES)}
+    try:
+        # "auto" resolves per backend; the artifact records what ran.
+        # merge_resolved covers every table shape (the fused kernel's
+        # 2048-lane bound exceeds the widest table merge, 616+616);
+        # merge_fallback records the escape hatch beyond that bound.
+        from veneur_tpu.ops import tdigest as _td
+        info["gates"]["merge_resolved"] = _td.resolved_merge_mode()
+        info["gates"]["merge_fallback"] = _td._FALLBACK_MODE
+    except Exception:
+        pass
     try:
         import jax
         d = jax.devices()[0]

@@ -22,6 +22,14 @@ RUNS = {
         "watch_ab_tailoff_c2.json",
     "VENEUR_TPU_F16_PLANE=0 (c2)": "watch_ab_f16off_c2.json",
     "VENEUR_TPU_MERGE=dfcumsum (c4)": "watch_ab_dfcumsum_c4.json",
+    "VENEUR_TPU_MERGE=pallas (c2, fused kernel)":
+        "watch_ab_pallas_c2.json",
+    # post-adoption era: auto default = fused kernel; scatter is the
+    # variant, and the full-bench keep-best artifact tracks the
+    # production defaults across healthy windows
+    "auto default, keep-best window (c2)": "watch_bench_auto.json",
+    "VENEUR_TPU_MERGE=scatter (c2, post-adoption A/B)":
+        "watch_ab_scatter_c2.json",
 }
 
 
@@ -123,6 +131,15 @@ def main() -> None:
         lines.append("_No device-measured baseline yet; table above "
                      "reports whatever artifacts exist (platform "
                      "column tells you what they ran on)._")
+    lines.append("")
+    lines.append(
+        "_Note: the dfcumsum c4 pick was superseded before adoption "
+        "— the fused Pallas kernel was widened to 2048 lanes (ops/"
+        "pallas_merge.py), covering the global-tier 616+616 union "
+        "that the dfcumsum fallback would have handled (device-"
+        "measured 4.1x over scatter at that shape); "
+        "VENEUR_TPU_MERGE_FALLBACK remains the lever beyond the "
+        "kernel's bound._")
     out = os.path.join(HERE, "ab_table.md")
     with open(out, "w") as f:
         f.write("\n".join(lines) + "\n")

@@ -57,10 +57,34 @@ Array = jax.Array
 # cumulative sums + sorted-boundary gather — no scatter at all, and
 # the compensation keeps per-cluster sums exact-in-practice (~2^-48
 # relative; a plain f32 cumsum-diff was measured to corrupt p999 by
-# perturbing tail cluster contents).  CPUs prefer scatter (cheap
-# scatter-add, costly multi-op scan); set VENEUR_TPU_MERGE=dfcumsum to
-# A/B on accelerator hardware.
-_MERGE_MODE = os.environ.get("VENEUR_TPU_MERGE", "scatter")
+# perturbing tail cluster contents).  "pallas": the whole merge
+# (sort + cluster + segment sums + pack) fused into one Pallas TPU
+# kernel (ops/pallas_merge.py) — one HBM pass each way, no scatter,
+# no second sort pass; falls back to _FALLBACK_MODE where the fused
+# kernel doesn't apply (combined width > its 2048-lane bound, which
+# no table-emitted shape exceeds).  The default, "auto",
+# resolves to pallas on a TPU backend and scatter elsewhere — the
+# round-4 device A/B measured the fused kernel at +69% end-to-end on
+# the 10k-series timer config (10.5M -> 17.8M samples/s, p99 error
+# unchanged at 0.03%; bench_results/ab_table.md), while CPUs prefer
+# scatter (cheap scatter-add; the interpreted kernel would crawl).
+_MERGE_MODE = os.environ.get("VENEUR_TPU_MERGE", "auto")
+
+# Cluster-reduction used where the fused pallas kernel doesn't apply
+# (combined width > its VMEM bound).
+_FALLBACK_MODE = os.environ.get("VENEUR_TPU_MERGE_FALLBACK", "scatter")
+
+
+def resolved_merge_mode() -> str:
+    """The merge strategy in effect: "auto" resolves per backend at
+    call time (bench artifacts record this resolved value)."""
+    if _MERGE_MODE != "auto":
+        return _MERGE_MODE
+    try:
+        backend = jax.default_backend()
+    except Exception:  # pragma: no cover - backend init failure
+        return "scatter"
+    return "pallas" if backend == "tpu" else "scatter"
 
 DEFAULT_COMPRESSION = 100.0
 
@@ -225,6 +249,22 @@ def _merge_impl(means: Array, weights: Array, new_means: Array,
             f"into the last slot (use empty_state(R, capacity_for(c)))")
     delta = _SCALE_MULT * compression  # internal scale, see module docstring
 
+    mode = resolved_merge_mode()
+    if mode == "pallas":
+        from veneur_tpu.ops import pallas_merge
+        if pallas_merge.supported(cap, new_means.shape[1]):
+            return pallas_merge.merge_planes(
+                means, weights, new_means, new_weights, delta=delta,
+                tail_coeff=_TAIL_MULT * compression,
+                tail_q0=_TAIL_Q0, tail_qmin=_TAIL_QMIN)
+        # width exceeds the fused kernel's 2048-lane bound — none of
+        # the table's own shapes do (widest: 616 state + 616 union),
+        # so this is the escape hatch for exotic compressions only.
+        # Scatter by default: routing wide ingest chunks through
+        # dfcumsum was measured to cost the timer config ~45%
+        # end-to-end (1.02s vs 0.55s intervals).
+        mode = _FALLBACK_MODE
+
     m = jnp.concatenate([means, new_means], axis=1)
     w = jnp.concatenate([weights, new_weights], axis=1)
     key = jnp.where(w > 0, m, jnp.inf)
@@ -237,7 +277,7 @@ def _merge_impl(means: Array, weights: Array, new_means: Array,
          _k_scale(jnp.float32(0.0), delta, compression))
     cluster = jnp.clip(jnp.floor(k).astype(jnp.int32), 0, cap - 1)
 
-    if _MERGE_MODE == "dfcumsum":
+    if mode == "dfcumsum":
         out_wm, out_w = _seg_sums_dfcumsum(m, w, cluster, cap)
     else:
         rows = jnp.arange(num_rows, dtype=jnp.int32)[:, None]
