@@ -1,0 +1,60 @@
+"""Fused Pallas merge under shard_map — the multi-chip composition.
+
+``VENEUR_TPU_MERGE=auto`` resolves to the fused kernel on any TPU
+backend, including a v5e-8 mesh where every digest merge runs INSIDE
+a ``shard_map``-ped step (parallel/sharded.py).  If ``pallas_call``
+didn't compose with shard_map, auto-mode would break exactly and only
+on real multi-chip hardware — the one place the driver can't test.
+This pins the composition on the virtual 8-device CPU mesh with the
+kernel in interpreter mode (a subprocess: both env gates must be set
+before the first jax/tdigest import).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_CODE = """
+import numpy as np, jax
+from veneur_tpu.parallel import ShardedAggregator, ShardedConfig, \
+    make_mesh
+from veneur_tpu.ops import tdigest
+assert tdigest.resolved_merge_mode() == "pallas"
+mesh = make_mesh(jax.devices())
+cfg = ShardedConfig(rows=16, set_rows=8, slots=32, batch=256)
+agg = ShardedAggregator(mesh, cfg)
+rng = np.random.default_rng(3)
+per_row = {r: [] for r in range(cfg.rows)}
+for shard in range(agg.n_shard):
+    rows = rng.integers(0, cfg.rows, 200, dtype=np.int32)
+    vals = rng.normal(150.0, 25.0, 200).astype(np.float32)
+    for r, v in zip(rows, vals):
+        per_row[r].append(v)
+    agg.stage(shard, histo_rows=rows, histo_vals=vals,
+              histo_wts=np.ones(200, np.float32))
+agg.step()
+out = agg.flush(qs=(0.5, 0.99))
+q = np.asarray(out["quantiles"])
+bad = 0.0
+for r, samples in per_row.items():
+    if len(samples) < 4:
+        continue
+    exact = np.quantile(np.array(samples), [0.5, 0.99])
+    rel = np.abs(q[r] - exact) / np.maximum(np.abs(exact), 1e-9)
+    bad = max(bad, float(rel.max()))
+assert bad < 0.05, bad
+print("ok", bad)
+"""
+
+
+def test_pallas_merge_composes_with_shard_map():
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               VENEUR_TPU_MERGE="pallas",
+               VENEUR_TPU_PALLAS_INTERPRET="1")
+    out = subprocess.run([sys.executable, "-c", _CODE], env=env,
+                         capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stderr[-3000:]
+    assert out.stdout.strip().startswith("ok")

@@ -173,19 +173,43 @@ def test_signalfx_datapoints_and_token_routing(http_capture):
 def test_splunk_hec_batches_and_sampling(http_capture):
     from veneur_tpu.sinks.splunk import SplunkSpanSink
     s = SplunkSpanSink(_url(http_capture), "tok", sample_rate=10)
-    # trace 10 samples in (10 % 10 == 0); trace 3 is dropped; error
-    # spans always ship
+    # trace 10 samples in (10 % 10 == 0); trace 3 is dropped — error
+    # spans are NOT exempt, only indicator spans are, and a kept
+    # would-drop indicator span is marked partial (splunk.go:452-495)
     s.ingest(_span(trace_id=10, span_id=1))
     s.ingest(_span(trace_id=3, span_id=2))
     s.ingest(_span(trace_id=3, span_id=3, error=True))
+    s.ingest(_span(trace_id=3, span_id=30, indicator=True))
     s.flush()
-    assert s.skipped == 1 and s.submitted == 2
+    assert s.skipped == 2 and s.submitted == 2
     _, path, headers, body = http_capture.requests[0]
     assert path == "/services/collector/event"
     assert headers["authorization"] == "Splunk tok"
     events = [json.loads(line) for line in body.splitlines()]
-    assert {e["event"]["id"] for e in events} == {"1", "3"}
-    assert events[0]["sourcetype"] == "ssf_span"
+    # ids are HEX strings (splunk.go:476-478 FormatInt base 16)
+    assert {e["event"]["id"] for e in events} == {"1", "1e"}
+    by_id = {e["event"]["id"]: e for e in events}
+    assert "partial" not in by_id["1"]["event"]
+    assert by_id["1e"]["event"]["partial"] is True
+    # sourcetype is the span service; timestamps are float seconds
+    assert events[0]["sourcetype"] == "svc"
+    assert events[0]["event"]["start_timestamp"] < 1e12
+
+
+def test_splunk_excluded_tag_key_skips_whole_span(http_capture):
+    """An excluded tag KEY drops the span entirely — Splunk bills on
+    volume, so the reference skips rather than strips
+    (splunk.go:461-466, SetExcludedTags comment)."""
+    from veneur_tpu.sinks.splunk import SplunkSpanSink
+    s = SplunkSpanSink(_url(http_capture), "tok")
+    s.set_excluded_tags(["noisy"])
+    s.ingest(_span(trace_id=1, span_id=1, tags=("noisy:x",)))
+    s.ingest(_span(trace_id=2, span_id=2, tags=("fine:y",)))
+    s.flush()
+    assert s.submitted == 1 and s.skipped == 1
+    events = [json.loads(line)
+              for line in http_capture.requests[0][3].splitlines()]
+    assert events[0]["event"]["tags"] == {"fine": "y"}
 
 
 # ----------------------------------------------------------------------
@@ -823,3 +847,96 @@ def test_flush_file_format_reference_end_to_end(tmp_path):
     assert len(hit[0]) == 8
     assert hit[0][2] == "rate" and hit[0][6] == "2"
     assert hit[0][4] == "10"
+
+
+def test_datadog_magic_host_device_tags(http_capture):
+    """`host:`/`device:` tags override the DDMetric fields and are
+    removed from the tag list (reference datadog.go:300-329)."""
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
+    s = DatadogMetricSink("key", _url(http_capture), 10.0,
+                          hostname="h1")
+    s.flush([_metric("dd.g", 5.0, GAUGE,
+                     tags=("a:1", "host:other", "device:sda"))])
+    series = json.loads(zlib.decompress(
+        http_capture.requests[0][3]))["series"]
+    assert series[0]["host"] == "other"
+    assert series[0]["device_name"] == "sda"
+    assert series[0]["tags"] == ["a:1"]
+
+
+def test_datadog_status_metric_becomes_service_check(http_capture):
+    """STATUS InterMetrics route to /api/v1/check_run as service
+    checks, never as gauge series (reference finalizeMetrics,
+    datadog.go:337-350)."""
+    from veneur_tpu.core.metrics import STATUS
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
+    s = DatadogMetricSink("key", _url(http_capture), 10.0,
+                          hostname="h1")
+    m = InterMetric(name="db.up", timestamp=1700000000, value=2.0,
+                    tags=("env:p",), type=STATUS, message="down",
+                    hostname="h1")
+    s.flush([m, _metric("dd.g", 1.0, GAUGE)])
+    paths = [r[1] for r in http_capture.requests]
+    assert "/api/v1/check_run?api_key=key" in paths
+    check_body = json.loads(
+        http_capture.requests[paths.index(
+            "/api/v1/check_run?api_key=key")][3])
+    assert check_body[0] == {"check": "db.up", "status": 2,
+                             "host_name": "h1",
+                             "timestamp": 1700000000,
+                             "message": "down", "tags": ["env:p"]}
+    series = json.loads(zlib.decompress(
+        http_capture.requests[
+            paths.index("/api/v1/series?api_key=key")][3]))["series"]
+    assert [e["metric"] for e in series] == ["dd.g"]
+
+
+def test_signalfx_tag_prefix_drop_skips_whole_metric(http_capture):
+    """A matching tag prefix drops the METRIC, not just the tag
+    (reference Flush's continue METRICLOOP, signalfx.go:414-423)."""
+    from veneur_tpu.sinks.signalfx import SignalFxSink
+    s = SignalFxSink("tok", _url(http_capture),
+                     metric_tag_prefix_drops=("secret",))
+    s.flush([_metric("keep.me", 1.0, GAUGE, tags=("ok:1",)),
+             _metric("drop.me", 2.0, GAUGE,
+                     tags=("ok:1", "secret:x"))])
+    body = json.loads(http_capture.requests[0][3])
+    assert [p["metric"] for p in body["gauge"]] == ["keep.me"]
+
+
+def test_signalfx_events_deliver(http_capture):
+    """DogStatsD events post to /v2/event as USERDEFINED custom
+    events with DD markdown fencing chopped (signalfx.go:543-592);
+    service checks are skipped."""
+    from veneur_tpu.protocol.dogstatsd import Event, ServiceCheck
+    from veneur_tpu.sinks.signalfx import SignalFxSink
+    s = SignalFxSink("tok", _url(http_capture), hostname="h9")
+    ev = Event(title="deploy", text="%%% \nrolled back\n %%%",
+               timestamp=1700000000, tags=("env:p",))
+    sc = ServiceCheck(name="db.up", status=0, timestamp=1700000000)
+    s.flush_other_samples([ev, sc])
+    reqs = [(r[1], r[3]) for r in http_capture.requests]
+    assert len(reqs) == 1
+    path, body = reqs[0]
+    assert path == "/v2/event"
+    evs = json.loads(body)
+    assert len(evs) == 1
+    assert evs[0]["eventType"] == "deploy"
+    assert evs[0]["category"] == "USERDEFINED"
+    assert evs[0]["properties"]["description"] == "rolled back"
+    assert evs[0]["dimensions"]["env"] == "p"
+    assert evs[0]["dimensions"]["host"] == "h9"
+
+
+def test_signalfx_chunk_cap_is_total_points(http_capture):
+    """max_per_body bounds TOTAL datapoints per POST across both
+    kinds (the reference's maxPointsInBatch slices the combined
+    list)."""
+    from veneur_tpu.sinks.signalfx import SignalFxSink
+    s = SignalFxSink("tok", _url(http_capture), max_per_body=4)
+    ms = ([_metric(f"g{i}", 1.0, GAUGE) for i in range(3)] +
+          [_metric(f"c{i}", 1.0, COUNTER) for i in range(3)])
+    s.flush(ms)
+    sizes = [len(json.loads(b)["gauge"]) + len(json.loads(b)["counter"])
+             for _, _, _, b in http_capture.requests]
+    assert sum(sizes) == 6 and max(sizes) <= 4

@@ -1203,7 +1203,8 @@ class Server:
                 (k, v) for k, v in status.items()):
             res.metrics.append(im.InterMetric(
                 name=name, timestamp=ts, value=val, tags=stags,
-                type=im.STATUS, message=msg))
+                type=im.STATUS, message=msg,
+                hostname=self.flusher.hostname))
 
         futures = []
 

@@ -18,7 +18,7 @@ import urllib.error
 import urllib.request
 import zlib
 
-from veneur_tpu.core.metrics import COUNTER, InterMetric
+from veneur_tpu.core.metrics import COUNTER, STATUS, InterMetric
 from veneur_tpu.sinks import base as sinks_base
 from veneur_tpu.sinks.base import SinkBase
 
@@ -49,19 +49,41 @@ class DatadogMetricSink(SinkBase):
             (r.get("metric_prefix", ""), tuple(r.get("tags", ())))
             for r in (exclude_tags_prefix_by_prefix_metric or ())]
 
-    def _series(self, m: InterMetric) -> dict:
+    def _finalize_tags(self, m: InterMetric
+                       ) -> tuple[list[str], str, str]:
+        """Tag housekeeping shared by series and status entries:
+        per-metric-prefix tag stripping, then the reference's "magic
+        tags" — ``host:``/``device:`` override the DDMetric hostname/
+        device fields and are REMOVED from the tag list
+        (datadog.go:300-329)."""
         tags = list(m.tags)
         for metric_prefix, tag_prefixes in self.tag_prefix_rules:
             if m.name.startswith(metric_prefix):
                 tags = [t for t in tags
                         if not any(t.startswith(p)
                                    for p in tag_prefixes)]
+        hostname = m.hostname or self.hostname
+        device = ""
+        kept = []
+        for t in tags:
+            if t.startswith("host:"):
+                hostname = t[5:]
+            elif t.startswith("device:"):
+                device = t[7:]
+            else:
+                kept.append(t)
+        return kept, hostname, device
+
+    def _series(self, m: InterMetric) -> dict:
+        tags, hostname, device = self._finalize_tags(m)
         entry = {
             "metric": m.name,
             "points": [[m.timestamp, m.value]],
             "tags": tags,
-            "host": m.hostname or self.hostname,
+            "host": hostname,
         }
+        if device:
+            entry["device_name"] = device
         if m.type == COUNTER:
             # DD rate semantics: value averaged over the interval
             entry["type"] = "rate"
@@ -72,6 +94,19 @@ class DatadogMetricSink(SinkBase):
             entry["type"] = "gauge"
         return entry
 
+    def _status_check(self, m: InterMetric) -> dict:
+        """A STATUS InterMetric is a service check, not a series entry
+        (reference finalizeMetrics, datadog.go:337-350)."""
+        tags, hostname, _ = self._finalize_tags(m)
+        return {
+            "check": m.name,
+            "status": int(m.value),
+            "host_name": hostname,
+            "timestamp": m.timestamp,
+            "message": m.message,
+            "tags": tags,
+        }
+
     def flush(self, metrics: list[InterMetric]) -> None:
         if self.name_prefix_drops:
             metrics = [m for m in metrics
@@ -79,7 +114,14 @@ class DatadogMetricSink(SinkBase):
                                   for p in self.name_prefix_drops)]
         if not metrics:
             return
-        series = [self._series(m) for m in metrics]
+        checks = [self._status_check(m) for m in metrics
+                  if m.type == STATUS]
+        series = [self._series(m) for m in metrics
+                  if m.type != STATUS]
+        if checks:
+            self._post_raw(
+                f"{self.api_hostname}/api/v1/check_run"
+                f"?api_key={self.api_key}", checks)
         for i in range(0, len(series), self.max_per_body):
             self._post(series[i:i + self.max_per_body])
 

@@ -65,7 +65,17 @@ class LightStepSpanSink(SpanTagExcluder):
                 "oldest_micros": span.start_timestamp // 1000,
                 "youngest_micros": span.end_timestamp // 1000,
                 "error_flag": bool(span.error),
+                # synthesized attributes the reference sets on every
+                # span (lightstep.go:159-167): indicator as a string
+                # bool, the hardcoded type, and error-code (0/1);
+                # span tags follow and may override
                 "attributes": [
+                    {"Key": "indicator",
+                     "Value": str(bool(span.indicator)).lower()},
+                    {"Key": "type", "Value": "http"},
+                    {"Key": "error-code",
+                     "Value": str(1 if span.error else 0)},
+                ] + [
                     {"Key": k, "Value": v}
                     for k, v in self.filter_span_tags(
                         span.tags).items()],

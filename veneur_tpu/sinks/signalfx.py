@@ -109,8 +109,6 @@ class SignalFxSink(SinkBase):
     def _datapoint(self, m: InterMetric) -> dict:
         dims = {}
         for t in m.tags:
-            if any(t.startswith(p) for p in self.tag_prefix_drops):
-                continue
             k, _, v = t.partition(":")
             dims[k] = v
         if m.hostname:
@@ -118,11 +116,19 @@ class SignalFxSink(SinkBase):
         return {"metric": m.name, "value": m.value,
                 "timestamp": m.timestamp * 1000, "dimensions": dims}
 
+    def _dropped(self, m: InterMetric) -> bool:
+        """Name-prefix drops AND tag-prefix drops both skip the WHOLE
+        metric (reference Flush's `continue METRICLOOP`,
+        signalfx.go:406-423 — a tag match does not merely strip the
+        tag)."""
+        if any(m.name.startswith(p) for p in self.name_prefix_drops):
+            return True
+        return any(t.startswith(p) for t in m.tags
+                   for p in self.tag_prefix_drops)
+
     def flush(self, metrics: list[InterMetric]) -> None:
-        if self.name_prefix_drops:
-            metrics = [m for m in metrics
-                       if not any(m.name.startswith(p)
-                                  for p in self.name_prefix_drops)]
+        if self.name_prefix_drops or self.tag_prefix_drops:
+            metrics = [m for m in metrics if not self._dropped(m)]
         # group by token so vary-by-tag keys hit their own org
         by_token: dict[str, dict] = {}
         for m in metrics:
@@ -131,14 +137,15 @@ class SignalFxSink(SinkBase):
             kind = "counter" if m.type == COUNTER else "gauge"
             body[kind].append(self._datapoint(m))
         for token, body in by_token.items():
-            points = body["gauge"] + body["counter"]
-            for i in range(0, max(len(points), 1), self.max_per_body):
-                chunk = {
-                    "gauge": body["gauge"][i:i + self.max_per_body],
-                    "counter": body["counter"][i:i + self.max_per_body],
-                }
-                if not (chunk["gauge"] or chunk["counter"]):
-                    continue
+            # cap applies to TOTAL points per POST (the reference's
+            # maxPointsInBatch slices the combined list), so chunk
+            # the kinds together, not with a shared per-kind index
+            points = ([("gauge", p) for p in body["gauge"]] +
+                      [("counter", p) for p in body["counter"]])
+            for i in range(0, len(points), self.max_per_body):
+                chunk = {"gauge": [], "counter": []}
+                for kind, p in points[i:i + self.max_per_body]:
+                    chunk[kind].append(p)
                 self._post(token, chunk)
 
     def _post(self, token: str, body: dict) -> None:
@@ -150,3 +157,51 @@ class SignalFxSink(SinkBase):
         with urllib.request.urlopen(req, timeout=10.0) as r:
             r.read()
         self.flushed_total += len(body["gauge"]) + len(body["counter"])
+
+    # -- events (reference FlushOtherSamples/reportEvent,
+    #    signalfx.go:501-592) ------------------------------------------
+
+    _EVENT_MAX = 256  # EventNameMaxLength / EventDescriptionMaxLength
+
+    def flush_other_samples(self, samples: list) -> None:
+        """DogStatsD events serialize as SignalFx custom events on
+        ``/v2/event``; service checks are ignored (the reference only
+        reports samples carrying the event identifier tag)."""
+        events = []
+        for s in samples:
+            if not hasattr(s, "title"):
+                continue  # service check: reference skips these
+            dims = {self.hostname_tag: self.hostname}
+            for t in s.tags:
+                k, _, v = t.partition(":")
+                dims[k] = v
+            # per-sink tag exclusion applies to event dimensions too
+            # (reference reportEvent, signalfx.go:559-561)
+            for k in self.excluded_tags:
+                dims.pop(k, None)
+            # truncate FIRST, then chop the DD markdown fencing and
+            # trim — the reference's exact order (signalfx.go:566-577)
+            msg = (s.text or "")[:self._EVENT_MAX]
+            msg = msg.replace("%%% \n", "", 1)
+            msg = msg.replace("\n %%%", "", 1).strip()
+            ev = {
+                "eventType": s.title[:self._EVENT_MAX],
+                "category": "USERDEFINED",
+                "dimensions": dims,
+                "properties": {"description": msg},
+            }
+            if s.timestamp:
+                ev["timestamp"] = s.timestamp * 1000
+            events.append(ev)
+        if not events:
+            return
+        req = urllib.request.Request(
+            f"{self.endpoint}/v2/event",
+            data=json.dumps(events).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-SF-Token": self.api_key}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=10.0) as r:
+                r.read()
+        except Exception as e:
+            log.warning("signalfx event flush failed: %s", e)

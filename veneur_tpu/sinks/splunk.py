@@ -4,9 +4,10 @@ Spans buffer between flushes and POST to the HTTP Event Collector
 (``/services/collector/event``) as newline-delimited JSON events with
 token auth.  The reference's operational behavior is kept:
 
-- sampling: 1/N of non-error, non-indicator spans (error and
-  indicator spans always ship), keyed on trace id so whole traces
-  sample together;
+- sampling: 1/N of traces keep their spans, keyed on trace id so
+  whole traces sample together; ONLY indicator spans are exempt
+  (kept despite sampling, marked ``partial``) — error spans are
+  sampled like any other (reference splunk.go:452-495);
 - batched submission across ``submission_workers`` threads, at most
   ``batch_size`` events per POST (reference SplunkHecBatchSize /
   SplunkHecSubmissionWorkers);
@@ -80,29 +81,46 @@ class SplunkSpanSink(SpanTagExcluder):
             self._pool = None
 
     def ingest(self, span) -> None:
-        keep = (span.error or span.indicator or
-                span.trace_id % self.sample_rate == 0)
-        if not keep:
+        # 1/sample_rate of traces kept, keyed on trace id so a
+        # trace's spans sample together; ONLY indicator spans are
+        # exempt (reference splunk.go:452-458 — error spans are not)
+        would_drop = span.trace_id % self.sample_rate != 0
+        if would_drop and not span.indicator:
             self.skipped += 1
             return
+        # a span carrying any excluded tag KEY is skipped ENTIRELY —
+        # Splunk bills on volume, not tag cardinality, so this sink
+        # drops the span rather than stripping the tag
+        # (splunk.go:461-466 and the SetExcludedTags comment)
+        if any(k in self.excluded_tags for k in span.tags):
+            self.skipped += 1
+            return
+        # SerializedSSF wire shape (splunk.go:531-543): hex ids,
+        # second-resolution float timestamps, ns duration; sourcetype
+        # is the span's service (splunk.go:503)
+        serialized = {
+            "trace_id": format(span.trace_id, "x"),
+            "id": format(span.id, "x"),
+            "parent_id": format(span.parent_id, "x"),
+            "start_timestamp": span.start_timestamp / 1e9,
+            "end_timestamp": span.end_timestamp / 1e9,
+            "duration_ns": span.end_timestamp -
+            span.start_timestamp,
+            "error": span.error,
+            "service": span.service,
+            "tags": dict(span.tags),
+            "indicator": span.indicator,
+            "name": span.name,
+        }
+        if would_drop:
+            # indicator span kept despite sampling: mark the trace
+            # partial so full traces remain searchable (splunk.go:489)
+            serialized["partial"] = True
         event = {
             "host": self.hostname,
-            "sourcetype": "ssf_span",
+            "sourcetype": span.service,
             "time": span.start_timestamp / 1e9,
-            "event": {
-                "trace_id": str(span.trace_id),
-                "id": str(span.id),
-                "parent_id": str(span.parent_id),
-                "name": span.name,
-                "service": span.service,
-                "start_timestamp": span.start_timestamp,
-                "end_timestamp": span.end_timestamp,
-                "duration_ns": span.end_timestamp -
-                span.start_timestamp,
-                "error": span.error,
-                "indicator": span.indicator,
-                "tags": self.filter_span_tags(span.tags),
-            },
+            "event": serialized,
         }
         with self._lock:
             if len(self._buf) < self.max_per_flush:
