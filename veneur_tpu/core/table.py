@@ -331,6 +331,33 @@ class MetricTable:
         self._hll_device_touched = False
 
         self.status: dict[tuple, tuple[float, str, tuple[str, ...]]] = {}
+        # gRPC import fast path: native import-identity hash -> row
+        # (-1 for known-dropped items), maintained by
+        # forward/grpc_forward.apply_metric_list_bytes so steady-state
+        # imports never decode name/tag strings.  Invalidated on
+        # compaction (rows renumber) and cleared when it reaches
+        # import_row_cache_limit (churning identities rebuild it).
+        self.import_row_cache: dict[int, int] = {}
+        # Effective digest chunk width: on TPU backends, cap merge
+        # chunks so state capacity + chunk stays inside the fused
+        # Pallas kernel's bound — a wider chunk silently drops to the
+        # scatter path (~4x slower on device, round-4 A/B)
+        self._eff_histo_slots = c.histo_slots
+        from veneur_tpu.ops import tdigest as _td
+        if _td.resolved_merge_mode() == "pallas":
+            from veneur_tpu.ops import pallas_merge
+            mb = pallas_merge.max_batch_slots(self.capacity)
+            # only cap when the kernel can actually engage at a sane
+            # chunk width — for capacities beyond its bound every
+            # merge scatters regardless, and micro-chunking would
+            # multiply dispatches for nothing
+            if mb >= _MIN_BUCKET:
+                self._eff_histo_slots = min(c.histo_slots, mb)
+        # bound for the gRPC import row cache (see import_row_cache):
+        # churning tag identities would otherwise grow it forever
+        self.import_row_cache_limit = 4 * (
+            c.counter_rows + c.gauge_rows + c.histo_rows +
+            c.set_rows) + 1024
         # O(1) staged-sample counter (``staged()`` must be callable per
         # sample to drive threshold-triggered device steps without
         # walking the staging lists)
@@ -637,6 +664,69 @@ class MetricTable:
     # ------------------------------------------------------------------
     # global-tier import (merge of forwarded mergeable state)
 
+    # -- row-resolution halves + batch appliers for the cached gRPC
+    #    fast path (forward/grpc_forward.apply_metric_list_bytes):
+    #    resolution runs once per novel series, application runs
+    #    vectorized over whole decoded MetricLists ------------------
+
+    def import_counter_row(self, name: str,
+                           tags: tuple[str, ...]) -> int | None:
+        key = (name, dsd.COUNTER, tags, dsd.SCOPE_GLOBAL)
+        return self.counter_idx.lookup(key, name, tags,
+                                       dsd.SCOPE_GLOBAL, dsd.COUNTER,
+                                       self.gen)
+
+    def import_gauge_row(self, name: str,
+                         tags: tuple[str, ...]) -> int | None:
+        key = (name, dsd.GAUGE, tags, dsd.SCOPE_GLOBAL)
+        return self.gauge_idx.lookup(key, name, tags,
+                                     dsd.SCOPE_GLOBAL, dsd.GAUGE,
+                                     self.gen)
+
+    def import_set_row(self, name: str, tags: tuple[str, ...],
+                       scope: str = dsd.SCOPE_DEFAULT) -> int | None:
+        key = (name, dsd.SET, tags, scope)
+        return self.set_idx.lookup(key, name, tags, scope, dsd.SET,
+                                   self.gen)
+
+    def import_counter_batch(self, rows: np.ndarray,
+                             values: np.ndarray) -> None:
+        """Vectorized import_counter over resolved rows (+= merge;
+        duplicate rows accumulate, matching per-item order
+        independence of addition)."""
+        rows = np.ascontiguousarray(rows, np.int64)
+        np.add.at(self._counter_dense, rows,
+                  np.asarray(values, np.float64))
+        self.counter_idx.touch_rows(rows, self.gen)
+        self._counter_dirty = True
+        self._staged_n += len(rows)
+
+    def import_gauge_batch(self, rows: np.ndarray,
+                           values: np.ndarray) -> None:
+        """Vectorized import_gauge (last-write-wins in wire order —
+        duplicates resolve to the LAST occurrence explicitly; numpy's
+        duplicate-index assignment order is unspecified)."""
+        rows = np.ascontiguousarray(rows, np.int64)
+        values = np.asarray(values, np.float64)
+        rev_u, rev_first = np.unique(rows[::-1], return_index=True)
+        last = len(rows) - 1 - rev_first
+        self._gauge_dense[rev_u] = values[last]
+        self._gauge_mask[rev_u] = 1
+        self.gauge_idx.touch_rows(rows, self.gen)
+        self._gauge_dirty = True
+        self._staged_n += len(rows)
+
+    def import_set_at(self, row: int, regs: np.ndarray) -> None:
+        """import_set's staging half for a pre-resolved row."""
+        regs = np.asarray(regs, np.uint8)
+        if regs.shape != (hll.M,):
+            raise ValueError(f"bad register plane shape {regs.shape}")
+        self._set_import_rows.append(int(row))
+        self._set_import_regs.append(regs)
+        self.set_idx.touched[row] = True
+        self.set_idx.last_gen[row] = self.gen
+        self._staged_n += 1
+
     def import_counter(self, name: str, tags: tuple[str, ...],
                        value: float) -> bool:
         """Merge a forwarded counter total (+=; reference
@@ -728,6 +818,10 @@ class MetricTable:
             self._stats_import_parts.append(
                 (np.ascontiguousarray(rows, np.int32),
                  np.ascontiguousarray(stats, np.float32)))
+            # rows may be cache-resolved (no lookup ran): touch here
+            # so flush emission and compaction survival see them
+            self.histo_idx.touch_rows(np.asarray(rows, np.int64),
+                                      self.gen)
             self._staged_n += len(rows)
         if len(cent_rows):
             self._digest_stage.append(
@@ -920,8 +1014,9 @@ class MetricTable:
         # spills) past the digest capacity — a fleet's forwarded
         # digests collapse to <= capacity clusters per row on host,
         # cutting the shipped batch ~5x and the merge to one call.
-        precluster_at = (c.histo_slots * 4 if with_stats
-                         else max(self.capacity, c.histo_slots))
+        precluster_at = (self._eff_histo_slots * 4 if with_stats
+                         else max(self.capacity,
+                                  self._eff_histo_slots))
         if max_count > precluster_at:
             if with_stats:
                 self._host_stats_fold(rows, vals, wts)
@@ -929,15 +1024,16 @@ class MetricTable:
             rows, vals, wts = self._host_precluster(rows, vals, wts)
             unit = False
             rank, max_count = self._rank(rows)
-        if max_count <= c.histo_slots:
+        eff = self._eff_histo_slots
+        if max_count <= eff:
             self._digest_merge(rows, vals, wts, rank, unit, with_stats)
             return
-        chunk_of = rank // c.histo_slots
+        chunk_of = rank // eff
         n_chunks = int(chunk_of.max()) + 1 if len(rows) else 0
         for ci in range(n_chunks):
             sel = np.nonzero(chunk_of == ci)[0]
             self._digest_merge(rows[sel], vals[sel], wts[sel],
-                               rank[sel] - ci * c.histo_slots, unit,
+                               rank[sel] - ci * eff, unit,
                                with_stats)
 
     def _host_stats_fold(self, rows, vals, wts) -> None:
@@ -1033,7 +1129,8 @@ class MetricTable:
         # (compile-cache variants bounded by histo_slots/128); the
         # coarse 1.5-step ladder only caps via the max row
         width = min(max(128, -(-w_p99 // 128) * 128),
-                    _bucket_len(w_hi, wide=True), c.histo_slots)
+                    _bucket_len(w_hi, wide=True),
+                    self._eff_histo_slots)
         # f16 plane only for unit-weight batches whose nonzero values
         # all sit in f16's NORMAL range: rel. quantization there is
         # 2^-11 (~0.05%), while subnormals (<6.1e-5) would quantize at
@@ -1193,7 +1290,12 @@ class MetricTable:
         b = _bucket_len(len(rows))
         vals_dev = jnp.asarray(_pad_np(vals, b, 0.0))
         rank_dev = jnp.asarray(_pad_np(rank, b, 0))
-        slots = min(c.histo_slots, b)
+        # dense-plane width: what the batch's deepest row needs (the
+        # old min(histo_slots, b) keyed on the FLAT batch length, so
+        # a shallow-but-wide batch shipped an oversized plane and —
+        # on TPU — pushed the merge past the fused kernel's bound)
+        slots = min(self._eff_histo_slots,
+                    _bucket_len(int(rank.max(initial=-1)) + 1))
         # Touched-row-subset merge: a batch touching m rows of an
         # R-row plane otherwise pays the k-scale sort for every row
         # (seconds per interval on the CPU-fallback backend at the
@@ -1318,6 +1420,10 @@ class MetricTable:
                 for row, m in enumerate(idx.meta):
                     if m.key_hash:
                         self.key_index.insert(m.key_hash, row)
+            # the gRPC import row cache maps its own hash space to
+            # the same renumbered rows — drop it; the next wire list
+            # re-resolves through the slow path
+            self.import_row_cache.clear()
         return snap
 
     def take_status(self):

@@ -122,5 +122,13 @@ def load() -> ctypes.CDLL | None:
             i64p, i32p,
             i64p, i32p,
             i64p]
+        lib.vtpu_metriclist_keyhash.restype = None
+        lib.vtpu_metriclist_keyhash.argtypes = [
+            u8p, i64,
+            i64p, i32p,
+            u8p, i32p, i32p,
+            i64p, i32p,
+            i64p, i32p,
+            u64p]
         _lib = lib
         return _lib

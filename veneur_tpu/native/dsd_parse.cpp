@@ -988,4 +988,38 @@ int64_t vtpu_metriclist_decode(
   return over ? -2 : nm;
 }
 
+// Import-identity hash per decoded MetricList item: an opaque cache
+// key over (name bytes, kind, proto mtype, proto scope, tag bytes)
+// for veneur_tpu/forward/grpc_forward.py's steady-state row cache —
+// repeated-interval imports resolve rows without decoding a single
+// string.  Same fold64/fmix64 building blocks as the series-identity
+// hash, commutative over tags; the constant offsets only need to be
+// deterministic (this hash never leaves the process and never mixes
+// with the DogStatsD key space — kind is mixed with a distinct
+// multiplier to keep the spaces disjoint).
+void vtpu_metriclist_keyhash(
+    const uint8_t* buf, int64_t nm,
+    const int64_t* name_off, const int32_t* name_len,
+    const uint8_t* kind, const int32_t* mtype, const int32_t* scope,
+    const int64_t* tag_start, const int32_t* tag_cnt,
+    const int64_t* tag_off, const int32_t* tag_len,
+    uint64_t* out_hash) {
+  constexpr uint64_t kImportKindMult = 0xD6E8FEB86659FD93ULL;
+  for (int64_t i = 0; i < nm; i++) {
+    uint64_t tagsum = 0;
+    const int64_t ts = tag_start[i];
+    for (int32_t j = 0; j < tag_cnt[i]; j++) {
+      tagsum += fmix64(fold64(buf + tag_off[ts + j],
+                              (size_t)tag_len[ts + j]));
+    }
+    const uint64_t meta =
+        ((uint64_t)kind[i] * kImportKindMult) ^
+        ((uint64_t)(uint32_t)mtype[i] * kKeyTypeMult) ^
+        ((uint64_t)(uint32_t)scope[i] * kKeyScopeMult);
+    out_hash[i] = fmix64(
+        fold64(buf + name_off[i], (size_t)name_len[i]) ^
+        fmix64(meta + tagsum));
+  }
+}
+
 }  // extern "C"

@@ -84,6 +84,18 @@ def supported(cap: int, batch_width: int) -> bool:
     return _pow2_at_least(cap + batch_width) <= _MAX_WIDTH
 
 
+def max_batch_slots(cap: int) -> int:
+    """Largest incoming-batch width that keeps a merge against a
+    ``cap``-slot state inside the fused kernel's bound — the table
+    caps its ingest chunk width to this on TPU backends so every
+    digest merge stays fused (an oversized chunk silently falls back
+    to the scatter path, measured ~4x slower on device).  May be <= 0
+    for capacities beyond the kernel's reach (exotic compressions):
+    callers must NOT cap chunks then — micro-chunking a merge that
+    falls back to scatter anyway only multiplies dispatches."""
+    return _MAX_WIDTH - cap
+
+
 def _rot_left(x: Array, j: int) -> Array:
     """x[i] <- x[i+j] cyclically along lanes (static j)."""
     return jnp.concatenate([x[:, j:], x[:, :j]], axis=1)

@@ -531,6 +531,14 @@ class ShardedTable:
         self.histo_idx = core_table._ClassIndex(self.cfg.rows)
         self.set_idx = core_table._ClassIndex(self.cfg.set_rows)
         self.status: dict = {}
+        # gRPC import fast path's identity-hash -> row cache (see
+        # core/table.py) — the facade never compacts, so no
+        # invalidation hook is needed; the size bound below guards
+        # churning-identity growth (cleared + rebuilt when hit)
+        self.import_row_cache: dict[int, int] = {}
+        self.import_row_cache_limit = 4 * (
+            2 * self.cfg.c_rows() + self.cfg.rows +
+            self.cfg.set_rows) + 1024
         self._staged_n = 0
         self._rr = 0  # round-robin shard cursor
 
@@ -619,6 +627,66 @@ class ShardedTable:
         return processed, dropped
 
     # -- global-tier imports ------------------------------------------
+
+    # -- cached-fast-path surface (forward/grpc_forward
+    #    apply_metric_list_bytes): row-resolution halves + batch
+    #    appliers.  The facade never compacts, so the cache (filled
+    #    by the forward module) needs no invalidation hook ----------
+
+    def import_counter_row(self, name, tags):
+        from veneur_tpu.protocol import dogstatsd as dsd
+        return self.counter_idx.lookup(
+            (name, dsd.COUNTER, tags, dsd.SCOPE_GLOBAL), name, tags,
+            dsd.SCOPE_GLOBAL, dsd.COUNTER, self.gen)
+
+    def import_gauge_row(self, name, tags):
+        from veneur_tpu.protocol import dogstatsd as dsd
+        return self.gauge_idx.lookup(
+            (name, dsd.GAUGE, tags, dsd.SCOPE_GLOBAL), name, tags,
+            dsd.SCOPE_GLOBAL, dsd.GAUGE, self.gen)
+
+    def import_set_row(self, name, tags, scope=None):
+        from veneur_tpu.protocol import dogstatsd as dsd
+        scope = scope or dsd.SCOPE_DEFAULT
+        return self.set_idx.lookup((name, dsd.SET, tags, scope), name,
+                                   tags, scope, dsd.SET, self.gen)
+
+    def import_counter_batch(self, rows, values) -> None:
+        rows = np.ascontiguousarray(rows, np.int64)
+        self.agg.stage(self._next_shard(),
+                       counter_rows=rows.astype(np.int32),
+                       counter_vals=np.asarray(values, np.float32),
+                       counter_wts=np.ones(len(rows), np.float32))
+        self.counter_idx.touch_rows(rows, self.gen)
+        self._staged_n += len(rows)
+
+    def import_gauge_batch(self, rows, values) -> None:
+        # one ticket per write preserves last-write-wins in wire
+        # order across the whole mesh (stage() takes one ticket per
+        # call, so gauges stage individually)
+        rows = np.ascontiguousarray(rows, np.int64)
+        values = np.asarray(values, np.float64)
+        for r, v in zip(rows, values):
+            self.agg.stage(self._next_shard(), gauge_rows=[int(r)],
+                           gauge_vals=[float(v)],
+                           gauge_ticket=self.agg.next_ticket())
+        self.gauge_idx.touch_rows(rows, self.gen)
+        self._staged_n += len(rows)
+
+    def import_set_at(self, row, regs) -> None:
+        regs = np.asarray(regs, np.uint8)
+        if regs.shape != (hll_ops.M,):
+            raise ValueError(f"bad register plane shape {regs.shape}")
+        nz = np.nonzero(regs)[0]
+        if len(nz):
+            self.agg.stage(self._next_shard(),
+                           set_rows=np.full(len(nz), int(row),
+                                            np.int32),
+                           set_idx=nz.astype(np.int32),
+                           set_rank=regs[nz].astype(np.int32))
+        self.set_idx.touched[row] = True
+        self.set_idx.last_gen[row] = self.gen
+        self._staged_n += max(1, len(nz))
 
     def import_counter(self, name, tags, value) -> bool:
         from veneur_tpu.protocol import dogstatsd as dsd
@@ -761,6 +829,9 @@ class ShardedTable:
             self.agg.stage(sh, rsum_rows=crows.astype(_np.int32),
                            rsum_vals=corr[crows].astype(_np.float32))
             n_staged += len(crows)
+        # rows may arrive cache-resolved (no lookup ran): touch them
+        # so flush emission sees the series
+        self.histo_idx.touch_rows(rows, self.gen)
         self._staged_n += n_staged
 
     def import_set(self, name, tags, regs, scope=None) -> bool:

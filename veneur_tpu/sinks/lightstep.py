@@ -71,7 +71,8 @@ class LightStepSpanSink(SpanTagExcluder):
                 # span tags follow and may override
                 "attributes": [
                     {"Key": "indicator",
-                     "Value": str(bool(span.indicator)).lower()},
+                     "Value": str(bool(getattr(span, "indicator",
+                                               False))).lower()},
                     {"Key": "type", "Value": "http"},
                     {"Key": "error-code",
                      "Value": str(1 if span.error else 0)},
