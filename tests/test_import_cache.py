@@ -1,0 +1,121 @@
+"""gRPC import fast path: identity-hash row cache semantics.
+
+Covers what the wire-level suites can't see directly: cache hits
+bypass string decode but MUST behave exactly like the per-item slow
+path — across compaction (rows renumber), identity churn (size
+bound), value-level validity (never cached), and gauge write order.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from veneur_tpu.core.table import MetricTable, TableConfig
+from veneur_tpu.forward.grpc_forward import (apply_metric_list_bytes,
+                                             rows_to_metric_list)
+from veneur_tpu.core.flusher import FlushResult, Flusher
+from veneur_tpu.core.metrics import InterMetric
+from veneur_tpu.protocol import dogstatsd as dsd
+
+
+def _wire(names_vals, mtype=dsd.COUNTER):
+    """Serialized MetricList of scalar metrics via the real encoder:
+    round-trips through a local table flush so the wire shape is the
+    production one."""
+    src = MetricTable(TableConfig())
+    for name, v in names_vals:
+        src.ingest(dsd.Sample(name=name, type=mtype, value=v,
+                              scope=dsd.SCOPE_GLOBAL))
+    res = Flusher(is_local=True).flush(src.swap())
+    return rows_to_metric_list(res.forward).SerializeToString()
+
+
+def test_cache_hits_accumulate_like_slow_path():
+    wire = _wire([("c.a", 2.0), ("c.b", 5.0)])
+    t = MetricTable(TableConfig())
+    for _ in range(3):
+        acc, drop = apply_metric_list_bytes(t, wire)
+        assert (acc, drop) == (2, 0)
+    assert len(t.import_row_cache) == 2
+    # second and third applies were pure cache hits; totals must be 3x
+    t.device_step(final=True)
+    snap = t.swap()
+    res = Flusher(is_local=False).flush(snap)
+    vals = {m.name: m.value for m in res.metrics
+            if m.name.startswith("c.")}
+    assert vals["c.a"] == pytest.approx(6.0)
+    assert vals["c.b"] == pytest.approx(15.0)
+
+
+def test_cache_cleared_on_compaction_and_rows_remap():
+    """After compaction renumbers rows, stale cached rows would
+    corrupt unrelated series — the swap must clear the cache and the
+    next wire must re-resolve correctly."""
+    cfg = TableConfig(counter_rows=8, compact_threshold=0.5)
+    t = MetricTable(cfg)
+    wire_a = _wire([(f"churn.{i}", 1.0) for i in range(5)])
+    apply_metric_list_bytes(t, wire_a)
+    t.device_step(final=True)
+    t.swap()
+    # interval 2: only a new series -> old rows go stale
+    wire_b = _wire([("keep.x", 7.0)])
+    apply_metric_list_bytes(t, wire_b)
+    t.device_step(final=True)
+    t.swap()  # occupancy 6/8 > 0.5 -> compacts, clears cache
+    assert len(t.import_row_cache) == 0
+    apply_metric_list_bytes(t, wire_b)
+    t.device_step(final=True)
+    res = Flusher(is_local=False).flush(t.swap())
+    vals = {m.name: m.value for m in res.metrics
+            if m.name.startswith(("keep.", "churn."))}
+    assert vals == {"keep.x": 7.0}
+
+
+def test_cache_size_bound_clears_and_rebuilds():
+    t = MetricTable(TableConfig())
+    t.import_row_cache_limit = 4
+    for i in range(4):
+        apply_metric_list_bytes(t, _wire([(f"s.{i}", 1.0)]))
+    assert len(t.import_row_cache) == 4
+    apply_metric_list_bytes(t, _wire([("s.new", 1.0)]))
+    # limit hit: cleared, then repopulated with the new identity
+    assert len(t.import_row_cache) == 1
+
+
+def test_gauge_validity_not_cached():
+    """A NaN gauge drops THIS wire only; the same series with a
+    finite value next wire must land (value-level checks never enter
+    the identity cache)."""
+    t = MetricTable(TableConfig())
+    bad = _wire([("g.x", float("nan"))], mtype=dsd.GAUGE)
+    good = _wire([("g.x", 3.25)], mtype=dsd.GAUGE)
+    acc, drop = apply_metric_list_bytes(t, bad)
+    assert (acc, drop) == (0, 1)
+    acc, drop = apply_metric_list_bytes(t, good)
+    assert (acc, drop) == (1, 0)
+    t.device_step(final=True)
+    res = Flusher(is_local=False).flush(t.swap())
+    vals = {m.name: m.value for m in res.metrics}
+    assert vals.get("g.x") == pytest.approx(3.25)
+
+
+def test_gauge_last_write_wins_within_wire_via_cache():
+    """Duplicate gauge rows in one wire resolve to the LAST value in
+    wire order, on both the miss pass and the cached pass."""
+    t = MetricTable(TableConfig())
+    import veneur_tpu.forward.gen.forward_pb2 as fpb
+    ml = fpb.MetricList()
+    for v in (1.0, 2.0, 9.0):
+        m = ml.metrics.add()
+        m.name = "g.dup"
+        m.type = fpb.Type.Value("GAUGE") if hasattr(
+            fpb, "Type") else 1
+        m.gauge.value = v
+    wire = ml.SerializeToString()
+    for _ in range(2):  # miss pass, then cached pass
+        apply_metric_list_bytes(t, wire)
+        t.device_step(final=True)
+        res = Flusher(is_local=False).flush(t.swap())
+        vals = {m.name: m.value for m in res.metrics}
+        assert vals.get("g.dup") == pytest.approx(9.0)
