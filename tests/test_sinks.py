@@ -940,3 +940,27 @@ def test_signalfx_chunk_cap_is_total_points(http_capture):
     sizes = [len(json.loads(b)["gauge"]) + len(json.loads(b)["counter"])
              for _, _, _, b in http_capture.requests]
     assert sum(sizes) == 6 and max(sizes) <= 4
+
+
+def test_newrelic_status_metric_becomes_event(http_capture):
+    """STATUS InterMetrics route to the account Event API as service
+    checks with the reference's status-name mapping (metric.go:
+    142-166); hostname rides as an attribute on regular metrics."""
+    import gzip as _gzip
+    from veneur_tpu.core.metrics import STATUS
+    from veneur_tpu.sinks.newrelic import NewRelicMetricSink
+    s = NewRelicMetricSink("ins", _url(http_capture), account_id=42)
+    s.events_endpoint = _url(http_capture)
+    sc = InterMetric(name="db.up", timestamp=1700000000, value=2.0,
+                     tags=("env:p",), type=STATUS, message="down",
+                     hostname="h3")
+    s.flush([sc, _metric("nr.g", 1.5, GAUGE)])
+    bodies = {r[1]: json.loads(_gzip.decompress(r[3]))
+              for r in http_capture.requests}
+    ev = bodies["/v1/accounts/42/events"][0]
+    assert ev["status"] == "CRITICAL" and ev["statusCode"] == 2
+    assert ev["name"] == "db.up" and ev["hostname"] == "h3"
+    assert ev["message"] == "down" and ev["env"] == "p"
+    metrics = bodies["/metric/v1"][0]["metrics"]
+    assert [m["name"] for m in metrics] == ["nr.g"]
+    assert metrics[0]["attributes"]["hostname"] == "h1"

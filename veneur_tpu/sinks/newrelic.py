@@ -13,7 +13,7 @@ import logging
 import threading
 import urllib.request
 
-from veneur_tpu.core.metrics import COUNTER, InterMetric
+from veneur_tpu.core.metrics import COUNTER, STATUS, InterMetric
 from veneur_tpu.sinks import base as sinks_base
 from veneur_tpu.sinks.base import SinkBase
 
@@ -63,6 +63,22 @@ class NewRelicMetricSink(SinkBase):
             else "https://insights-collector.newrelic.com")
         self.flushed_total = 0
 
+    def _post_events(self, out: list, what: str) -> bool:
+        body = gzip.compress(json.dumps(out).encode())
+        req = urllib.request.Request(
+            f"{self.events_endpoint}/v1/accounts/"
+            f"{self.account_id}/events", data=body,
+            headers={"Content-Type": "application/json",
+                     "Content-Encoding": "gzip",
+                     "Api-Key": self.insert_key}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=10.0) as r:
+                r.read()
+            return True
+        except OSError as e:
+            log.warning("newrelic %s flush failed: %s", what, e)
+            return False
+
     def flush_other_samples(self, samples: list) -> None:
         """Events + service checks -> the account-scoped Event API
         (reference newrelic sink's FlushOtherSamples)."""
@@ -82,27 +98,59 @@ class NewRelicMetricSink(SinkBase):
             if msg:
                 item["message"] = msg
             out.append(item)
-        body = gzip.compress(json.dumps(out).encode())
-        req = urllib.request.Request(
-            f"{self.events_endpoint}/v1/accounts/"
-            f"{self.account_id}/events", data=body,
-            headers={"Content-Type": "application/json",
-                     "Content-Encoding": "gzip",
-                     "Api-Key": self.insert_key}, method="POST")
-        try:
-            with urllib.request.urlopen(req, timeout=10.0) as r:
-                r.read()
-        except OSError as e:
-            log.warning("newrelic event flush failed: %s", e)
+        self._post_events(out, "event")
+
+    _STATUS_NAMES = {0: "OK", 1: "WARNING", 2: "CRITICAL"}
+
+    def _flush_status_checks(self, checks: list[InterMetric]) -> None:
+        """STATUS metrics are service-check EVENTS, not metric
+        entries (reference metric.go:142-166: eventType/name/
+        statusCode/status attributes through the Event API)."""
+        if self.account_id <= 0:
+            # Event API is account-scoped: without newrelic_account_id
+            # checks cannot be delivered anywhere (loud, not silent)
+            log.warning("newrelic: dropping %d service checks — "
+                        "newrelic_account_id is not configured",
+                        len(checks))
+            return
+        out = []
+        for m in checks:
+            attrs = _tags_to_attrs(m.tags)
+            if m.hostname:
+                attrs["hostname"] = m.hostname
+            if m.message:
+                attrs["message"] = m.message
+            attrs.update({
+                "eventType": self.service_check_event_type,
+                "name": m.name,
+                "timestamp": m.timestamp,
+                "statusCode": int(m.value),
+                "status": self._STATUS_NAMES.get(int(m.value),
+                                                 "UNKNOWN"),
+            })
+            out.append(attrs)
+        if self._post_events(out, "service-check"):
+            self.flushed_total += len(out)
 
     def flush(self, metrics: list[InterMetric]) -> None:
         if not metrics:
             return
+        checks = [m for m in metrics if m.type == STATUS]
+        if checks:
+            self._flush_status_checks(checks)
         out = []
         for m in metrics:
+            if m.type == STATUS:
+                continue
+            attrs = _tags_to_attrs(m.tags)
+            # hostname/message ride as attributes (metric.go:117-122)
+            if m.hostname:
+                attrs["hostname"] = m.hostname
+            if m.message:
+                attrs["message"] = m.message
             item = {"name": m.name,
                     "timestamp": m.timestamp * 1000,
-                    "attributes": _tags_to_attrs(m.tags)}
+                    "attributes": attrs}
             if m.type == COUNTER:
                 item["type"] = "count"
                 item["value"] = m.value
@@ -111,6 +159,8 @@ class NewRelicMetricSink(SinkBase):
                 item["type"] = "gauge"
                 item["value"] = m.value
             out.append(item)
+        if not out:
+            return
         body = gzip.compress(json.dumps(
             [{"common": {"attributes": self.common}, "metrics": out}]
         ).encode())
