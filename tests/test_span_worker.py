@@ -1,0 +1,118 @@
+"""SpanWorker failure isolation (core/spans.py).
+
+The reference gives every span sink a bounded ingest chance per span
+and a wedged sink cannot stall the rest (worker.go:611-694).  These
+tests pin that property directly — the server-level suites only
+exercise the happy path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from veneur_tpu.core import spans as spans_mod
+from veneur_tpu.core.spans import SpanWorker
+from veneur_tpu.protocol.gen import ssf_pb2
+
+
+def _span(i=1, service="svc"):
+    return ssf_pb2.SSFSpan(
+        version=0, trace_id=i, id=i + 1, parent_id=0, name="op",
+        service=service, start_timestamp=1_700_000_000_000_000_000,
+        end_timestamp=1_700_000_001_000_000_000)
+
+
+class _GoodSink:
+    name = "good"
+
+    def __init__(self):
+        self.got = []
+
+    def ingest(self, span):
+        self.got.append(span)
+
+
+class _WedgedSink:
+    name = "wedged"
+
+    def __init__(self, release: threading.Event):
+        self.release = release
+        self.entered = threading.Event()
+
+    def ingest(self, span):
+        self.entered.set()
+        self.release.wait(30)
+
+
+def test_wedged_sink_does_not_stall_others(monkeypatch):
+    """One sink hangs mid-ingest: later spans keep flowing to the
+    healthy sink, the wedged sink's spans are shed (not queued), and
+    drops are counted."""
+    monkeypatch.setattr(spans_mod, "SINK_TIMEOUT", 0.3)
+    release = threading.Event()
+    good, wedged = _GoodSink(), _WedgedSink(release)
+    stats: dict[str, int] = {}
+
+    def cb(name, n=1):
+        stats[name] = stats.get(name, 0) + n
+
+    w = SpanWorker([wedged, good], {}, stats_cb=cb)
+    w.start()
+    try:
+        assert w.submit(_span(1))
+        assert wedged.entered.wait(5)
+        # the first span rides out the timeout, then the wedged flag
+        # sheds every later span instantly
+        deadline = time.time() + 10
+        n = 2
+        while time.time() < deadline and len(good.got) < 5:
+            w.submit(_span(n))
+            n += 1
+            time.sleep(0.05)
+        assert len(good.got) >= 5
+        assert stats.get("span_sink_dropped", 0) >= 1
+        # wedged sink saw exactly the one span that wedged it
+        assert wedged.entered.is_set()
+    finally:
+        release.set()
+        w.stop()
+
+
+def test_common_tags_fill_missing_only():
+    good = _GoodSink()
+    w = SpanWorker([good], {"env": "prod", "host": "h1"})
+    w.start()
+    try:
+        s = _span(9)
+        s.tags["env"] = "dev"
+        w.submit(s)
+        deadline = time.time() + 5
+        while time.time() < deadline and not good.got:
+            time.sleep(0.02)
+        assert good.got
+        assert good.got[0].tags["env"] == "dev"  # not overwritten
+        assert good.got[0].tags["host"] == "h1"  # filled
+    finally:
+        w.stop()
+
+
+def test_invalid_span_without_metrics_dropped():
+    good = _GoodSink()
+    stats: dict[str, int] = {}
+    w = SpanWorker([good], {},
+                   stats_cb=lambda k, n=1: stats.__setitem__(
+                       k, stats.get(k, 0) + n))
+    w.start()
+    try:
+        bad = ssf_pb2.SSFSpan()  # no ids, no metrics
+        w.submit(bad)
+        deadline = time.time() + 5
+        while time.time() < deadline and not stats.get("empty_ssf"):
+            time.sleep(0.02)
+        assert stats.get("empty_ssf", 0) >= 1
+        assert not good.got
+    finally:
+        w.stop()
