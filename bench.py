@@ -85,6 +85,13 @@ _GATE_TAG = "".join(f".{k}-{v}" for k, v in sorted(_GATES.items())
                     if v != _GATES_DEFAULT[k])
 
 
+def _resolve_merge_for(platform: str) -> str:
+    """tdigest's pure auto-resolution rule (no jax backend init —
+    importing the module is backend-free by design)."""
+    from veneur_tpu.ops import tdigest as _td
+    return _td.resolve_merge_mode_for(platform)
+
+
 def _backend_info() -> dict:
     """Platform stamp for artifacts: what backend did THIS process
     actually run on.  A CPU capture must be unmistakable for a device
@@ -1116,7 +1123,18 @@ def _assemble(configs: dict, t_start: float,
         "num_devices": stamp.get("num_devices"),
         "jax_version": stamp.get("jax_version"),
         "platform_pin": _PLATFORM_PIN or None,
-        "gates": dict(_GATES),
+        # headline gates carry the resolved merge mode + fallback like
+        # the config rows — resolved from the subprocess-captured
+        # platform stamp via tdigest's pure rule, NOT _backend_info():
+        # importing jax here would initialize the backend in the
+        # PARENT, which hangs on a dead tunnel link exactly when the
+        # driver is waiting for this line
+        "gates": dict(
+            _GATES,
+            merge_resolved=_resolve_merge_for(
+                stamp.get("platform", "unknown")),
+            merge_fallback=os.environ.get(
+                "VENEUR_TPU_MERGE_FALLBACK", "scatter")),
         "platform_mixed": sorted(platforms) if len(platforms) > 1
         else None,
         "quick": QUICK,

@@ -75,16 +75,24 @@ _MERGE_MODE = os.environ.get("VENEUR_TPU_MERGE", "auto")
 _FALLBACK_MODE = os.environ.get("VENEUR_TPU_MERGE_FALLBACK", "scatter")
 
 
+def resolve_merge_mode_for(platform: str) -> str:
+    """Pure resolution rule, usable without touching a jax backend
+    (bench's parent process stamps headlines from a subprocess-
+    captured platform string — importing jax there can hang on a
+    dead tunnel link)."""
+    if _MERGE_MODE != "auto":
+        return _MERGE_MODE
+    return "pallas" if platform == "tpu" else "scatter"
+
+
 def resolved_merge_mode() -> str:
     """The merge strategy in effect: "auto" resolves per backend at
     call time (bench artifacts record this resolved value)."""
-    if _MERGE_MODE != "auto":
-        return _MERGE_MODE
     try:
         backend = jax.default_backend()
     except Exception:  # pragma: no cover - backend init failure
-        return "scatter"
-    return "pallas" if backend == "tpu" else "scatter"
+        backend = "unknown"
+    return resolve_merge_mode_for(backend)
 
 DEFAULT_COMPRESSION = 100.0
 
