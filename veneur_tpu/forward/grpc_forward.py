@@ -170,70 +170,123 @@ def apply_metric_list(table: MetricTable,
 # columnar wire decode (native vtpu_metriclist_decode)
 
 
+import threading as _threading
+
+# Per-thread decode buffer scratch — policy in _decode_native's
+# docstring.
+_decode_scratch = _threading.local()
+
+
+def _decode_call(lib, buf, n, cap_m, cap_c, cap_t, cols,
+                 needed) -> int:
+    import ctypes
+
+    def p(a, ct):
+        return a.ctypes.data_as(ctypes.POINTER(ct))
+
+    return lib.vtpu_metriclist_decode(
+        p(buf, ctypes.c_uint8), n, cap_m, cap_c, cap_t,
+        p(cols["name_off"], ctypes.c_int64),
+        p(cols["name_len"], ctypes.c_int32),
+        p(cols["kind"], ctypes.c_uint8),
+        p(cols["mtype"], ctypes.c_int32),
+        p(cols["scope"], ctypes.c_int32),
+        p(cols["scalar"], ctypes.c_double),
+        p(cols["dstats"], ctypes.c_double),
+        p(cols["cent_start"], ctypes.c_int64),
+        p(cols["cent_cnt"], ctypes.c_int32),
+        p(cols["means"], ctypes.c_float),
+        p(cols["weights"], ctypes.c_float),
+        p(cols["tag_start"], ctypes.c_int64),
+        p(cols["tag_cnt"], ctypes.c_int32),
+        p(cols["tag_off"], ctypes.c_int64),
+        p(cols["tag_len"], ctypes.c_int32),
+        p(cols["hll_off"], ctypes.c_int64),
+        p(cols["hll_len"], ctypes.c_int32),
+        p(needed, ctypes.c_int64))
+
+
+_SCRATCH_MAX_BYTES = 32 << 20
+
+
+def _cols_nbytes(cols: dict) -> int:
+    return sum(a.nbytes for a in cols.values()
+               if isinstance(a, np.ndarray))
+
+
+def _keep_scratch(cols: dict) -> None:
+    if _cols_nbytes(cols) <= _SCRATCH_MAX_BYTES:
+        _decode_scratch.cols = cols
+    else:
+        _decode_scratch.cols = None
+
+
+def _alloc_cols(cap_m: int, cap_c: int, cap_t: int) -> dict:
+    return {
+        "name_off": np.empty(cap_m, np.int64),
+        "name_len": np.empty(cap_m, np.int32),
+        "kind": np.empty(cap_m, np.uint8),
+        "mtype": np.empty(cap_m, np.int32),
+        "scope": np.empty(cap_m, np.int32),
+        "scalar": np.empty(cap_m, np.float64),
+        "dstats": np.empty((cap_m, 4), np.float64),
+        "cent_start": np.empty(cap_m, np.int64),
+        "cent_cnt": np.empty(cap_m, np.int32),
+        "means": np.empty(cap_c, np.float32),
+        "weights": np.empty(cap_c, np.float32),
+        "tag_start": np.empty(cap_m, np.int64),
+        "tag_cnt": np.empty(cap_m, np.int32),
+        "tag_off": np.empty(cap_t, np.int64),
+        "tag_len": np.empty(cap_t, np.int32),
+        "hll_off": np.empty(cap_m, np.int64),
+        "hll_len": np.empty(cap_m, np.int32),
+    }
+
+
 def _decode_native(lib, data: bytes):
     """Run the C++ wire walker, growing buffers once if the guess was
     small.  Returns the column dict, None when the wire is malformed
-    (caller falls back to protobuf for its per-item isolation)."""
-    import ctypes
+    (caller falls back to protobuf for its per-item isolation).
+
+    Buffers come from a per-thread scratch cache: a steady-state
+    global decodes same-sized wires from each peer every interval,
+    and reallocating the ~15 column arrays per call profiled at
+    ~100ms of a c4 interval.  Thread-local because concurrent gRPC
+    handler threads need their own scratch; safe because
+    apply_metric_list_bytes only reads the columns within the call
+    (everything staged is a copy).  Scratch above _SCRATCH_MAX_BYTES
+    is not retained — one near-max 64MB wire must not pin ~230MB of
+    columns per handler thread forever."""
     n = len(data)
     buf = np.frombuffer(data, np.uint8)
     cap_m = max(256, n // 48)
     cap_c = max(1024, n // 18)
     cap_t = cap_m * 4
+    needed = np.zeros(3, np.int64)
+    cols = getattr(_decode_scratch, "cols", None)
+    if (cols is None or len(cols["name_off"]) < cap_m or
+            len(cols["means"]) < cap_c or
+            len(cols["tag_off"]) < cap_t):
+        cols = _alloc_cols(cap_m, cap_c, cap_t)
+        _keep_scratch(cols)
     for _ in range(2):
-        cols = {
-            "name_off": np.empty(cap_m, np.int64),
-            "name_len": np.empty(cap_m, np.int32),
-            "kind": np.empty(cap_m, np.uint8),
-            "mtype": np.empty(cap_m, np.int32),
-            "scope": np.empty(cap_m, np.int32),
-            "scalar": np.empty(cap_m, np.float64),
-            "dstats": np.empty((cap_m, 4), np.float64),
-            "cent_start": np.empty(cap_m, np.int64),
-            "cent_cnt": np.empty(cap_m, np.int32),
-            "means": np.empty(cap_c, np.float32),
-            "weights": np.empty(cap_c, np.float32),
-            "tag_start": np.empty(cap_m, np.int64),
-            "tag_cnt": np.empty(cap_m, np.int32),
-            "tag_off": np.empty(cap_t, np.int64),
-            "tag_len": np.empty(cap_t, np.int32),
-            "hll_off": np.empty(cap_m, np.int64),
-            "hll_len": np.empty(cap_m, np.int32),
-        }
-        needed = np.zeros(3, np.int64)
-
-        def p(a, ct):
-            return a.ctypes.data_as(ctypes.POINTER(ct))
-
-        rc = lib.vtpu_metriclist_decode(
-            p(buf, ctypes.c_uint8), n, cap_m, cap_c, cap_t,
-            p(cols["name_off"], ctypes.c_int64),
-            p(cols["name_len"], ctypes.c_int32),
-            p(cols["kind"], ctypes.c_uint8),
-            p(cols["mtype"], ctypes.c_int32),
-            p(cols["scope"], ctypes.c_int32),
-            p(cols["scalar"], ctypes.c_double),
-            p(cols["dstats"], ctypes.c_double),
-            p(cols["cent_start"], ctypes.c_int64),
-            p(cols["cent_cnt"], ctypes.c_int32),
-            p(cols["means"], ctypes.c_float),
-            p(cols["weights"], ctypes.c_float),
-            p(cols["tag_start"], ctypes.c_int64),
-            p(cols["tag_cnt"], ctypes.c_int32),
-            p(cols["tag_off"], ctypes.c_int64),
-            p(cols["tag_len"], ctypes.c_int32),
-            p(cols["hll_off"], ctypes.c_int64),
-            p(cols["hll_len"], ctypes.c_int32),
-            p(needed, ctypes.c_int64))
+        rc = _decode_call(lib, buf, n, len(cols["name_off"]),
+                          len(cols["means"]), len(cols["tag_off"]),
+                          cols, needed)
         if rc == -1:
             return None
-        if rc == -2:
-            cap_m = max(int(needed[0]), 1)
-            cap_c = max(int(needed[1]), 1)
-            cap_t = max(int(needed[2]), 1)
-            continue
-        cols["n"] = int(rc)
-        return cols
+        if rc >= 0:
+            out = dict(cols)
+            out["n"] = int(rc)
+            return out
+        # rc == -2: grow to the elementwise max of the exact need and
+        # the size heuristic — exact-only buffers for a centroid-dense
+        # wire would sit BELOW the next call's heuristic and be
+        # evicted, re-walking every wire twice forever
+        cols = _alloc_cols(max(int(needed[0]), cap_m, 1),
+                           max(int(needed[1]), cap_c, 1),
+                           max(int(needed[2]), cap_t, 1))
+        _keep_scratch(cols)
     return None  # still over after the exact-size retry: give up
 
 
