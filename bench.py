@@ -516,6 +516,19 @@ def bench_global_merge() -> dict:
 
 
 
+def _rss_now_kb() -> int:
+    # current (not peak) RSS: ru_maxrss is a lifetime high-water
+    # mark and cannot measure growth during a run
+    try:
+        with open("/proc/self/status") as f:
+            for ln in f:
+                if ln.startswith("VmRSS:"):
+                    return int(ln.split()[1])
+    except OSError:
+        pass
+    return 0
+
+
 def _save_artifact(stem: str, out: dict) -> None:
     """Persist a mode's result JSON under bench_results/ (quick runs
     get their own suffix and are gitignored)."""
@@ -726,18 +739,6 @@ def sockets_bench() -> dict:
 
     import resource
 
-    def _rss_now_kb() -> int:
-        # current (not peak) RSS: ru_maxrss is a lifetime high-water
-        # mark and cannot measure growth during the run
-        try:
-            with open("/proc/self/status") as f:
-                for ln in f:
-                    if ln.startswith("VmRSS:"):
-                        return int(ln.split()[1])
-        except OSError:
-            pass
-        return 0
-
     out: dict = {"mode": "sockets", "quick": QUICK}
     duration = 5.0 if QUICK else 12.0
     rss0_kb = _rss_now_kb()
@@ -817,6 +818,144 @@ def sockets_bench() -> dict:
     out.update(_backend_info())
     out["captured_unix"] = round(time.time(), 1)
     _save_artifact("sockets_bench", out)
+    return out
+
+
+def soak_bench() -> dict:
+    """``--soak``: long-run stability under sustained mixed load —
+    the leak/cadence counterpart of the throughput modes.  A live
+    Server ingests paced counters/gauges/timers/sets plus events,
+    service checks and SSF spans for VENEUR_SOAK_SECONDS (default
+    1200; --quick 60) while RSS, thread count and flush cadence are
+    sampled every 15s.  The verdicts the artifact asserts:
+
+    - rss_slope_mb_per_min over the SECOND half (past jit warmup and
+      row allocation) stays under 1 MB/min — a steady-state server
+      must not creep;
+    - thread count is flat after startup (a leaked thread per
+      interval/flush is the classic wedge);
+    - flushes land on cadence (count within 20% of duration/interval
+      — the watchdog's no-flush condition never approaches).
+
+    Loadgen shares the core, so the PACED rate is deliberately modest
+    (~50k samples/s): this measures drift, not throughput."""
+    import socket as socket_mod
+    import threading
+
+    from veneur_tpu.core.config import read_config
+    from veneur_tpu.core.server import Server
+
+    duration = float(os.environ.get(
+        "VENEUR_SOAK_SECONDS", "60" if QUICK else "1200"))
+    interval_s = 3.0
+    srv = Server(read_config(data={
+        "statsd_listen_addresses": ["udp://127.0.0.1:0"],
+        "ssf_listen_addresses": ["udp://127.0.0.1:0"],
+        "interval": f"{int(interval_s)}s",
+        "hostname": "soak",
+        "accelerator_probe_timeout": "5s"}))
+    srv.start()
+    samples = []
+    sent_box = [0]
+    stop = threading.Event()
+    try:
+        port = srv.statsd_ports[0]
+
+        def blast():
+            s = socket_mod.socket(socket_mod.AF_INET,
+                                  socket_mod.SOCK_DGRAM)
+            s.connect(("127.0.0.1", port))
+            rng = np.random.default_rng(0)
+            vals = rng.gamma(2.0, 30.0, 4096)
+            i = 0
+            # ~50k samples/s: 5k-line burst per 100ms tick
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                for _ in range(200):
+                    j = i % 4096
+                    batch = [
+                        f"soak.ctr.{j % 400}:{1 + j % 7}|c",
+                        f"soak.gauge.{j % 200}:{vals[j]:.2f}|g",
+                        f"soak.lat.{j % 300}:{vals[j]:.3f}|ms",
+                        f"soak.lat.{(j + 7) % 300}:{vals[(j + 7) % 4096]:.3f}|ms",
+                        f"soak.uniq.{j % 50}:m{i}|s",
+                    ]
+                    if j % 512 == 0:
+                        batch.append("_e{10,9}:soak event|soak body")
+                        batch.append("_sc|soak.up|0")
+                    try:
+                        s.send("\n".join(batch).encode())
+                    except OSError:
+                        pass
+                    sent_box[0] += len(batch)
+                    i += 1
+                lag = 0.1 - (time.perf_counter() - t0)
+                if lag > 0:
+                    time.sleep(lag)
+            s.close()
+
+        t = threading.Thread(target=blast, daemon=True)
+        t_start = time.perf_counter()
+        t.start()
+        next_sample = 15.0
+        while time.perf_counter() - t_start < duration:
+            time.sleep(1.0)
+            el = time.perf_counter() - t_start
+            if el >= next_sample:
+                samples.append({
+                    "t": round(el, 1),
+                    "rss_mb": round(_rss_now_kb() / 1024.0, 1),
+                    "threads": threading.active_count(),
+                    "flushes": srv.stats.get("flushes", 0),
+                    "metrics": srv.stats.get("metrics_processed", 0),
+                })
+                next_sample += 15.0
+        stop.set()
+        t.join(10.0)
+    finally:
+        srv.shutdown()
+
+    out: dict = {"mode": "soak", "quick": QUICK,
+                 "duration_seconds": duration,
+                 "interval_seconds": interval_s,
+                 "offered_samples": sent_box[0],
+                 "samples": samples}
+    if len(samples) >= 4:
+        half = samples[len(samples) // 2:]
+        ts = np.asarray([s["t"] for s in half])
+        rss = np.asarray([s["rss_mb"] for s in half])
+        slope = float(np.polyfit(ts, rss, 1)[0] * 60.0)
+        thr = [s["threads"] for s in half]
+        # cadence over the SECOND half too: the first interval's jit
+        # warmup (~20-40s) structurally delays early flushes
+        flushes = half[-1]["flushes"] - half[0]["flushes"]
+        span_t = half[-1]["t"] - half[0]["t"]
+        expect = max(span_t / interval_s, 1e-9)
+        out["rss_slope_mb_per_min"] = round(slope, 3)
+        out["threads_min_max"] = [min(thr), max(thr)]
+        out["flush_cadence_ratio"] = round(flushes / expect, 3)
+        if duration >= 300:
+            out["verdicts"] = {
+                "rss_stable": bool(slope < 1.0),
+                "threads_stable": bool(max(thr) - min(thr) <= 2),
+                "flush_cadence_ok": bool(
+                    0.8 <= flushes / expect <= 1.2),
+            }
+            out["ok"] = all(out["verdicts"].values())
+        else:
+            # sub-5-minute runs end inside jit warmup/row allocation;
+            # RSS slope there measures ramp, not leak
+            out["ok"] = None
+            out["note"] = ("duration < 300s: smoke only, no "
+                           "stability verdicts")
+    out.update(_backend_info())
+    out["captured_unix"] = round(time.time(), 1)
+    if duration >= 300:
+        _save_artifact("soak_bench", out)
+    else:
+        # short smokes must not overwrite the committed gating
+        # artifact (tests assert its verdicts)
+        _save_artifact("soak_bench.smoke", out)
     return out
 
 
@@ -1229,6 +1368,8 @@ if __name__ == "__main__":
         print(json.dumps(sockets_bench()))
     elif "--tls" in sys.argv:
         print(json.dumps(tls_bench()))
+    elif "--soak" in sys.argv:
+        print(json.dumps(soak_bench()))
     elif "--chain" in sys.argv:
         print(json.dumps(chain_bench()))
     elif "--config" in sys.argv:

@@ -228,3 +228,19 @@ def test_chain_bench_artifact_committed():
     assert d["items_forwarded"] == d["items_expected"]
     assert d["local_interval_headroom_x"] >= 5.0
     assert "platform" in d and "gates" in d
+
+
+def test_soak_artifact_committed_and_stable():
+    """The committed 20-minute soak artifact must carry passing
+    stability verdicts (RSS slope, thread flatness, flush cadence) —
+    the long-run counterpart of the throughput gates."""
+    import pathlib
+    path = pathlib.Path(__file__).parent.parent / "bench_results" / \
+        "soak_bench.json"
+    d = json.loads(path.read_text())
+    assert d["duration_seconds"] >= 300
+    assert d["ok"] is True, d.get("verdicts")
+    assert d["verdicts"] == {"rss_stable": True,
+                             "threads_stable": True,
+                             "flush_cadence_ok": True}
+    assert d["platform"]  # stamped
