@@ -30,6 +30,10 @@ RUNS = {
     "auto default, keep-best window (c2)": "watch_bench_auto.json",
     "VENEUR_TPU_MERGE=scatter (c2, post-adoption A/B)":
         "watch_ab_scatter_c2.json",
+    "VENEUR_TPU_F16_PLANE=0 (c2, vs fused baseline)":
+        "watch_ab_f16off_auto_c2.json",
+    "VENEUR_TPU_TAIL_REFINE=0 (c2, vs fused baseline)":
+        "watch_ab_tailoff_auto_c2.json",
 }
 
 

@@ -99,6 +99,23 @@ print(err or 'HEALTHY ' + json.dumps(info))" 2>&1 | tail -1)
           > bench_results/watch_ab_scatter_c2.json 2>> "$LOG"
       echo "$(date -u +%FT%TZ) scatter A/B done rc=$?" >> "$LOG"
     fi
+    # post-adoption levers against the fused-kernel baseline: with
+    # the merge no longer dominant, the transfer-width and capacity
+    # trades may answer differently than against scatter
+    if ! ab_valid bench_results/watch_ab_f16off_auto_c2.json \
+        2_timers_10k_series; then
+      VENEUR_TPU_F16_PLANE=0 VENEUR_BENCH_BUDGET=420 timeout 500 \
+          python bench.py --config 2_timers_10k_series \
+          > bench_results/watch_ab_f16off_auto_c2.json 2>> "$LOG"
+      echo "$(date -u +%FT%TZ) f16off-auto A/B done rc=$?" >> "$LOG"
+    fi
+    if ! ab_valid bench_results/watch_ab_tailoff_auto_c2.json \
+        2_timers_10k_series; then
+      VENEUR_TPU_TAIL_REFINE=0 VENEUR_BENCH_BUDGET=420 timeout 500 \
+          python bench.py --config 2_timers_10k_series \
+          > bench_results/watch_ab_tailoff_auto_c2.json 2>> "$LOG"
+      echo "$(date -u +%FT%TZ) tailoff-auto A/B done rc=$?" >> "$LOG"
+    fi
     python bench_results/summarize_ab.py >> "$LOG" 2>&1
     sleep 120
   ;; esac
