@@ -57,8 +57,8 @@ print("merged best: " + ", ".join(
 EOF
 }
 
-ab_valid() {  # $1 artifact, $2 config key: real rate present?
-  python - "$1" "$2" <<'EOF'
+ab_valid() {  # $1 artifact, $2 config key, [$3 max median interval]
+  python - "$1" "$2" "${3:-0}" <<'EOF'
 import json, sys
 try:
     with open(sys.argv[1]) as f:
@@ -68,6 +68,14 @@ try:
     # "configs" (same duality summarize_ab._config_row handles)
     cfg = (d.get("configs") or d)[sys.argv[2]]
     ok = bool(cfg.get("samples_per_sec") or cfg.get("items_per_sec"))
+    # window-quality gate: a variant captured while the link was
+    # degraded (median interval blown out vs the golden-window
+    # profile) must be retried, not kept — its magnitude says
+    # nothing about the lever
+    max_med = float(sys.argv[3])
+    if ok and max_med > 0:
+        iv = sorted(cfg.get("interval_seconds", []))
+        ok = bool(iv) and iv[len(iv) // 2] <= max_med
 except Exception:
     ok = False
 sys.exit(0 if ok else 1)
@@ -103,14 +111,14 @@ print(err or 'HEALTHY ' + json.dumps(info))" 2>&1 | tail -1)
     # the merge no longer dominant, the transfer-width and capacity
     # trades may answer differently than against scatter
     if ! ab_valid bench_results/watch_ab_f16off_auto_c2.json \
-        2_timers_10k_series; then
+        2_timers_10k_series 2.0; then
       VENEUR_TPU_F16_PLANE=0 VENEUR_BENCH_BUDGET=420 timeout 500 \
           python bench.py --config 2_timers_10k_series \
           > bench_results/watch_ab_f16off_auto_c2.json 2>> "$LOG"
       echo "$(date -u +%FT%TZ) f16off-auto A/B done rc=$?" >> "$LOG"
     fi
     if ! ab_valid bench_results/watch_ab_tailoff_auto_c2.json \
-        2_timers_10k_series; then
+        2_timers_10k_series 1.5; then
       VENEUR_TPU_TAIL_REFINE=0 VENEUR_BENCH_BUDGET=420 timeout 500 \
           python bench.py --config 2_timers_10k_series \
           > bench_results/watch_ab_tailoff_auto_c2.json 2>> "$LOG"
