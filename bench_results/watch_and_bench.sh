@@ -82,7 +82,16 @@ sys.exit(0 if ok else 1)
 EOF
 }
 
+# Self-stop deadline (epoch seconds, VENEUR_WATCH_DEADLINE): the
+# driver's end-of-round bench must not contend with a watcher bench
+# for the one core + device.
+DEADLINE="${VENEUR_WATCH_DEADLINE:-0}"
+
 for i in $(seq 1 2000); do
+  if [ "$DEADLINE" -gt 0 ] && [ "$(date -u +%s)" -ge "$DEADLINE" ]; then
+    echo "$(date -u +%FT%TZ) watcher deadline reached; stopping" >> "$LOG"
+    exit 0
+  fi
   out=$(timeout 120 python -c "
 from veneur_tpu.utils import devprobe
 import json
