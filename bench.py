@@ -392,8 +392,13 @@ def bench_sets() -> dict:
         nmeta = len(snap.set_meta)
         if snap.host_only_sets:
             # device-free set interval: estimate on the flusher thread
-            return lambda: hll.estimate_np(
-                snap.hll_host_plane)[:nmeta][live]
+            # (O(rows) from the fold-maintained stats when native),
+            # then hand the plane back to the table's reuse pool
+            def run():
+                est = snap.host_set_estimates()[:nmeta][live]
+                snap.release()
+                return est
+            return run
         est = hll.estimate(snap.hll_regs)
         _async_np(est)
         return lambda: np.asarray(est)[:nmeta][live]
@@ -624,7 +629,7 @@ def accuracy_soak() -> dict:
     snap = table.swap()
     live = snap.set_touched[:len(snap.set_meta)]
     if snap.host_only_sets:
-        est = hll.estimate_np(snap.hll_host_plane)[:len(snap.set_meta)]
+        est = snap.host_set_estimates()[:len(snap.set_meta)]
     else:
         est = np.asarray(hll.estimate(snap.hll_regs))[
             :len(snap.set_meta)]

@@ -1,0 +1,91 @@
+"""Phase breakdown of bench config 3 (sets) on the host path.
+
+Times each phase of one steady interval in isolation: parse,
+native ingest, HLL host-plane fold, and the numpy estimate, plus the
+full pipeline for cross-checking.  Run with JAX_PLATFORMS=cpu; the
+sets config never dispatches to the device (host_set_plane_max_bytes).
+"""
+import time
+
+import numpy as np
+
+from veneur_tpu.core.table import MetricTable, TableConfig
+from veneur_tpu.ops import hll
+from veneur_tpu.protocol import columnar
+
+
+def main():
+    n = 1_000_000
+    lines = [f"uniq.{i % 1000}:m{i}|s".encode() for i in range(n)]
+    buf = b"\n".join(lines)
+    parser = columnar.ColumnarParser()
+    table = MetricTable(TableConfig(set_rows=1024))
+
+    # warm: resolve all keys, allocate plane
+    pb = parser.parse(buf, copy=False)
+    table.ingest_columns(pb)
+    table.device_step()
+    table.swap()
+
+    R = 5
+
+    def t(fn, reps=R):
+        best = 1e9
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    # phase 1: parse only
+    tp = t(lambda: parser.parse(buf, copy=False))
+    print(f"parse:            {tp*1e3:8.2f} ms  ({n/tp/1e6:.1f}M lines/s)")
+
+    # phase 2: ingest (parse excluded)
+    pb = parser.parse(buf, copy=False)
+
+    def ing():
+        table.ingest_columns(pb)
+        # drop staging so it doesn't accumulate across reps
+        table._set_pos_rows.clear()
+        table._set_pos.clear()
+        table._staged_n = 0
+    ti = t(ing)
+    print(f"vtpu_ingest:      {ti*1e3:8.2f} ms  ({n/ti/1e6:.1f}M samples/s)")
+
+    # phase 3: host fold (vtpu_hll_plane)
+    table.ingest_columns(pb)
+    srows = np.concatenate(table._set_pos_rows)
+    spos = np.concatenate(table._set_pos)
+    table._set_pos_rows.clear()
+    table._set_pos.clear()
+    table._staged_n = 0
+    tf = t(lambda: table._hll_host_fold(srows, spos))
+    print(f"hll_host_fold:    {tf*1e3:8.2f} ms  ({n/tf/1e6:.1f}M members/s)")
+
+    # phase 4: estimate_np over the 1024x16384 plane
+    plane = table._hll_host_plane
+    te = t(lambda: hll.estimate_np(plane))
+    print(f"estimate_np:      {te*1e3:8.2f} ms")
+
+    print(f"sum:              {(tp+ti+tf+te)*1e3:8.2f} ms "
+          f"-> {n/(tp+ti+tf+te)/1e6:.2f}M samples/s serial bound")
+
+    # full pipeline interval, as bench does it (fold happens in
+    # device_step/swap path)
+    table2 = MetricTable(TableConfig(set_rows=1024))
+
+    def interval(tab):
+        pb = parser.parse(buf, copy=False)
+        tab.ingest_columns(pb)
+        tab.device_step()
+        snap = tab.swap()
+        est = hll.estimate_np(snap.hll_host_plane)[:len(snap.set_meta)]
+        return est
+    interval(table2)  # warm
+    tw = t(lambda: interval(table2))
+    print(f"full interval:    {tw*1e3:8.2f} ms  ({n/tw/1e6:.2f}M samples/s)")
+
+
+if __name__ == "__main__":
+    main()

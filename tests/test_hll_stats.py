@@ -1,0 +1,88 @@
+"""Incremental LogLog-Beta statistics (vtpu_hll_plane_stats) vs the
+full-plane rescan.
+
+The native fold maintains per-row (ez, inv_sum) so the flush estimate
+is O(rows); these tests pin that the fold-maintained statistics and
+the resulting estimates match a fresh rescan of the register plane
+exactly enough to be interchangeable (reference estimator:
+vendor hyperloglog.go:206-226; insert samplers/samplers.go:375).
+"""
+
+import numpy as np
+import pytest
+
+from veneur_tpu import native
+from veneur_tpu.core.table import MetricTable, TableConfig
+from veneur_tpu.ops import hll
+from veneur_tpu.protocol import columnar
+
+
+def _fold_via_table(batches, set_rows=64):
+    """Drive the production fold path: parse -> ingest -> swap."""
+    table = MetricTable(TableConfig(set_rows=set_rows))
+    parser = columnar.ColumnarParser()
+    for lines in batches:
+        pb = parser.parse(b"\n".join(lines), copy=False)
+        table.ingest_columns(pb)
+        table.device_step()
+    return table.swap()
+
+
+def test_stats_match_plane_rescan():
+    if native.load() is None:
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(7)
+    batches = []
+    for _ in range(3):  # multiple fold calls must accumulate
+        batches.append([
+            f"s.{rng.integers(0, 40)}:m{rng.integers(0, 5000)}|s"
+            .encode() for _ in range(4000)])
+    snap = _fold_via_table(batches)
+    assert snap.hll_host_ez is not None
+    plane = snap.hll_host_plane
+    # ez must be exact
+    np.testing.assert_array_equal(
+        snap.hll_host_ez, (plane == 0).sum(axis=-1).astype(np.int32))
+    # inv_sum to accumulation rounding
+    lut = np.exp2(-np.arange(64, dtype=np.float64))
+    fresh = lut[plane].sum(axis=-1)
+    np.testing.assert_allclose(snap.hll_host_inv, fresh, rtol=1e-9)
+
+
+def test_estimates_interchangeable():
+    if native.load() is None:
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(8)
+    lines = [f"u.{i % 16}:m{rng.integers(0, 100_000)}|s".encode()
+             for i in range(50_000)]
+    snap = _fold_via_table([lines], set_rows=32)
+    got = snap.host_set_estimates()
+    want = hll.estimate_np(snap.hll_host_plane)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    # and the estimate is actually accurate on the live rows
+    live = snap.set_touched[:len(snap.set_meta)]
+    per = np.unique(
+        np.array([ln.split(b":")[0] for ln in lines]),
+        return_counts=False)
+    assert len(per) == live.sum()
+
+
+def test_python_fallback_has_no_stats_but_estimates():
+    """A table whose native lib is absent folds pure-Python; the
+    snapshot then carries no stats and host_set_estimates falls back
+    to the rescan."""
+    table = MetricTable(TableConfig(set_rows=16))
+    table._lib = None
+    parser = columnar.ColumnarParser()
+    pb = parser.parse(
+        b"\n".join(f"x.{i % 4}:m{i}|s".encode() for i in range(2000)),
+        copy=False)
+    table.ingest_columns(pb)
+    table.device_step()
+    snap = table.swap()
+    assert snap.hll_host_plane is not None
+    assert snap.hll_host_ez is None
+    est = snap.host_set_estimates()
+    live = est[:len(snap.set_meta)][
+        snap.set_touched[:len(snap.set_meta)]]
+    np.testing.assert_allclose(live, 500.0, rtol=0.1)

@@ -1,0 +1,53 @@
+"""Loader recovery from a stale cached native library.
+
+A cached .so can pass the mtime freshness check yet predate a newly
+added symbol (clock skew, copied build trees).  load() must detect
+the missing symbol, rebuild, and — because dlopen caches loaded
+objects by pathname — bring the fresh build in under a unique name
+rather than silently re-binding the stale image or abandoning the
+native path for the process lifetime.
+"""
+
+import os
+import subprocess
+import time
+
+import pytest
+
+from veneur_tpu import native
+
+
+def test_stale_so_rebuilds_and_loads(tmp_path):
+    import shutil
+    # load() succeeding can mean a cached .so, not a live toolchain
+    if native.load() is None or shutil.which("g++") is None:
+        pytest.skip("no toolchain")
+    build_dir = tmp_path / "_build"
+    build_dir.mkdir()
+    stale = build_dir / "dsd_parse.so"
+    # a syntactically valid library that lacks every vtpu_* symbol
+    stub = tmp_path / "stub.cpp"
+    stub.write_text("extern \"C\" int vtpu_stub() { return 0; }\n")
+    subprocess.run(["g++", "-shared", "-fPIC", "-o", str(stale),
+                    str(stub)], check=True, capture_output=True)
+    # make the stub look fresher than the real source
+    future = time.time() + 10
+    os.utime(stale, (future, future))
+
+    saved = (native._SO, native._BUILD_DIR, native._lib, native._tried)
+    try:
+        native._SO = str(stale)
+        native._BUILD_DIR = str(build_dir)
+        native._lib = None
+        native._tried = False
+        lib = native.load()
+        assert lib is not None
+        # the newest symbol must be bound (argtypes set by _bind)
+        assert lib.vtpu_hll_plane_stats.argtypes is not None
+        # and the fresh image came in under a unique retry name
+        retries = [f for f in os.listdir(build_dir)
+                   if f.startswith("dsd_parse.so.r")]
+        assert retries
+    finally:
+        (native._SO, native._BUILD_DIR, native._lib,
+         native._tried) = saved

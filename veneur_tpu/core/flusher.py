@@ -288,7 +288,7 @@ class Flusher:
                     pre["fwd_regs"] = snap.hll_host_plane[
                         np.asarray(fwd, np.int64)]
                 if need_est:
-                    pre["ests"] = hll.estimate_np(snap.hll_host_plane)
+                    pre["ests"] = snap.host_set_estimates()
             else:
                 regs = snap.hll_regs
                 if snap.hll_host_plane is not None:

@@ -1195,6 +1195,9 @@ class Server:
             self.events, self.checks = [], []
             status = self.table.take_status()
         res = self.flusher.flush(snap)
+        # the interval's reads are done (forward rows hold copies);
+        # recycle the host set plane into the table's reuse pool
+        snap.release()
         self.last_flush = time.monotonic()
         self.bump("flushes")
 

@@ -244,7 +244,15 @@ class Snapshot:
     # (imports, oversized-plane scatters) touched the DEVICE registers
     hll_host_plane: np.ndarray | None = None
     hll_device_touched: bool = False
+    # per-row LogLog-Beta sufficient statistics maintained by the
+    # native fold (ez = zero-register count, inv_sum = sum 2^-reg);
+    # None when the pure-Python fold ran (estimate_np covers it)
+    hll_host_ez: np.ndarray | None = None
+    hll_host_inv: np.ndarray | None = None
     overflow: dict[str, int] = field(default_factory=dict)
+    # set by swap(): hands the host set plane back to the table's
+    # reuse pool (see Snapshot.release)
+    recycle: Any = None
 
     @property
     def host_only_sets(self) -> bool:
@@ -253,6 +261,28 @@ class Snapshot:
         on to skip the device for set reads."""
         return (self.hll_host_plane is not None and
                 not self.hll_device_touched)
+
+    def host_set_estimates(self) -> np.ndarray:
+        """Cardinality estimates f32[set_rows] for a host-only-sets
+        interval — O(rows) from the fold-maintained statistics when
+        available, full-plane rescan otherwise."""
+        from veneur_tpu.ops import hll as _hll
+        if self.hll_host_ez is not None:
+            return _hll.estimate_from_stats(self.hll_host_ez,
+                                            self.hll_host_inv)
+        return _hll.estimate_np(self.hll_host_plane)
+
+    def release(self) -> None:
+        """Return the host set plane to the owning table's pool once
+        all reads are done.  Faulting in a fresh 16 MiB np.zeros
+        inside the fold costs ~2x the fold itself; clearing a warm
+        recycled plane is ~10x cheaper.  The plane (and its stats)
+        are invalid after this call."""
+        if self.recycle is not None and self.hll_host_plane is not None:
+            plane, self.hll_host_plane = self.hll_host_plane, None
+            self.hll_host_ez = None
+            self.hll_host_inv = None
+            self.recycle(plane)
 
     def set_registers(self) -> np.ndarray:
         """Effective HLL registers for the interval as a host array:
@@ -326,9 +356,17 @@ class MetricTable:
         self._set_import_regs: list[np.ndarray] = []
 
         # host register plane for raw set traffic (lazy; see
-        # TableConfig.host_set_plane_max_bytes) + device-touch flag
+        # TableConfig.host_set_plane_max_bytes) + device-touch flag,
+        # plus fold-maintained per-row estimate statistics (native
+        # path only; see hll.estimate_from_stats)
         self._hll_host_plane: np.ndarray | None = None
+        self._hll_host_ez: np.ndarray | None = None
+        self._hll_host_inv: np.ndarray | None = None
         self._hll_device_touched = False
+        # cleared planes handed back by consumed snapshots
+        # (Snapshot.release); list ops are GIL-atomic, so the flusher
+        # thread appends while the ingest thread pops
+        self._plane_pool: list[np.ndarray] = []
 
         self.status: dict[tuple, tuple[float, str, tuple[str, ...]]] = {}
         # gRPC import fast path: native import-identity hash -> row
@@ -1212,24 +1250,48 @@ class MetricTable:
         (see TableConfig.host_set_plane_max_bytes)."""
         c = self.config
         if self._hll_host_plane is None:
-            self._hll_host_plane = np.zeros((c.set_rows, hll.M),
-                                            np.uint8)
+            if self._plane_pool:
+                self._hll_host_plane = self._plane_pool.pop()
+            else:
+                self._hll_host_plane = np.zeros((c.set_rows, hll.M),
+                                                np.uint8)
+            if self._lib is not None:
+                # all-zero row: every register counts in ez and
+                # contributes 2^0 to the inverse-power sum
+                self._hll_host_ez = np.full(c.set_rows, hll.M,
+                                            np.int32)
+                self._hll_host_inv = np.full(c.set_rows, float(hll.M),
+                                             np.float64)
         rows = np.ascontiguousarray(rows, np.int32)
         pos = np.ascontiguousarray(pos, np.int32)
         if self._lib is not None:
             import ctypes as ct
             i32p = ct.POINTER(ct.c_int32)
-            self._lib.vtpu_hll_plane(
+            self._lib.vtpu_hll_plane_stats(
                 rows.ctypes.data_as(i32p), pos.ctypes.data_as(i32p),
                 len(rows), c.set_rows, hll.M,
                 self._hll_host_plane.ctypes.data_as(
-                    ct.POINTER(ct.c_uint8)))
+                    ct.POINTER(ct.c_uint8)),
+                self._hll_host_inv.ctypes.data_as(
+                    ct.POINTER(ct.c_double)),
+                self._hll_host_ez.ctypes.data_as(i32p))
             return
         idx = pos >> 6
         rank = (pos & 0x3F).astype(np.uint8)
         live = (rows >= 0) & (rows < c.set_rows)
         np.maximum.at(self._hll_host_plane,
                       (rows[live], idx[live]), rank[live])
+
+    def _recycle_plane(self, plane: np.ndarray) -> None:
+        """Accept a consumed snapshot's plane back into the pool,
+        cleared.  Runs on the releasing (flusher) thread, keeping the
+        memset off the ingest path.  Bounded: FLUSH_LAG snapshots can
+        be in flight, more than that is a leak, not a pool."""
+        c = self.config
+        if (len(self._plane_pool) < 4 and
+                plane.shape == (c.set_rows, hll.M)):
+            plane.fill(0)
+            self._plane_pool.append(plane)
 
     def _hll_plane_step(self, rows: np.ndarray, pos: np.ndarray
                         ) -> bool:
@@ -1384,6 +1446,9 @@ class MetricTable:
             set_touched=self.set_idx.touched.copy(),
             hll_host_plane=self._hll_host_plane,
             hll_device_touched=self._hll_device_touched,
+            hll_host_ez=self._hll_host_ez,
+            hll_host_inv=self._hll_host_inv,
+            recycle=self._recycle_plane,
             overflow={
                 "counter": self.counter_idx.overflow,
                 "gauge": self.gauge_idx.overflow,
@@ -1393,6 +1458,8 @@ class MetricTable:
         )
         # the host set plane belongs to the snapshot now
         self._hll_host_plane = None
+        self._hll_host_ez = None
+        self._hll_host_inv = None
         self._hll_device_touched = False
         # the old planes belong to the snapshot now; fresh ones are
         # allocated lazily on first touch (see _ensure_fresh) — a
@@ -1405,9 +1472,24 @@ class MetricTable:
         for idx in (self.counter_idx, self.gauge_idx, self.histo_idx,
                     self.set_idx):
             idx.overflow = 0
-            if idx.occupancy() > idx.capacity * self.config.compact_threshold:
-                idx.compact(keep_gen=self.gen - 1)
-                compacted = True
+            occ = idx.occupancy()
+            if occ > idx.capacity * self.config.compact_threshold:
+                # compaction only pays when it frees meaningful
+                # headroom; a near-full index whose rows are all live
+                # (steady workload at high occupancy) would otherwise
+                # compact EVERY interval, rebuilding the fast-path key
+                # index each time for zero freed rows
+                freed = occ - int(
+                    (idx.last_gen[:occ] >= self.gen - 1).sum())
+                # a FULL index must reclaim whatever it can (new keys
+                # are dropping as overflow); below full, skipping a
+                # low-yield compaction costs nothing until capacity
+                if (freed >= max(1, idx.capacity // 8) or
+                        (occ >= idx.capacity and freed > 0)):
+                    idx.compact(keep_gen=self.gen - 1)
+                    compacted = True
+                else:
+                    idx.reset_interval()
             else:
                 idx.reset_interval()
         if compacted:

@@ -107,6 +107,20 @@ def estimate_np(plane) -> "np.ndarray":
     step = max(1, (8 << 20) // (M * 8))
     for i in range(0, plane.shape[0], step):
         inv_sum[i:i + step] = lut[plane[i:i + step]].sum(axis=-1)
+    return estimate_from_stats(ez, inv_sum)
+
+
+def estimate_from_stats(ez, inv_sum) -> "np.ndarray":
+    """LogLog-Beta estimate from per-row sufficient statistics
+    (ez = zero-register count, inv_sum = sum_j 2^-reg_j) — either a
+    fresh plane rescan (estimate_np) or the running values maintained
+    by the native fold (vtpu_hll_plane_stats).  The fold-maintained
+    path is O(rows) at flush, which is what lets a set-heavy
+    interval's estimate cost vanish from the single-core host
+    budget."""
+    import numpy as np
+    ez = np.asarray(ez, np.float64)
+    inv_sum = np.asarray(inv_sum, np.float64)
     zl = np.log(ez + 1.0)
     beta = _BETA14[0] * ez
     zp = zl.copy()
