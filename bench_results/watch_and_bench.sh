@@ -1,16 +1,16 @@
 #!/bin/bash
-# Device-link watcher, post-capture era.  Round 4 landed the full
-# scatter-baseline capture + 5 device A/Bs (ab_table.md, commit
-# 339f4a3) and the fused Pallas merge kernel was adopted as the
-# auto default.  From here every healthy window re-runs the full
-# bench at PRODUCTION DEFAULTS into watch_bench_auto.json (keep-best
-# across windows — the tunnel link's health varies run to run; the
-# artifact records how many windows competed and every interval), and
-# keeps a scatter-vs-fused A/B fresh.  The frozen first capture in
-# watch_bench_stdout.json is never overwritten.
+# Device-link watcher, round 5.  Each healthy window: full bench at
+# production defaults -> per-config keep-best in watch_bench_r5.json
+# (round-5 code only; round-4's watch_bench_auto.json is frozen),
+# a raw per-window history line in watch_windows_r5.jsonl (feeds the
+# median-of-windows column next to keep-best), and a Mosaic-compiled
+# fused-merge parity check (bench.py --pallas-parity) whose verdict
+# is appended to watch_parity_log.jsonl.  The frozen round-4
+# captures (watch_bench_stdout.json, watch_bench_auto.json) are
+# never overwritten.
 cd /root/repo
 LOG=bench_results/watch.log
-echo "$(date -u +%FT%TZ) watcher start (round 4, post-capture)" >> "$LOG"
+echo "$(date -u +%FT%TZ) watcher start (round 5)" >> "$LOG"
 
 keep_best() {  # $1 candidate stdout, $2 best-so-far artifact
   python - "$1" "$2" <<'EOF'
@@ -104,7 +104,48 @@ print(err or 'HEALTHY ' + json.dumps(info))" 2>&1 | tail -1)
         > /tmp/watch_bench_candidate.json 2>> "$LOG"
     echo "$(date -u +%FT%TZ) bench done rc=$?" >> "$LOG"
     keep_best /tmp/watch_bench_candidate.json \
-        bench_results/watch_bench_auto.json >> "$LOG" 2>&1
+        bench_results/watch_bench_r5.json >> "$LOG" 2>&1
+    # raw per-window rates: the median-of-windows statistic published
+    # next to keep-best needs every window, not just the winner
+    python - <<'PYEOF' >> bench_results/watch_windows_r5.jsonl 2>> "$LOG"
+import json, time
+try:
+    with open("/tmp/watch_bench_candidate.json") as f:
+        lines = [l for l in f.read().splitlines() if l.startswith("{")]
+    d = json.loads(lines[-1])
+    cfgs = d.get("configs") or {}
+    row = {"ts": round(time.time(), 1),
+           "platform": d.get("platform")}
+    for k, v in cfgs.items():
+        if isinstance(v, dict):
+            r = v.get("samples_per_sec") or v.get("items_per_sec")
+            if r:
+                row[k] = r
+    print(json.dumps(row))
+except Exception as e:
+    print(json.dumps({"ts": round(time.time(), 1), "error": str(e)}))
+PYEOF
+    # Mosaic-lowering parity on the live chip, once per healthy
+    # window (random seed each run): bench_results/pallas_parity.json
+    # holds the full latest verdict, the log keeps one line per window
+    timeout 420 python bench.py --pallas-parity \
+        > /tmp/watch_parity.json 2>> "$LOG"
+    python - <<'PYEOF' >> bench_results/watch_parity_log.jsonl 2>> "$LOG"
+import json, time
+try:
+    with open("/tmp/watch_parity.json") as f:
+        lines = [l for l in f.read().splitlines() if l.startswith("{")]
+    d = json.loads(lines[-1])
+    print(json.dumps({
+        "ts": round(time.time(), 1), "ok": d.get("ok"),
+        "seed": d.get("seed"), "platform": d.get("platform"),
+        "skipped": d.get("skipped", False),
+        "checks": [{k: c.get(k) for k in ("slots", "ok")}
+                   for c in d.get("checks", [])]}))
+except Exception as e:
+    print(json.dumps({"ts": round(time.time(), 1), "error": str(e)}))
+PYEOF
+    echo "$(date -u +%FT%TZ) parity done" >> "$LOG"
     # scatter-vs-fused A/B on the timer config (baseline is now the
     # fused kernel; scatter is the variant).  Validity-gated, not
     # existence-gated: a window that dies mid-A/B leaves an error
@@ -134,7 +175,9 @@ print(err or 'HEALTHY ' + json.dumps(info))" 2>&1 | tail -1)
       echo "$(date -u +%FT%TZ) tailoff-auto A/B done rc=$?" >> "$LOG"
     fi
     python bench_results/summarize_ab.py >> "$LOG" 2>&1
-    sleep 120
+    # longer idle between healthy-window cycles: the builder shares
+    # the one host core; a ~45min cadence still accumulates windows
+    sleep 600
   ;; esac
   sleep 90
 done
