@@ -517,6 +517,51 @@ def bench_global_merge() -> dict:
     res_d["mean_items_per_sec"] = res_d.pop("mean_samples_per_sec")
     res_d["locals"] = n_locals
     res_d["quantile_rows_read"] = int(np.isfinite(q).all(axis=1).sum())
+
+    # Phase breakdown (serialized, so each phase's device work is
+    # fenced before the next starts — the pipelined loop above stays
+    # the headline; this attributes its interval): decode+apply is
+    # host, swap is merge DISPATCH, the block after it is merge
+    # EXECUTION, and the flush closure is readout dispatch + d2h.
+    phases: dict = {}
+    for _ in range(3):
+        _block(dst)
+        t0 = time.perf_counter()
+        for wire in wire_lists:
+            apply_metric_list_bytes(dst, wire)
+            dst.device_step()
+        t1 = time.perf_counter()
+        snap = dst.swap()
+        t2 = time.perf_counter()
+        _block(dst)
+        jax.block_until_ready(snap.histo_import_stats)
+        t3 = time.perf_counter()
+        closure = flush_launch(snap)
+        t4 = time.perf_counter()
+        closure()
+        t5 = time.perf_counter()
+        for key, v in (("apply_decode_host", t1 - t0),
+                       ("swap_merge_dispatch", t2 - t1),
+                       ("merge_execute", t3 - t2),
+                       ("readout_dispatch", t4 - t3),
+                       ("readout_d2h_wait", t5 - t4),
+                       ("serial_total", t5 - t0)):
+            phases[key] = round(min(phases.get(key, 1e9), v), 4)
+    # one-wire sub-splits of the apply phase
+    from veneur_tpu import native as _native
+    from veneur_tpu.forward import grpc_forward as _gf
+    lib = _native.load()
+    if lib is not None:
+        t0 = time.perf_counter()
+        for _ in range(8):
+            _gf._decode_native(lib, wire_lists[0])
+        phases["decode_only_per_wire"] = round(
+            (time.perf_counter() - t0) / 8, 5)
+    t0 = time.perf_counter()
+    for _ in range(8):
+        apply_metric_list_bytes(dst, wire_lists[0])
+    phases["apply_per_wire"] = round((time.perf_counter() - t0) / 8, 5)
+    res_d["phases"] = phases
     return res_d
 
 

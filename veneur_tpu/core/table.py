@@ -352,8 +352,14 @@ class MetricTable:
         # (rows i32[N], stats f32[N,5]) parts — single imports append
         # 1-row parts, the batched gRPC decode appends whole batches
         self._stats_import_parts: list[tuple[np.ndarray, np.ndarray]] = []
-        self._set_import_rows: list[int] = []
-        self._set_import_regs: list[np.ndarray] = []
+        # forwarded set sketches fold incrementally into a host plane
+        # (register max is associative): K received planes for the
+        # same row cost K 16 KiB vector maxes at import time and ONE
+        # gathered ship at the swap — the list-accumulate-then-dedup
+        # design paid an O(K * 16 KiB) stack + argsort + reduceat at
+        # the swap (~0.75s at 4096 planes/interval on one core)
+        self._set_import_plane: np.ndarray | None = None
+        self._set_import_touched: np.ndarray | None = None
 
         # host register plane for raw set traffic (lazy; see
         # TableConfig.host_set_plane_max_bytes) + device-touch flag,
@@ -755,12 +761,20 @@ class MetricTable:
         self._staged_n += len(rows)
 
     def import_set_at(self, row: int, regs: np.ndarray) -> None:
-        """import_set's staging half for a pre-resolved row."""
+        """import_set's staging half for a pre-resolved row: one
+        16 KiB register max into the host import plane (Set.Merge,
+        samplers/samplers.go:423)."""
         regs = np.asarray(regs, np.uint8)
         if regs.shape != (hll.M,):
             raise ValueError(f"bad register plane shape {regs.shape}")
-        self._set_import_rows.append(int(row))
-        self._set_import_regs.append(regs)
+        if self._set_import_plane is None:
+            c = self.config
+            self._set_import_plane = np.zeros((c.set_rows, hll.M),
+                                              np.uint8)
+            self._set_import_touched = np.zeros(c.set_rows, bool)
+        prow = self._set_import_plane[row]
+        np.maximum(prow, regs, out=prow)
+        self._set_import_touched[row] = True
         self.set_idx.touched[row] = True
         self.set_idx.last_gen[row] = self.gen
         self._staged_n += 1
@@ -881,9 +895,7 @@ class MetricTable:
                                   self.gen)
         if row is None:
             return False
-        self._set_import_rows.append(row)
-        self._set_import_regs.append(regs)
-        self._staged_n += 1
+        self.import_set_at(row, regs)
         return True
 
     # ------------------------------------------------------------------
@@ -966,7 +978,16 @@ class MetricTable:
                     jnp.asarray(_pad_np(srows, b, c.set_rows)),
                     jnp.asarray(_pad_np(spos, b, 0)))
 
-        if self._stats_import_parts:
+        # Import-side staging flushes at the swap like the digest
+        # stage: a global node receiving K wire lists per interval
+        # otherwise pays K small dispatches (and, for sets, ships
+        # every list's register planes separately — the cross-list
+        # dedup below collapsed 64 MB/interval to ~2 MB once
+        # deferred).  Size gates bound host staging between swaps.
+        if self._stats_import_parts and (
+                final or
+                sum(len(p[0]) for p in self._stats_import_parts)
+                >= (1 << 16)):
             rows = np.concatenate(
                 [p[0] for p in self._stats_import_parts])
             vals = np.concatenate(
@@ -983,24 +1004,18 @@ class MetricTable:
                 jnp.asarray(_pad_np(rows, b, c.histo_rows)),
                 jnp.asarray(padded))
 
-        if self._set_import_rows:
-            rows = np.asarray(self._set_import_rows, np.int32)
-            regs = np.stack(self._set_import_regs)
-            self._set_import_rows, self._set_import_regs = [], []
+        if (final and self._set_import_touched is not None and
+                self._set_import_touched.any()):
+            # imports fold into the host plane at receive time, so
+            # the swap ships just the touched rows, pre-deduped (a
+            # fleet of locals forwards the SAME series: K received
+            # planes for U series ship as U rows, not K)
+            rows = np.nonzero(self._set_import_touched)[0].astype(
+                np.int32)
+            regs = self._set_import_plane[rows]
+            self._set_import_plane[rows] = 0
+            self._set_import_touched[:] = False
             self._hll_device_touched = True
-            # a fleet of locals forwards the SAME series: fold
-            # duplicate target rows by register-max on host first, so
-            # K received planes ship as U unique rows (64 locals x
-            # 16 KiB/plane was ~64x the necessary transfer)
-            if len(rows) > 1:
-                order = np.argsort(rows, kind="stable")
-                r_s = rows[order]
-                starts = np.nonzero(np.concatenate(
-                    [[True], r_s[1:] != r_s[:-1]]))[0]
-                if len(starts) < len(rows):
-                    regs = np.maximum.reduceat(regs[order], starts,
-                                               axis=0)
-                    rows = r_s[starts]
             # wide rows (16 KiB each): small bucket floor, padding a
             # 256-row plane for one import would cost 4 MiB of
             # host->device bandwidth per flush
@@ -1043,36 +1058,32 @@ class MetricTable:
                 rows, vals, wts = spill
                 with_stats = False
         rank, max_count = self._rank(rows)
-        # Host pre-cluster (same k-scale math as the device merge)
-        # when a row's batch exceeds what the digest keeps anyway:
-        # raw-sample floods past histo_slots*4 (a 400k-sample series
-        # would otherwise issue ~800 chunked device merges — enough
-        # queue depth to wedge a tunneled device link), and
-        # stats-free centroid batches (global-tier imports, plane
-        # spills) past the digest capacity — a fleet's forwarded
-        # digests collapse to <= capacity clusters per row on host,
-        # cutting the shipped batch ~5x and the merge to one call.
-        precluster_at = (self._eff_histo_slots * 4 if with_stats
-                         else max(self.capacity,
-                                  self._eff_histo_slots))
-        if max_count > precluster_at:
-            if with_stats:
-                self._host_stats_fold(rows, vals, wts)
-                with_stats = False
-            rows, vals, wts = self._host_precluster(rows, vals, wts)
-            unit = False
-            rank, max_count = self._rank(rows)
         eff = self._eff_histo_slots
         if max_count <= eff:
             self._digest_merge(rows, vals, wts, rank, unit, with_stats)
             return
-        chunk_of = rank // eff
-        n_chunks = int(chunk_of.max()) + 1 if len(rows) else 0
-        for ci in range(n_chunks):
-            sel = np.nonzero(chunk_of == ci)[0]
-            self._digest_merge(rows[sel], vals[sel], wts[sel],
-                               rank[sel] - ci * eff, unit,
-                               with_stats)
+        # Deep batch (a row carries more samples than one merge
+        # width): fold the local aggregates on host once (exact), then
+        # merge digest-only through the single-dispatch device scan —
+        # a 1.6M-centroid global-tier import interval previously paid
+        # ~0.7s of single-core k-scale precluster (or, before that,
+        # one dispatch per chunk: ~100ms each over a tunneled link).
+        # The host precluster survives only as the ultra-deep escape
+        # (> 64 chunk widths in one row), where bounding the scan's
+        # compile variants and h2d bytes is worth its lossier
+        # collapse-then-merge accuracy.
+        if with_stats:
+            self._host_stats_fold(rows, vals, wts)
+            with_stats = False
+        n_chunks = -(-max_count // eff)
+        if n_chunks > 64:
+            rows, vals, wts = self._host_precluster(rows, vals, wts)
+            rank, max_count = self._rank(rows)
+            if max_count <= eff:
+                self._digest_merge(rows, vals, wts, rank, False, False)
+                return
+            n_chunks = -(-max_count // eff)
+        self._digest_merge_scan(rows, vals, wts, rank, n_chunks)
 
     def _host_stats_fold(self, rows, vals, wts) -> None:
         """Fold a batch's per-row local aggregates into the device
@@ -1413,6 +1424,82 @@ class MetricTable:
                 *args, rows_dev, rank_dev, vals_dev,
                 jnp.asarray(_pad_np(wts, b, 0.0)),
                 slots=slots, compression=c.compression)
+
+    def _digest_merge_scan(self, rows, vals, wts, rank,
+                           n_chunks: int) -> None:
+        """Digest-only merge of a deep batch (per-row counts beyond
+        one merge width) in ONE device dispatch: lax.scan merges an
+        eff-slots-wide chunk per step.  The chunk count is bucketed
+        to a power of two so the static scan length doesn't mint a
+        compile variant per interval shape; chunks past the real
+        depth merge empty plane slices.
+
+        The batch ships HOST-DENSIFIED whenever the touched rows are
+        uniform enough that the plane is not much bigger than the
+        flat triplets: the scan then never scatters on device (an XLA
+        scatter of the full flat batch re-executed per chunk measured
+        ~2.5s/interval for the 64-local import config, vs ~ms for
+        slice+merge).  Skewed deep batches (plane would blow past 2x
+        the flat bytes) keep the flat scatter-scan."""
+        c = self.config
+        self._ensure_fresh("histo")
+        eff = self._eff_histo_slots
+        nc = 1 << max(0, (n_chunks - 1).bit_length())
+        uniq = np.unique(rows)
+        mb = _bucket_len(len(uniq))
+        sub = mb * 2 <= c.histo_rows
+        n_plane_rows = mb if sub else c.histo_rows
+        if sub:
+            local = np.searchsorted(uniq, rows).astype(np.int32)
+        else:
+            local = np.ascontiguousarray(rows, np.int32)
+        width = nc * eff
+        b = _bucket_len(len(rows))
+        if n_plane_rows * width * 8 <= 32 * b:
+            plane_v = np.zeros((n_plane_rows, width), np.float32)
+            plane_w = np.zeros((n_plane_rows, width), np.float32)
+            plane_v[local, rank] = vals
+            plane_w[local, rank] = wts
+            if sub:
+                idx_dev = jnp.asarray(_pad_np(
+                    uniq.astype(np.int32), mb, c.histo_rows))
+                self.histo_means, self.histo_weights = \
+                    tdigest.merge_dense_scan_rows(
+                        self.histo_means, self.histo_weights,
+                        idx_dev, jnp.asarray(plane_v),
+                        jnp.asarray(plane_w), slots=eff,
+                        n_chunks=nc, compression=c.compression)
+            else:
+                self.histo_means, self.histo_weights = \
+                    tdigest.merge_dense_scan(
+                        self.histo_means, self.histo_weights,
+                        jnp.asarray(plane_v), jnp.asarray(plane_w),
+                        slots=eff, n_chunks=nc,
+                        compression=c.compression)
+            return
+        # padding rank nc*eff is past every chunk's live window, so
+        # padded entries drop without needing a row-id sentinel
+        vals_dev = jnp.asarray(_pad_np(vals, b, 0.0))
+        rank_dev = jnp.asarray(_pad_np(rank, b, nc * eff))
+        wts_dev = jnp.asarray(_pad_np(wts, b, 0.0))
+        if sub:
+            rows_dev = jnp.asarray(_pad_np(local, b, mb))
+            idx_dev = jnp.asarray(_pad_np(
+                uniq.astype(np.int32), mb, c.histo_rows))
+            self.histo_means, self.histo_weights = \
+                tdigest.add_samples_ranked_scan_rows(
+                    self.histo_means, self.histo_weights, idx_dev,
+                    rows_dev, rank_dev, vals_dev, wts_dev,
+                    slots=eff, n_chunks=nc,
+                    compression=c.compression)
+        else:
+            rows_dev = jnp.asarray(_pad_np(rows, b, c.histo_rows))
+            self.histo_means, self.histo_weights = \
+                tdigest.add_samples_ranked_scan(
+                    self.histo_means, self.histo_weights, rows_dev,
+                    rank_dev, vals_dev, wts_dev,
+                    slots=eff, n_chunks=nc,
+                    compression=c.compression)
 
     # ------------------------------------------------------------------
     # flush boundary

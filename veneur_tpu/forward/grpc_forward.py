@@ -480,9 +480,16 @@ def apply_metric_list_bytes(table: MetricTable,
             sel_ok = selh[ok_h]
             cnts = cc[sel_ok]
             rep_rows = np.repeat(rows[sel_ok], cnts).astype(np.int32)
-            take = np.concatenate(
-                [np.arange(s, s + c) for s, c in
-                 zip(cs[sel_ok], cnts)]) if cnts.sum() else                 np.empty(0, np.int64)
+            total_c = int(cnts.sum())
+            if total_c:
+                # ragged gather indices without a per-metric arange:
+                # position-within-group + repeated segment starts
+                within = (np.arange(total_c, dtype=np.int64) -
+                          np.repeat(np.cumsum(cnts) - cnts, cnts))
+                take = np.repeat(cs[sel_ok].astype(np.int64),
+                                 cnts) + within
+            else:
+                take = np.empty(0, np.int64)
             cm = means[take]
             cw = weights[take]
             live = (cw > 0) & np.isfinite(cm) & np.isfinite(cw)

@@ -70,8 +70,8 @@ def decode(data: bytes) -> np.ndarray:
     p, b, sparse = data[1], data[2], data[3]
     if p != P:
         raise HLLCodecError(f"precision {p} != {P}")
-    regs = np.zeros(M, np.uint8)
     if sparse == 1:
+        regs = np.zeros(M, np.uint8)
         if len(data) < 8:
             raise HLLCodecError("sparse sketch truncated")
         tn = int.from_bytes(data[4:8], "big")
