@@ -119,3 +119,58 @@ def test_gauge_last_write_wins_within_wire_via_cache():
         res = Flusher(is_local=False).flush(t.swap())
         vals = {m.name: m.value for m in res.metrics}
         assert vals.get("g.dup") == pytest.approx(9.0)
+
+
+def test_cached_overflow_drops_keep_counting():
+    """An identity cached as overflow (-1) must bump the class
+    overflow counter on EVERY wire that carries it — the uncached
+    slow path counted every sample, and the operator counter
+    (veneur.worker.metrics_dropped equivalent) must not undercount
+    just because the drop got cached (round-4 advisor finding)."""
+    wire = _wire([(f"ov.{i}", 1.0) for i in range(4)])
+    t = MetricTable(TableConfig(counter_rows=2))
+    acc, drop = apply_metric_list_bytes(t, wire)
+    assert (acc, drop) == (2, 2)
+    first = t.counter_idx.overflow
+    assert first == 2  # slow path counted at fill
+    acc, drop = apply_metric_list_bytes(t, wire)
+    assert (acc, drop) == (2, 2)
+    assert t.counter_idx.overflow == first + 2  # hits keep counting
+
+
+def test_malformed_drops_do_not_count_as_overflow():
+    """Cache sentinel -2 (malformed identity / empty oneof) is a drop
+    but NOT overflow; repeated wires must not inflate the overflow
+    counter for it."""
+    from veneur_tpu.forward.gen import forward_pb2, metric_pb2
+    ml = forward_pb2.MetricList()
+    m = ml.metrics.add()
+    m.name = "no.value.oneof"
+    m.type = metric_pb2.Counter
+    wire = ml.SerializeToString()
+    t = MetricTable(TableConfig())
+    for _ in range(3):
+        acc, drop = apply_metric_list_bytes(t, wire)
+        assert (acc, drop) == (0, 1)
+    assert t.counter_idx.overflow == 0
+
+
+def test_name_length_mismatch_reresolves():
+    """Collision guard: a cache entry whose stored name length
+    disagrees with the wire (a 64-bit identity-hash collision between
+    distinct series) must re-resolve through the slow path, not
+    silently merge the two series into one row."""
+    wire = _wire([("cg.abc", 3.0)])
+    t = MetricTable(TableConfig())
+    apply_metric_list_bytes(t, wire)
+    (h, ent), = t.import_row_cache.items()
+    row = ent & 0xFFFFFFFF
+    # poison: same hash, absurd name length — as a colliding series
+    # would have left it
+    t.import_row_cache[h] = (999 << 32) | row
+    acc, drop = apply_metric_list_bytes(t, wire)
+    assert (acc, drop) == (1, 0)
+    # the slow path repaired the entry and kept the same row
+    assert t.import_row_cache[h] == ent
+    snap = t.swap()
+    assert float(np.asarray(snap.counters)[row]) == 6.0

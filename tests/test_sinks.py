@@ -864,6 +864,27 @@ def test_datadog_magic_host_device_tags(http_capture):
     assert series[0]["tags"] == ["a:1"]
 
 
+def test_datadog_magic_tags_beat_prefix_exclusion(http_capture):
+    """Magic-tag extraction runs BEFORE per-metric-prefix tag
+    stripping (the reference's single-pass order, datadog.go:300-329):
+    an exclude rule covering "host:" must not suppress the hostname
+    override."""
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
+    s = DatadogMetricSink(
+        "key", _url(http_capture), 10.0, hostname="h1",
+        exclude_tags_prefix_by_prefix_metric=[
+            {"metric_prefix": "dd.", "tags": ["host", "a:"]}])
+    s.flush([_metric("dd.g", 5.0, GAUGE,
+                     tags=("a:1", "hostile:keep", "host:other"))])
+    series = json.loads(zlib.decompress(
+        http_capture.requests[0][3]))["series"]
+    # the override still landed, and the exclusion still stripped
+    # non-magic tags matching the prefixes ("hostile:" matches
+    # prefix "host" exactly as the reference's HasPrefix would)
+    assert series[0]["host"] == "other"
+    assert series[0]["tags"] == []
+
+
 def test_datadog_status_metric_becomes_service_check(http_capture):
     """STATUS InterMetrics route to /api/v1/check_run as service
     checks, never as gauge series (reference finalizeMetrics,

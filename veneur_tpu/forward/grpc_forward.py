@@ -364,18 +364,43 @@ def apply_metric_list_bytes(table: MetricTable,
     if len(cache) >= getattr(table, "import_row_cache_limit",
                              1 << 20):
         cache.clear()  # churning identities: rebound, self-rebuilds
+    class_idx = {1: table.counter_idx, 2: table.gauge_idx,
+                 3: table.histo_idx, 4: table.set_idx}
+    name_len = cols["name_len"]
     for i, h in enumerate(khl):
         ent = cache.get(h)
+        had_pos = ent is not None and ent >= 0
         if ent is not None:
-            rows[i] = ent
-            continue
+            if had_pos:
+                # cheap collision guard on the 64-bit identity hash:
+                # the cached entry carries the resolved name length;
+                # a hit whose wire name length disagrees is a hash
+                # collision between distinct series — fall through to
+                # the slow path instead of silently merging them
+                if (ent >> 32) == int(name_len[i]):
+                    rows[i] = ent & 0xFFFFFFFF
+                    continue
+            else:
+                rows[i] = ent
+                if ent == -1:
+                    # the slow path bumped overflow when it cached the
+                    # drop; hits must keep counting per dropped sample
+                    # or the operator counter undercounts vs the
+                    # uncached path (every overflowing import counts)
+                    idx = class_idx.get(int(kind[i]))
+                    if idx is not None:
+                        idx.overflow += 1
+                continue
         k = int(kind[i])
         row = None
+        resolved = False
         try:
             name, tags = _ident(i)
             if k == 1:
+                resolved = True
                 row = table.import_counter_row(name, tags)
             elif k == 2:
+                resolved = True
                 row = table.import_gauge_row(name, tags)
             elif k == 3:
                 mtype = _PB_TO_TYPE.get(int(cols["mtype"][i]))
@@ -383,10 +408,12 @@ def apply_metric_list_bytes(table: MetricTable,
                     mtype = dsd.HISTOGRAM
                 scope = _PB_TO_SCOPE.get(int(cols["scope"][i]),
                                          dsd.SCOPE_DEFAULT)
+                resolved = True
                 row = table.import_histo_row(name, mtype, tags, scope)
             elif k == 4:
                 scope = _PB_TO_SCOPE.get(int(cols["scope"][i]),
                                          dsd.SCOPE_DEFAULT)
+                resolved = True
                 row = table.import_set_row(name, tags, scope)
             else:
                 log.warning("import metric %s with empty value oneof",
@@ -395,9 +422,21 @@ def apply_metric_list_bytes(table: MetricTable,
             log.warning("dropping bad gRPC import item: %s", e)
         # row None covers malformed identity, empty oneof AND class
         # overflow — all stable until the next compaction, which
-        # clears the cache (overflow can only recover via compaction)
-        cache[h] = -1 if row is None else int(row)
-        rows[i] = cache[h]
+        # clears the cache (overflow can only recover via compaction).
+        # Overflow drops (-1, lookup ran and failed) keep counting
+        # per sample on cache hits; malformed drops (-2) never
+        # counted as overflow and must not start to.
+        if row is None:
+            rows[i] = -1 if resolved else -2
+            # a collision-guard fallthrough that then overflows must
+            # NOT evict the colliding series' live entry: the drop is
+            # per-sample (lookup counted it), the cache entry stays
+            # the surviving series'
+            if not had_pos:
+                cache[h] = rows[i]
+        else:
+            cache[h] = (int(name_len[i]) << 32) | int(row)
+            rows[i] = int(row)
 
     valid = rows >= 0
     dropped += int((~valid).sum())

@@ -51,27 +51,28 @@ class DatadogMetricSink(SinkBase):
 
     def _finalize_tags(self, m: InterMetric
                        ) -> tuple[list[str], str, str]:
-        """Tag housekeeping shared by series and status entries:
-        per-metric-prefix tag stripping, then the reference's "magic
-        tags" — ``host:``/``device:`` override the DDMetric hostname/
-        device fields and are REMOVED from the tag list
-        (datadog.go:300-329)."""
-        tags = list(m.tags)
-        for metric_prefix, tag_prefixes in self.tag_prefix_rules:
-            if m.name.startswith(metric_prefix):
-                tags = [t for t in tags
-                        if not any(t.startswith(p)
-                                   for p in tag_prefixes)]
+        """Tag housekeeping shared by series and status entries: the
+        reference's "magic tags" — ``host:``/``device:`` override the
+        DDMetric hostname/device fields and are REMOVED from the tag
+        list — run FIRST, matching datadog.go:300-329's single-pass
+        order, so a per-metric-prefix exclude rule covering "host:"
+        never suppresses the hostname override; prefix stripping then
+        applies to the remaining tags."""
         hostname = m.hostname or self.hostname
         device = ""
         kept = []
-        for t in tags:
+        for t in m.tags:
             if t.startswith("host:"):
                 hostname = t[5:]
             elif t.startswith("device:"):
                 device = t[7:]
             else:
                 kept.append(t)
+        for metric_prefix, tag_prefixes in self.tag_prefix_rules:
+            if m.name.startswith(metric_prefix):
+                kept = [t for t in kept
+                        if not any(t.startswith(p)
+                                   for p in tag_prefixes)]
         return kept, hostname, device
 
     def _series(self, m: InterMetric) -> dict:
