@@ -495,3 +495,22 @@ def test_full_chain_emit_to_mesh_sharded_global():
         local.shutdown()
         proxy.shutdown()
         g.shutdown()
+
+
+def test_proxy_identity_and_pprof_surface(chain):
+    """The proxy's HTTP listener serves the same identity + pprof
+    endpoints as the server (reference proxy.go:533-538)."""
+    import urllib.request
+    from veneur_tpu import __version__
+    _, proxy, _, _ = chain
+    base = f"http://127.0.0.1:{proxy.http_port}"
+
+    def get(path):
+        return urllib.request.urlopen(base + path, timeout=5).read()
+
+    assert get("/version").decode() == __version__
+    assert get("/builddate") == b"dev"
+    dump = get("/debug/pprof/goroutine").decode()
+    assert "Thread" in dump
+    heap = get("/debug/pprof/heap")
+    assert b"tracemalloc" in heap or b"KiB" in heap

@@ -1,0 +1,101 @@
+"""Shared /debug/pprof + identity HTTP handlers.
+
+The reference wires the same net/http/pprof surface onto BOTH the
+server's and the proxy's HTTP listeners (server: server.go Handler();
+proxy: proxy.go:533-538 alongside /healthcheck and the standard
+identity endpoints), so the Python equivalents live here once:
+
+- ``/debug/pprof`` | ``.../goroutine`` | ``.../threads``: thread
+  stack dump (the goroutine profile's role)
+- ``/debug/pprof/heap``: tracemalloc top allocations
+  (``?start=1``/``?stop=1`` toggle tracing — per-allocation overhead
+  must be opt-in and revocable on a long-running process)
+- ``/debug/pprof/profile[?seconds=N]``: cProfile sample
+
+Handlers are BaseHTTPRequestHandler methods; callers pass the request
+handler plus a per-process lock serializing the profiler (only one
+can be enabled per interpreter).
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+import time
+
+
+def respond_ok(handler, body: bytes = b"ok",
+               ctype: str = "text/plain") -> None:
+    handler.send_response(200)
+    handler.send_header("Content-Type", ctype)
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def pprof(handler, lock: threading.Lock) -> None:
+    """Serve one /debug/pprof/* GET on ``handler``."""
+    path, _, query = handler.path.partition("?")
+    part = path.rsplit("/", 1)[-1]
+    if part in ("pprof", "goroutine", "threads"):
+        import sys
+        import traceback
+        names = {t.ident: t.name for t in threading.enumerate()}
+        buf = io.StringIO()
+        for tid, frame in sys._current_frames().items():
+            buf.write(f"Thread {names.get(tid, tid)}:\n")
+            buf.writelines(traceback.format_stack(frame))
+            buf.write("\n")
+        respond_ok(handler, buf.getvalue().encode())
+    elif part == "heap":
+        import tracemalloc
+        if "start=1" in query:
+            tracemalloc.start()
+            respond_ok(handler, b"tracing started")
+        elif "stop=1" in query:
+            # tracing has per-allocation overhead: always stoppable
+            # so one debug query can't degrade a long-running server
+            # until restart
+            tracemalloc.stop()
+            respond_ok(handler, b"tracing stopped")
+        elif not tracemalloc.is_tracing():
+            respond_ok(handler, b"tracemalloc not tracing; GET "
+                                b"/debug/pprof/heap?start=1 first")
+        else:
+            snap = tracemalloc.take_snapshot()
+            top = snap.statistics("lineno")[:50]
+            respond_ok(handler,
+                       "\n".join(str(s) for s in top).encode())
+    elif part == "profile":
+        import cProfile
+        import pstats
+        seconds = 2.0
+        if "seconds=" in query:
+            try:
+                seconds = float(
+                    query.split("seconds=")[1].split("&")[0])
+            except ValueError:
+                pass
+        # only one profiler can be active per process (concurrent
+        # requests or enable_profiling would raise): serialize, and
+        # 503 on any other active profiling tool
+        if not lock.acquire(blocking=False):
+            handler.send_error(503, "profiling already in progress")
+            return
+        try:
+            prof = cProfile.Profile()
+            try:
+                prof.enable()
+            except ValueError as e:
+                handler.send_error(503, str(e))
+                return
+            time.sleep(min(seconds, 30.0))
+            prof.disable()
+        finally:
+            lock.release()
+        buf = io.StringIO()
+        pstats.Stats(prof, stream=buf).sort_stats(
+            "cumulative").print_stats(60)
+        respond_ok(handler, buf.getvalue().encode())
+    else:
+        handler.send_error(404)

@@ -34,6 +34,7 @@ class ProxyServer:
         self.config = config
         self.stats = defaultdict(int)
         self._stats_lock = threading.Lock()
+        self._pprof_lock = threading.Lock()
         self._shutdown = threading.Event()
         self._pool = ThreadPoolExecutor(max_workers=16)
         self._clients: dict[str, object] = {}
@@ -165,12 +166,20 @@ class ProxyServer:
                 pass
 
             def do_GET(self):
+                # identity + pprof surface, matching the reference
+                # proxy's HTTP mux (proxy.go:533-538 wires
+                # /healthcheck, net/http/pprof and the standard
+                # identity endpoints on the same listener)
+                from veneur_tpu import __version__
+                from veneur_tpu.core import debughttp
                 if self.path == "/healthcheck":
-                    body = b"ok"
-                    self.send_response(200)
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    debughttp.respond_ok(self)
+                elif self.path == "/version":
+                    debughttp.respond_ok(self, __version__.encode())
+                elif self.path == "/builddate":
+                    debughttp.respond_ok(self, b"dev")
+                elif self.path.startswith("/debug/pprof"):
+                    debughttp.pprof(self, proxy._pprof_lock)
                 else:
                     self.send_error(404)
 

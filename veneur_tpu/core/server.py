@@ -1009,76 +1009,8 @@ class Server:
                     self.send_error(404)
 
             def _pprof(self):
-                import io as _io
-                path, _, query = self.path.partition("?")
-                part = path.rsplit("/", 1)[-1]
-                if part in ("pprof", "goroutine", "threads"):
-                    # thread stack dump — the goroutine profile's role
-                    import sys
-                    import traceback
-                    names = {t.ident: t.name
-                             for t in threading.enumerate()}
-                    buf = _io.StringIO()
-                    for tid, frame in sys._current_frames().items():
-                        buf.write(f"Thread {names.get(tid, tid)}:\n")
-                        buf.writelines(traceback.format_stack(frame))
-                        buf.write("\n")
-                    self._ok(buf.getvalue().encode())
-                elif part == "heap":
-                    import tracemalloc
-                    if "start=1" in query:
-                        tracemalloc.start()
-                        self._ok(b"tracing started")
-                    elif "stop=1" in query:
-                        # tracing has per-allocation overhead: always
-                        # stoppable so one debug query can't degrade a
-                        # long-running server until restart
-                        tracemalloc.stop()
-                        self._ok(b"tracing stopped")
-                    elif not tracemalloc.is_tracing():
-                        self._ok(b"tracemalloc not tracing; GET "
-                                 b"/debug/pprof/heap?start=1 first")
-                    else:
-                        snap = tracemalloc.take_snapshot()
-                        top = snap.statistics("lineno")[:50]
-                        self._ok("\n".join(str(s)
-                                           for s in top).encode())
-                elif part == "profile":
-                    import cProfile
-                    import pstats
-                    seconds = 2.0
-                    if "seconds=" in query:
-                        try:
-                            seconds = float(
-                                query.split("seconds=")[1]
-                                .split("&")[0])
-                        except ValueError:
-                            pass
-                    # only one profiler can be active per process
-                    # (concurrent requests or enable_profiling would
-                    # raise): serialize, and 503 on any other active
-                    # profiling tool
-                    if not server._pprof_lock.acquire(blocking=False):
-                        self.send_error(
-                            503, "profiling already in progress")
-                        return
-                    try:
-                        prof = cProfile.Profile()
-                        try:
-                            prof.enable()
-                        except ValueError as e:
-                            self.send_error(503, str(e))
-                            return
-                        time.sleep(min(seconds, 30.0))
-                        prof.disable()
-                    finally:
-                        server._pprof_lock.release()
-                    buf = _io.StringIO()
-                    pstats.Stats(prof, stream=buf).sort_stats(
-                        "cumulative").print_stats(60)
-                    self._ok(buf.getvalue().encode())
-                else:
-                    self.send_error(404)
+                from veneur_tpu.core import debughttp
+                debughttp.pprof(self, server._pprof_lock)
 
             def do_POST(self):
                 if self.path == "/import":
