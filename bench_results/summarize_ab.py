@@ -144,10 +144,54 @@ def main() -> None:
         "measured 4.1x over scatter at that shape); "
         "VENEUR_TPU_MERGE_FALLBACK remains the lever beyond the "
         "kernel's bound._")
+    lines.extend(window_stats_lines())
     out = os.path.join(HERE, "ab_table.md")
     with open(out, "w") as f:
         f.write("\n".join(lines) + "\n")
     print(f"wrote {out}")
+
+
+def window_stats() -> dict:
+    """Per-config {n_windows, median, best, spread} across the
+    round's healthy-window history (watch_windows_r5.jsonl).  The
+    keep-best headline needs this next to it: the tunnel link's
+    service quality swings ±20%+ between windows, and a median over
+    all windows is the honest central tendency."""
+    path = os.path.join(HERE, "watch_windows_r5.jsonl")
+    stats: dict = {}
+    try:
+        with open(path) as f:
+            rows = [json.loads(ln) for ln in f if ln.strip()]
+    except OSError:
+        return stats
+    import statistics
+    for row in rows:
+        if row.get("platform") != "tpu":
+            continue
+        for k, v in row.items():
+            if isinstance(v, (int, float)) and k not in ("ts",):
+                stats.setdefault(k, []).append(float(v))
+    return {
+        k: {"n_windows": len(vs),
+            "median": statistics.median(vs),
+            "best": max(vs),
+            "spread": (max(vs) - min(vs)) / max(vs) if max(vs) else 0}
+        for k, vs in stats.items()}
+
+
+def window_stats_lines() -> list[str]:
+    st = window_stats()
+    if not st:
+        return []
+    lines = ["", "## Round-5 windows: median vs keep-best", "",
+             "| config | n windows | median | best | spread |",
+             "|---|---|---|---|---|"]
+    for k in sorted(st):
+        s = st[k]
+        lines.append(f"| {k} | {s['n_windows']} | "
+                     f"{s['median']:,.0f}/s | {s['best']:,.0f}/s | "
+                     f"{s['spread']:.0%} |")
+    return lines
 
 
 if __name__ == "__main__":

@@ -1,0 +1,150 @@
+"""Faithful Python model of the reference's serial merging t-digest.
+
+This is a BEHAVIORAL REFERENCE for accuracy comparisons only — it is
+not product code and nothing in veneur_tpu imports it.  It re-states
+the algorithm of /root/reference/tdigest/merging_digest.go:
+
+- buffered adds into a temp list sized by the paper's heuristic
+  (estimateTempBuffer, merging_digest.go:107)
+- mergeAllTemps (:140): one ascending-mean pass greedily combining
+  (Welford) while the k-scale index width stays within 1
+  (mergeOne :229, indexEstimate :258: c * (asin(2q-1)/pi + 0.5))
+- Quantile (:301): uniform interpolation between centroid upper
+  bounds (midpoint to the next mean; min/max at the ends)
+
+The in-place swap dance of the Go merge is replaced by a plain
+sorted merge into fresh lists — identical semantics, since the Go
+code's swapping exists only to avoid allocation.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+def estimate_temp_buffer(compression: float) -> int:
+    t = min(925.0, max(20.0, compression))
+    return int(7.5 + 0.37 * t - 2e-4 * t * t)
+
+
+class GoMergingDigest:
+    def __init__(self, compression: float = 100.0):
+        self.compression = float(compression)
+        self.main_mean: list[float] = []
+        self.main_weight: list[float] = []
+        self.main_total = 0.0
+        self.temp_cap = estimate_temp_buffer(compression)
+        self.temp_vals: list[float] = []
+        self.temp_wts: list[float] = []
+        self.temp_total = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self.reciprocal_sum = 0.0
+
+    def add(self, value: float, weight: float = 1.0) -> None:
+        if (math.isnan(value) or math.isinf(value) or weight <= 0):
+            raise ValueError("invalid value added")
+        if len(self.temp_vals) == self.temp_cap:
+            self._merge_all_temps()
+        self.min = min(self.min, value)
+        self.max = max(self.max, value)
+        self.reciprocal_sum += (1.0 / value) * weight
+        self.temp_vals.append(value)
+        self.temp_wts.append(weight)
+        self.temp_total += weight
+
+    def add_many(self, values) -> None:
+        """Unit-weight bulk add with the exact serial merge cadence
+        (a merge fires each time the temp buffer fills)."""
+        values = np.asarray(values, np.float64)
+        if np.isnan(values).any() or np.isinf(values).any():
+            raise ValueError("invalid value added")
+        i = 0
+        n = len(values)
+        while i < n:
+            room = self.temp_cap - len(self.temp_vals)
+            if room == 0:
+                self._merge_all_temps()
+                room = self.temp_cap
+            take = values[i:i + room]
+            self.min = min(self.min, float(take.min()))
+            self.max = max(self.max, float(take.max()))
+            self.reciprocal_sum += float((1.0 / take).sum())
+            self.temp_vals.extend(take.tolist())
+            self.temp_wts.extend([1.0] * len(take))
+            self.temp_total += float(len(take))
+            i += len(take)
+
+    def _index_estimate(self, q: float) -> float:
+        return self.compression * (
+            (math.asin(2.0 * q - 1.0) / math.pi) + 0.5)
+
+    def _merge_all_temps(self) -> None:
+        if not self.temp_vals:
+            return
+        order = np.argsort(np.asarray(self.temp_vals),
+                           kind="stable")
+        tv = [self.temp_vals[j] for j in order]
+        tw = [self.temp_wts[j] for j in order]
+        # two-pointer ascending merge; Go takes the temp side when
+        # means tie (nextMain.Mean < nextTemp.Mean picks main only on
+        # strict less)
+        mv, mw = self.main_mean, self.main_weight
+        total = self.main_total + self.temp_total
+        out_mean: list[float] = []
+        out_weight: list[float] = []
+        merged = 0.0
+        last_index = 0.0
+        idx_est = self._index_estimate
+        i = j = 0
+        ni, nj = len(mv), len(tv)
+        while i < ni or j < nj:
+            if i < ni and (j >= nj or mv[i] < tv[j]):
+                mean, weight = mv[i], mw[i]
+                i += 1
+            else:
+                mean, weight = tv[j], tw[j]
+                j += 1
+            next_index = idx_est((merged + weight) / total)
+            if next_index - last_index > 1.0 or not out_mean:
+                out_mean.append(mean)
+                out_weight.append(weight)
+                last_index = idx_est(merged / total)
+            else:
+                # Welford: weight before mean
+                out_weight[-1] += weight
+                out_mean[-1] += ((mean - out_mean[-1]) * weight /
+                                 out_weight[-1])
+            merged += weight
+        self.main_mean = out_mean
+        self.main_weight = out_weight
+        self.main_total = total
+        self.temp_vals = []
+        self.temp_wts = []
+        self.temp_total = 0.0
+
+    def _upper_bound(self, i: int) -> float:
+        if i != len(self.main_mean) - 1:
+            return (self.main_mean[i + 1] + self.main_mean[i]) / 2.0
+        return self.max
+
+    def quantile(self, quantile: float) -> float:
+        if quantile < 0.0 or quantile > 1.0:
+            raise ValueError("quantile out of bounds")
+        self._merge_all_temps()
+        q = quantile * self.main_total
+        weight_so_far = 0.0
+        lower = self.min
+        for i, w in enumerate(self.main_weight):
+            upper = self._upper_bound(i)
+            if q <= weight_so_far + w:
+                proportion = (q - weight_so_far) / w
+                return lower + proportion * (upper - lower)
+            weight_so_far += w
+            lower = upper
+        return math.nan
+
+    def count(self) -> float:
+        return self.main_total + self.temp_total

@@ -1,0 +1,63 @@
+"""The Go-serial-digest MODEL itself must be a fair stand-in before
+the accuracy artifact compares against it: these mirror the
+reference's own test expectations (histo_test.go) plus the paper's
+structural invariants."""
+
+import math
+
+import numpy as np
+
+from tests.go_digest_model import GoMergingDigest, estimate_temp_buffer
+
+
+def test_mirrors_reference_uniform_bounds():
+    """histo_test.go:15 TestMergingDigest: c=1000, 100k uniforms,
+    median within 2%, min/max sane."""
+    rng = np.random.default_rng(42)
+    d = GoMergingDigest(1000.0)
+    d.add_many(rng.random(100_000))
+    assert abs(d.quantile(0.5) - 0.5) / 0.5 < 0.02
+    assert d.min >= 0
+    assert d.max < 1
+    assert d.count() == 100_000
+
+
+def test_size_bound_and_weight_conservation():
+    """merging_digest.go:70: centroid count <= pi*c/2 + 0.5; total
+    weight is conserved exactly."""
+    rng = np.random.default_rng(7)
+    d = GoMergingDigest(100.0)
+    d.add_many(rng.lognormal(3.0, 2.0, 50_000))
+    d._merge_all_temps()
+    assert len(d.main_mean) <= int(math.pi * 100.0 / 2 + 0.5)
+    assert d.main_total == 50_000.0
+    assert abs(sum(d.main_weight) - 50_000.0) < 1e-6
+    # centroids ascend by mean (sorted-merge invariant)
+    assert all(a <= b for a, b in zip(d.main_mean, d.main_mean[1:]))
+
+
+def test_temp_buffer_heuristic_matches_reference():
+    """estimateTempBuffer (merging_digest.go:107) at the sampled
+    compressions the reference uses."""
+    assert estimate_temp_buffer(100.0) == int(7.5 + 37.0 - 2.0)
+    assert estimate_temp_buffer(1000.0) == int(
+        7.5 + 0.37 * 925 - 2e-4 * 925 * 925)
+    assert estimate_temp_buffer(5.0) == estimate_temp_buffer(20.0)
+
+
+def test_add_many_matches_serial_adds():
+    """The bulk path must preserve the serial merge cadence — same
+    final centroids as one-at-a-time add()."""
+    rng = np.random.default_rng(3)
+    vals = rng.exponential(10.0, 5_000)
+    a = GoMergingDigest(100.0)
+    a.add_many(vals)
+    b = GoMergingDigest(100.0)
+    for v in vals:
+        b.add(float(v))
+    a._merge_all_temps()
+    b._merge_all_temps()
+    np.testing.assert_allclose(a.main_mean, b.main_mean, rtol=1e-12)
+    np.testing.assert_allclose(a.main_weight, b.main_weight)
+    for q in (0.1, 0.5, 0.9, 0.99, 0.999):
+        assert a.quantile(q) == b.quantile(q)
