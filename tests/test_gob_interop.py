@@ -155,3 +155,33 @@ def test_proxy_routes_reference_items():
     items = json.loads(open(REF_FIXTURE, "rb").read())
     key = ProxyServer._json_key(items[0])
     assert key == "a.b.c|histogram|"
+
+
+def test_old_gob_digest_backwards_compat():
+    """The reference pins gob back-compat with a recorded first-
+    generation digest (tdigest/testdata/oldgob.base64; histo_test.go
+    TestGobDecodeOldGob).  The same bytes must decode here with the
+    same recovered statistics — including the ABSENT reciprocalSum
+    field, which postdates the recording (that's what the fixture
+    exists to catch)."""
+    import base64
+    import numpy as np
+    from tests.go_digest_model import GoMergingDigest
+
+    raw = base64.b64decode(open(
+        "/root/reference/tdigest/testdata/oldgob.base64").read())
+    d = gob_codec.decode_digest(raw)
+    w = np.asarray(d["weights"], float)
+    m = np.asarray(d["means"], float)
+    assert w.sum() == 1000.0
+    assert abs(d["min"] - 0.01) <= 0.02       # Adds were 0..999
+    assert abs(d["max"] - 1000) / 1000 <= 0.02
+    assert float((m * w).sum()) == 499500.0   # Sum() exact
+    assert d.get("reciprocal_sum") in (None, 0.0)
+    # the median through the reference quantile rule reads ~500
+    god = GoMergingDigest(1000.0)
+    god.main_mean = list(m)
+    god.main_weight = list(w)
+    god.main_total = float(w.sum())
+    god.min, god.max = d["min"], d["max"]
+    assert abs(god.quantile(0.5) - 500.0) / 500.0 <= 0.02
