@@ -1,0 +1,131 @@
+"""Deep-batch digest merges: the single-dispatch scan paths.
+
+A batch whose per-row depth exceeds one merge width takes
+table._digest_merge_scan — host-densified plane + lax.scan of chunk
+merges when the touched rows are uniform, a flat scatter-scan when
+the plane would be oversized, and the host k-scale precluster past
+64 chunk widths.  These pin weight conservation, quantile accuracy
+and WHICH branch engaged for each shape (semantics contract:
+reference tdigest/merging_digest.go:229 mergeNewValues)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from veneur_tpu.core.table import MetricTable, TableConfig
+from veneur_tpu.ops import tdigest
+
+
+def _deep_table(slots=128, rows=64):
+    return MetricTable(TableConfig(histo_rows=rows, histo_slots=slots,
+                                   histo_merge_samples=1 << 30))
+
+
+def _feed(table, row_ids, vals):
+    table._digest_stage.append(
+        np.asarray(row_ids, np.int32),
+        np.asarray(vals, np.float32),
+        np.ones(len(vals), np.float32))
+    table.device_step(final=True)
+
+
+def _spied(monkeypatch, names):
+    calls = []
+    for name in names:
+        real = getattr(tdigest, name)
+
+        def wrap(*a, _real=real, _n=name, **kw):
+            calls.append(_n)
+            return _real(*a, **kw)
+
+        monkeypatch.setattr(tdigest, name, wrap)
+    return calls
+
+
+def test_uniform_deep_batch_takes_dense_scan(monkeypatch):
+    calls = _spied(monkeypatch, ["merge_dense_scan_rows",
+                                 "merge_dense_scan",
+                                 "add_samples_ranked_scan_rows",
+                                 "add_samples_ranked_scan"])
+    t = _deep_table()
+    rng = np.random.default_rng(0)
+    n_rows, depth = 16, 1000  # depth ~8x the 128-slot merge width
+    rows = np.repeat(np.arange(n_rows, dtype=np.int32), depth)
+    vals = rng.gamma(2.0, 30.0, len(rows)).astype(np.float32)
+    _feed(t, rows, vals)
+    assert any(c.startswith("merge_dense_scan") for c in calls), calls
+    w = np.asarray(t.histo_weights)
+    np.testing.assert_allclose(w.sum(axis=1)[:n_rows], depth,
+                               rtol=1e-6)
+    q = np.asarray(tdigest.quantile(
+        t.histo_means, t.histo_weights,
+        np.asarray([0.5, 0.99], np.float32)))
+    for r in range(n_rows):
+        sv = vals[rows == r]
+        for qi, p in enumerate((0.5, 0.99)):
+            exact = np.quantile(sv, p)
+            assert abs(q[r, qi] - exact) / exact < 0.02, (r, p)
+
+
+def test_skewed_deep_batch_takes_flat_scan(monkeypatch):
+    """One row 100x deeper than the rest: the dense plane would blow
+    past 2x the flat bytes, so the flat scatter-scan engages — and
+    still conserves weight."""
+    calls = _spied(monkeypatch, ["merge_dense_scan_rows",
+                                 "merge_dense_scan",
+                                 "add_samples_ranked_scan_rows",
+                                 "add_samples_ranked_scan"])
+    t = _deep_table(slots=128, rows=256)
+    rng = np.random.default_rng(1)
+    deep = 6000
+    rows = np.concatenate([
+        np.zeros(deep, np.int32),
+        np.arange(1, 200, dtype=np.int32)])  # 199 singleton rows
+    vals = rng.exponential(50.0, len(rows)).astype(np.float32)
+    _feed(t, rows, vals)
+    assert any(c.startswith("add_samples_ranked_scan")
+               for c in calls), calls
+    w = np.asarray(t.histo_weights)
+    assert w[0].sum() == pytest.approx(deep, rel=1e-6)
+    np.testing.assert_allclose(w[1:200].sum(axis=1), 1.0)
+
+
+def test_ultra_deep_row_preclusters_then_merges():
+    """Past 64 chunk widths the host k-scale precluster bounds the
+    scan (compile variants + h2d bytes); accuracy stays inside the
+    digest budget."""
+    t = _deep_table(slots=64, rows=8)
+    rng = np.random.default_rng(2)
+    depth = 64 * 64 * 2  # 2x the escape threshold at 64-slot chunks
+    vals = rng.gamma(2.0, 30.0, depth).astype(np.float32)
+    _feed(t, np.zeros(depth, np.int32), vals)
+    w = np.asarray(t.histo_weights)
+    assert w[0].sum() == pytest.approx(depth, rel=1e-6)
+    q = np.asarray(tdigest.quantile(
+        t.histo_means, t.histo_weights,
+        np.asarray([0.5, 0.99], np.float32)))
+    for qi, p in enumerate((0.5, 0.99)):
+        exact = np.quantile(vals, p)
+        assert abs(q[0, qi] - exact) / exact < 0.02, p
+
+
+def test_scan_matches_single_merge_ground_truth():
+    """The same samples through (a) one wide merge and (b) the
+    chunked scan agree at the quantile readout within digest noise."""
+    rng = np.random.default_rng(3)
+    n = 4096
+    vals = rng.normal(100.0, 25.0, n).astype(np.float32)
+
+    wide = _deep_table(slots=8192, rows=8)
+    _feed(wide, np.zeros(n, np.int32), vals)
+
+    scan = _deep_table(slots=128, rows=8)
+    _feed(scan, np.zeros(n, np.int32), vals)
+
+    qs = np.asarray([0.1, 0.5, 0.9, 0.99], np.float32)
+    qw = np.asarray(tdigest.quantile(
+        wide.histo_means, wide.histo_weights, qs))[0]
+    qn = np.asarray(tdigest.quantile(
+        scan.histo_means, scan.histo_weights, qs))[0]
+    np.testing.assert_allclose(qn, qw, rtol=0.01)
