@@ -887,24 +887,48 @@ class Server:
         self.bump("packets_received", len(good) + drained_pkts)
         if drained is not None:
             good.append(drained)
-        # views into the reader's own parser scratch: consumed fully
-        # (ingest + slow-path sweep) before this reader parses again
-        pb = parser.parse(b"\n".join(good), copy=False)
-        with self.lock:
-            processed, dropped = self.table.ingest_columns(pb)
-            self._maybe_device_step_locked()
-        # events / service checks / malformed lines: per-line slow path
-        slow = np.nonzero(pb.type_code > columnar.CODE_SET)[0]
-        for i in slow:
-            line = pb.line(int(i))
-            try:
-                parsed = dsd.parse_line(line)
-            except dsd.ParseError:
-                errors += 1
-                continue
-            p, d = self.ingest_parsed(parsed, bump=False)
-            processed += p
-            dropped += d
+        if self.config.num_readers <= 1 and \
+                getattr(self.table, "_lib", None) is not None:
+            # single reader: nothing contends for the table lock, so
+            # the fused native parse+probe+combine pass (no column
+            # materialization) replaces parse-then-ingest; the split
+            # design exists so MULTI-reader servers parse outside the
+            # lock
+            buf = b"\n".join(good)
+            with self.lock:
+                processed, dropped, others = \
+                    self.table.ingest_buffer(buf)
+                self._maybe_device_step_locked()
+            for off, ln, _kind in others:
+                try:
+                    parsed = dsd.parse_line(buf[off:off + ln])
+                except dsd.ParseError:
+                    errors += 1
+                    continue
+                p, d = self.ingest_parsed(parsed, bump=False)
+                processed += p
+                dropped += d
+        else:
+            # views into the reader's own parser scratch: consumed
+            # fully (ingest + slow-path sweep) before this reader
+            # parses again
+            pb = parser.parse(b"\n".join(good), copy=False)
+            with self.lock:
+                processed, dropped = self.table.ingest_columns(pb)
+                self._maybe_device_step_locked()
+            # events / service checks / malformed lines: per-line
+            # slow path
+            slow = np.nonzero(pb.type_code > columnar.CODE_SET)[0]
+            for i in slow:
+                line = pb.line(int(i))
+                try:
+                    parsed = dsd.parse_line(line)
+                except dsd.ParseError:
+                    errors += 1
+                    continue
+                p, d = self.ingest_parsed(parsed, bump=False)
+                processed += p
+                dropped += d
         if errors:
             self.bump("packet_errors", errors)
         if processed:

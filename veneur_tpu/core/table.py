@@ -218,6 +218,23 @@ class _Staging:
         return sum(len(r) for r in self.rows)
 
 
+class _MissLines:
+    """ParsedBatch-shaped view over the fused pass's compact miss
+    columns — just enough surface for _resolve_misses (line bytes +
+    type codes)."""
+
+    def __init__(self, buf: np.ndarray, off: np.ndarray,
+                 ln: np.ndarray, types: np.ndarray):
+        self._buf = buf
+        self._off = off
+        self._len = ln
+        self.type_code = types
+
+    def line(self, i: int) -> bytes:
+        o = int(self._off[i])
+        return self._buf[o:o + int(self._len[i])].tobytes()
+
+
 @dataclass
 class Snapshot:
     """Everything the flusher needs from one interval, per class:
@@ -373,6 +390,9 @@ class MetricTable:
         # (Snapshot.release); list ops are GIL-atomic, so the flusher
         # thread appends while the ingest thread pops
         self._plane_pool: list[np.ndarray] = []
+
+        # fused parse+ingest scratch (see ingest_buffer), grow-only
+        self._fused_scratch: dict | None = None
 
         self.status: dict[tuple, tuple[float, str, tuple[str, ...]]] = {}
         # gRPC import fast path: native import-identity hash -> row
@@ -607,6 +627,139 @@ class MetricTable:
         processed = len(sel)
         self._staged_n += processed - dropped
         return processed, dropped
+
+    def ingest_buffer(self, buf
+                      ) -> tuple[int, int, list[tuple[int, int, int]]]:
+        """Fused parse + probe + combine over a raw newline-separated
+        buffer (native vtpu_parse_ingest): no column materialization
+        between the grammar and the table.  For SINGLE-READER
+        pipelines — the split parse/ingest_columns design exists so
+        multi-reader servers can parse outside the table lock.
+
+        Returns (processed, dropped, others) where others is
+        [(offset, length, type_code)] for event / service-check /
+        error lines — the caller's per-line business, as with
+        ingest_columns.  Falls back to parse + ingest_columns when
+        the native library is unavailable."""
+        if self._lib is None or not isinstance(
+                self.key_index, intern.NativeHashIndex):
+            parser = getattr(self, "_fallback_parser", None)
+            if parser is None:
+                parser = columnar.ColumnarParser()
+                self._fallback_parser = parser
+            pb = parser.parse(bytes(buf), copy=False)
+            processed, dropped = self.ingest_columns(pb)
+            others = [(int(pb.line_off[i]), int(pb.line_len[i]),
+                       int(pb.type_code[i]))
+                      for i in np.nonzero(
+                          pb.type_code[:pb.n] > columnar.CODE_SET)[0]]
+            return processed, dropped, others
+        import ctypes as ct
+        buf_b = bytes(buf) if not isinstance(buf, bytes) else buf
+        buf_np = np.frombuffer(buf_b, np.uint8)
+        n_est = buf_b.count(b"\n") + 1
+        sc = self._fused_scratch
+        if sc is None or len(sc["hr"]) < n_est:
+            cap = max(n_est, 4096)
+            sc = self._fused_scratch = {
+                "hr": np.empty(cap, np.int32),
+                "hv": np.empty(cap, np.float32),
+                "hw": np.empty(cap, np.float32),
+                "sr": np.empty(cap, np.int32),
+                "sp": np.empty(cap, np.int32),
+                "mk": np.empty(cap, np.uint64),
+                "mt": np.empty(cap, np.uint8),
+                "mv": np.empty(cap, np.float64),
+                "mm": np.empty(cap, np.uint64),
+                "mw": np.empty(cap, np.float32),
+                "mo": np.empty(cap, np.int64),
+                "ml": np.empty(cap, np.int32),
+                "oo": np.empty(cap, np.int64),
+                "ol": np.empty(cap, np.int32),
+                "ok": np.empty(cap, np.uint8),
+            }
+        meta = np.zeros(12, np.int64)
+
+        def p(a, t):
+            return a.ctypes.data_as(ct.POINTER(t))
+
+        u8p = ct.c_uint8
+        self._lib.vtpu_parse_ingest(
+            p(buf_np, u8p), len(buf_np),
+            self.key_index.handle, hashing.HLL_P,
+            p(self._counter_dense, ct.c_double),
+            p(self.counter_idx.touched.view(np.uint8), u8p),
+            p(self._gauge_dense, ct.c_float),
+            p(self._gauge_mask, u8p),
+            p(self.gauge_idx.touched.view(np.uint8), u8p),
+            p(sc["hr"], ct.c_int32), p(sc["hv"], ct.c_float),
+            p(sc["hw"], ct.c_float),
+            p(self.histo_idx.touched.view(np.uint8), u8p),
+            p(sc["sr"], ct.c_int32), p(sc["sp"], ct.c_int32),
+            p(self.set_idx.touched.view(np.uint8), u8p),
+            p(sc["mk"], ct.c_uint64), p(sc["mt"], u8p),
+            p(sc["mv"], ct.c_double), p(sc["mm"], ct.c_uint64),
+            p(sc["mw"], ct.c_float),
+            p(sc["mo"], ct.c_int64), p(sc["ml"], ct.c_int32),
+            p(sc["oo"], ct.c_int64), p(sc["ol"], ct.c_int32),
+            p(sc["ok"], u8p),
+            p(meta, ct.c_int64))
+
+        n_miss = int(meta[2])
+        if n_miss:
+            shim = _MissLines(buf_np, sc["mo"], sc["ml"], sc["mt"])
+            self._resolve_misses(shim, np.arange(n_miss),
+                                 sc["mk"][:n_miss])
+            # replay the compact miss columns through the column
+            # combiner (resolved keys now hit; unparseable ones are
+            # DROPPED and counted) — same staging buffers, same meta
+            i64p = ct.POINTER(ct.c_int64)
+            miss2 = np.empty(n_miss, np.int64)
+            self._lib.vtpu_ingest(
+                self.key_index.handle,
+                p(sc["mk"], ct.c_uint64), p(sc["mt"], u8p),
+                p(sc["mv"], ct.c_double), p(sc["mm"], ct.c_uint64),
+                p(sc["mw"], ct.c_float), n_miss,
+                miss2.ctypes.data_as(i64p), -1,
+                hashing.HLL_P,
+                p(self._counter_dense, ct.c_double),
+                p(self.counter_idx.touched.view(np.uint8), u8p),
+                p(self._gauge_dense, ct.c_float),
+                p(self._gauge_mask, u8p),
+                p(self.gauge_idx.touched.view(np.uint8), u8p),
+                p(sc["hr"], ct.c_int32), p(sc["hv"], ct.c_float),
+                p(sc["hw"], ct.c_float),
+                p(self.histo_idx.touched.view(np.uint8), u8p),
+                p(sc["sr"], ct.c_int32), p(sc["sp"], ct.c_int32),
+                p(self.set_idx.touched.view(np.uint8), u8p),
+                miss2.ctypes.data_as(i64p),
+                p(meta, ct.c_int64))
+
+        processed = int(meta[3])
+        dropped = int(meta[6:11].sum())
+        if dropped:
+            self.counter_idx.overflow += int(meta[6])
+            self.gauge_idx.overflow += int(meta[7])
+            self.histo_idx.overflow += int(meta[8] + meta[9])
+            self.set_idx.overflow += int(meta[10])
+        if meta[4]:
+            self._counter_dirty = True
+        if meta[5]:
+            self._gauge_dirty = True
+        hn = int(meta[0])
+        if hn:
+            self._histo_stage.append(sc["hr"][:hn].copy(),
+                                     sc["hv"][:hn].copy(),
+                                     sc["hw"][:hn].copy())
+        sn = int(meta[1])
+        if sn:
+            self._set_pos_rows.append(sc["sr"][:sn].copy())
+            self._set_pos.append(sc["sp"][:sn].copy())
+        self._staged_n += processed - dropped
+        n_other = int(meta[11])
+        others = [(int(sc["oo"][i]), int(sc["ol"][i]),
+                   int(sc["ok"][i])) for i in range(n_other)]
+        return processed, dropped, others
 
     def _ingest_columns_native(self, pb: columnar.ParsedBatch
                                ) -> tuple[int, int]:

@@ -168,6 +168,15 @@ def _bind(lib: ctypes.CDLL) -> None:
         i32p, f32p, f32p, u8p,
         i32p, i32p, u8p,
         i64p, i64p]
+    lib.vtpu_parse_ingest.restype = None
+    lib.vtpu_parse_ingest.argtypes = [
+        u8p, i64, vp, i64,
+        f64p, u8p, f32p, u8p, u8p,
+        i32p, f32p, f32p, u8p,
+        i32p, i32p, u8p,
+        u64p, u8p, f64p, u64p, f32p, i64p, i32p,
+        i64p, i32p, u8p,
+        i64p]
     lib.vtpu_metriclist_decode.restype = i64
     lib.vtpu_metriclist_decode.argtypes = [
         u8p, i64, i64, i64, i64,

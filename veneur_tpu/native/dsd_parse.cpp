@@ -306,6 +306,137 @@ enum : uint8_t {
 // its scratch and retry — counting lines up front cost more than the
 // parse itself (bytes.count on a 75MB batch was ~60ms; the rare
 // retry is free in steady state because reader batches are bounded).
+// Per-line grammar core shared by the column writer
+// (vtpu_parse_batch) and the fused parse+combine pass
+// (vtpu_parse_ingest).  Returns the line's type code; for metric
+// codes (<= T_SET) every LineParse field is valid, for
+// event/service-check/error codes only tc is.
+struct LineParse {
+  uint8_t tc;
+  uint8_t scope;
+  float weight;
+  double value;     // non-set metrics
+  uint64_t member;  // sets
+  uint64_t key;
+};
+
+inline uint8_t parse_line_core(const uint8_t* buf, int64_t start,
+                               int64_t eol, const DelimMasks& dm,
+                               LineParse* o) {
+  const uint8_t* line = buf + start;
+  const int64_t n = eol - start;
+
+  // events / service checks -> slow path
+  if (n >= 3 && line[0] == '_') {
+    if (line[1] == 'e' && line[2] == '{') return T_EVENT;
+    if (n >= 4 && line[1] == 's' && line[2] == 'c' &&
+        line[3] == '|') return T_SERVICE_CHECK;
+  }
+
+  // name:value|type[|@rate][|#tags] — all field positions come
+  // from the stage-1 masks (absolute buffer offsets)
+  const int64_t ca = next_bit(dm.colon, start, eol);
+  if (ca < 0 || ca == start) return T_ERROR;
+  // a '|' before the colon means the first pipe-section has no
+  // name:value pair — the reference splits on '|' FIRST and rejects
+  // such lines (samplers/parser.go:307), so must we
+  if (next_bit(dm.pipe, start, ca) >= 0) return T_ERROR;
+  const int64_t pa = next_bit(dm.pipe, ca + 1, eol);
+  if (pa < 0 || pa == ca + 1) return T_ERROR;
+  int64_t te = next_bit(dm.pipe, pa + 1, eol);
+  if (te < 0) te = eol;
+  int64_t tlen = te - (pa + 1);
+  uint8_t tc;
+  uint8_t t0 = tlen >= 1 ? buf[pa + 1] : 0;
+  if (tlen == 1) {
+    switch (t0) {
+      case 'c': tc = T_COUNTER; break;
+      case 'g': tc = T_GAUGE; break;
+      case 'm': tc = T_TIMER; break;
+      case 'h': tc = T_HISTOGRAM; break;
+      case 'd': tc = T_HISTOGRAM; break;
+      case 's': tc = T_SET; break;
+      default: return T_ERROR;
+    }
+  } else if (tlen == 2 && t0 == 'm' && buf[pa + 2] == 's') {
+    tc = T_TIMER;
+  } else {
+    return T_ERROR;
+  }
+
+  // optional sections.  Tags accumulate into a commutative identity
+  // sum as they are scanned — no tag array, no sort, no assembly
+  // (that stage was half the per-line cost of the payload-hash
+  // design), and no tag-count cap.
+  double rate = 1.0;
+  uint64_t tagsum = 0;
+  uint8_t sc = 0;
+  int64_t sec = te;
+  while (sec < eol) {
+    // sec points at '|'
+    int64_t s0 = sec + 1;
+    if (s0 >= eol) return T_ERROR;
+    int64_t s1 = next_bit(dm.pipe, s0, eol);
+    if (s1 < 0) s1 = eol;
+    if (buf[s0] == '@') {
+      if (!parse_value(buf + s0 + 1, s1 - s0 - 1, &rate) ||
+          !(rate > 0.0 && rate <= 1.0)) {
+        return T_ERROR;
+      }
+    } else if (buf[s0] == '#') {
+      // a later '#' section REPLACES tags and scope (the reference
+      // overwrites tags per section; last one wins)
+      tagsum = 0;
+      sc = 0;
+      int64_t t = s0 + 1;
+      while (t <= s1) {
+        int64_t e = next_bit(dm.comma, t, s1);
+        if (e < 0) e = s1;
+        int64_t L = e - t;
+        if (L > 0) {
+          // scope magic tags: prefix match as the reference does
+          // (parser.go:397-407); first-byte guard keeps the memcmp
+          // off the per-tag hot path
+          if (buf[t] == 'v' && L >= 15 &&
+              memcmp(buf + t, "veneurlocalonly", 15) == 0) {
+            sc = 1;
+          } else if (buf[t] == 'v' && L >= 16 &&
+                     memcmp(buf + t, "veneurglobalonly", 16) == 0) {
+            sc = 2;
+          } else {
+            tagsum += fmix64(fold64(buf + t, (size_t)L));
+          }
+        }
+        t = e + 1;
+      }
+    } else {
+      return T_ERROR;
+    }
+    sec = s1;
+  }
+  if (tc == T_GAUGE && rate != 1.0) return T_ERROR;
+
+  int64_t vlen = pa - (ca + 1);
+  if (tc == T_SET) {
+    o->member = fmix64(fnv1a64(kFnvOffset, buf + ca + 1, vlen));
+  } else {
+    double v;
+    if (!parse_value(buf + ca + 1, vlen, &v) ||
+        !std::isfinite(v)) {
+      return T_ERROR;
+    }
+    o->value = v;
+  }
+  o->weight = (float)(1.0 / rate);
+  o->scope = sc;
+  o->key = fmix64(
+      fold64(buf + start, (size_t)(ca - start)) ^
+      fmix64((((uint64_t)tc * kKeyTypeMult) ^
+              ((uint64_t)sc * kKeyScopeMult)) + tagsum));
+  o->tc = tc;
+  return tc;
+}
+
 int64_t vtpu_parse_batch(
     const uint8_t* buf, int64_t len,
     uint64_t* key_hash, uint8_t* type_code, double* value,
@@ -317,7 +448,6 @@ int64_t vtpu_parse_batch(
   while (pos < len) {
     int64_t nlp = next_bit(dm.nl, pos, len);
     const int64_t eol = nlp < 0 ? len : nlp;
-    const uint8_t* line = buf + pos;
     int64_t n = eol - pos;
     int64_t start = pos;
     pos = eol + 1;
@@ -341,135 +471,16 @@ int64_t vtpu_parse_batch(
     // non-sets, all of them unused for error/event lines), and
     // key_hash/weight/scope are unconditionally assigned on the
     // metric success path below — 5 scattered stores per line saved
-
-    // events / service checks -> slow path
-    if (n >= 3 && line[0] == '_') {
-      if (n >= 3 && line[1] == 'e' && line[2] == '{') {
-        type_code[out++] = T_EVENT;
-        continue;
-      }
-      if (n >= 4 && line[1] == 's' && line[2] == 'c' && line[3] == '|') {
-        type_code[out++] = T_SERVICE_CHECK;
-        continue;
-      }
-    }
-
-    // name:value|type[|@rate][|#tags] — all field positions come
-    // from the stage-1 masks (absolute buffer offsets)
-    const int64_t ca = next_bit(dm.colon, start, eol);
-    if (ca < 0 || ca == start) { type_code[out++] = T_ERROR; continue; }
-    // a '|' before the colon means the first pipe-section has no
-    // name:value pair — the reference splits on '|' FIRST and rejects
-    // such lines (samplers/parser.go:307), so must we
-    if (next_bit(dm.pipe, start, ca) >= 0) {
-      type_code[out++] = T_ERROR;
-      continue;
-    }
-    const int64_t pa = next_bit(dm.pipe, ca + 1, eol);
-    if (pa < 0 || pa == ca + 1) {
-      type_code[out++] = T_ERROR;
-      continue;
-    }
-    int64_t te = next_bit(dm.pipe, pa + 1, eol);
-    if (te < 0) te = eol;
-    int64_t tlen = te - (pa + 1);
-    uint8_t tc;
-    uint8_t t0 = tlen >= 1 ? buf[pa + 1] : 0;
-    if (tlen == 1) {
-      switch (t0) {
-        case 'c': tc = T_COUNTER; break;
-        case 'g': tc = T_GAUGE; break;
-        case 'm': tc = T_TIMER; break;
-        case 'h': tc = T_HISTOGRAM; break;
-        case 'd': tc = T_HISTOGRAM; break;
-        case 's': tc = T_SET; break;
-        default: type_code[out++] = T_ERROR; continue;
-      }
-    } else if (tlen == 2 && t0 == 'm' && buf[pa + 2] == 's') {
-      tc = T_TIMER;
-    } else {
-      type_code[out++] = T_ERROR;
-      continue;
-    }
-
-    // optional sections.  Tags accumulate into a commutative identity
-    // sum as they are scanned — no tag array, no sort, no assembly
-    // (that stage was half the per-line cost of the payload-hash
-    // design), and no tag-count cap.
-    double rate = 1.0;
-    uint64_t tagsum = 0;
-    uint8_t sc = 0;
-    bool bad = false;
-    int64_t sec = te;
-    while (sec < eol) {
-      // sec points at '|'
-      int64_t s0 = sec + 1;
-      if (s0 >= eol) { bad = true; break; }
-      int64_t s1 = next_bit(dm.pipe, s0, eol);
-      if (s1 < 0) s1 = eol;
-      if (buf[s0] == '@') {
-        if (!parse_value(buf + s0 + 1, s1 - s0 - 1, &rate) ||
-            !(rate > 0.0 && rate <= 1.0)) {
-          bad = true;
-          break;
-        }
-      } else if (buf[s0] == '#') {
-        // a later '#' section REPLACES tags and scope (the reference
-        // overwrites tags per section; last one wins)
-        tagsum = 0;
-        sc = 0;
-        int64_t t = s0 + 1;
-        while (t <= s1) {
-          int64_t e = next_bit(dm.comma, t, s1);
-          if (e < 0) e = s1;
-          int64_t L = e - t;
-          if (L > 0) {
-            // scope magic tags: prefix match as the reference does
-            // (parser.go:397-407); first-byte guard keeps the memcmp
-            // off the per-tag hot path
-            if (buf[t] == 'v' && L >= 15 &&
-                memcmp(buf + t, "veneurlocalonly", 15) == 0) {
-              sc = 1;
-            } else if (buf[t] == 'v' && L >= 16 &&
-                       memcmp(buf + t, "veneurglobalonly", 16) == 0) {
-              sc = 2;
-            } else {
-              tagsum += fmix64(fold64(buf + t, (size_t)L));
-            }
-          }
-          t = e + 1;
-        }
-      } else {
-        bad = true;
-        break;
-      }
-      sec = s1;
-    }
-    if (bad || (tc == T_GAUGE && rate != 1.0)) {
-      type_code[out++] = T_ERROR;
-      continue;
-    }
-
-    int64_t vlen = pa - (ca + 1);
-    if (tc == T_SET) {
-      member_hash[out] =
-          fmix64(fnv1a64(kFnvOffset, buf + ca + 1, vlen));
-    } else {
-      double v;
-      if (!parse_value(buf + ca + 1, vlen, &v) ||
-          !std::isfinite(v)) {
-        type_code[out++] = T_ERROR;
-        continue;
-      }
-      value[out] = v;
-    }
-    weight[out] = (float)(1.0 / rate);
-    scope[out] = sc;
-    key_hash[out] = fmix64(
-        fold64(buf + start, (size_t)(ca - start)) ^
-        fmix64((((uint64_t)tc * kKeyTypeMult) ^
-                ((uint64_t)sc * kKeyScopeMult)) + tagsum));
+    LineParse lp;
+    uint8_t tc = parse_line_core(buf, start, eol, dm, &lp);
     type_code[out] = tc;
+    if (tc <= T_SET) {
+      if (tc == T_SET) member_hash[out] = lp.member;
+      else value[out] = lp.value;
+      weight[out] = lp.weight;
+      scope[out] = lp.scope;
+      key_hash[out] = lp.key;
+    }
     out++;
   }
   return out;
@@ -671,6 +682,55 @@ void vtpu_index_lookup(void* p, const uint64_t* keys, int64_t n,
 // [2]=miss count (out only), [3]=processed (metric lines with a
 // resolved key, incl. dropped), [4]=counter hits, [5]=gauge hits,
 // [6..10]=dropped per type code 0..4.
+// One resolved metric sample into the dense/staged outputs — shared
+// by the column combiner (vtpu_ingest) and the fused pass
+// (vtpu_parse_ingest) so the two ingest paths cannot desync.
+inline void combine_line(uint8_t tc, int32_t row, double val,
+                         uint64_t member, float wt, int64_t hll_p,
+                         double* counter_dense, uint8_t* counter_touch,
+                         float* gauge_dense, uint8_t* gauge_mask,
+                         uint8_t* gauge_touch,
+                         int32_t* histo_rows, float* histo_vals,
+                         float* histo_wts, uint8_t* histo_touch,
+                         int32_t* set_rows, int32_t* set_pos,
+                         uint8_t* set_touch,
+                         int64_t* hn, int64_t* sn, int64_t* cn,
+                         int64_t* gn) {
+  switch (tc) {
+    case T_COUNTER:
+      counter_dense[row] += val * (double)wt;
+      counter_touch[row] = 1;
+      (*cn)++;
+      break;
+    case T_GAUGE:
+      gauge_dense[row] = (float)val;
+      gauge_mask[row] = 1;  // staging dirty mask (cleared per step)
+      gauge_touch[row] = 1;  // interval-scoped flush-emission mark
+      (*gn)++;
+      break;
+    case T_TIMER:
+    case T_HISTOGRAM:
+      histo_rows[*hn] = row;
+      histo_vals[*hn] = (float)val;
+      histo_wts[*hn] = wt;
+      histo_touch[row] = 1;
+      (*hn)++;
+      break;
+    case T_SET: {
+      // bit split parameterized by hll_p so utils/hashing.HLL_P
+      // stays the single source of truth
+      const uint32_t ridx = (uint32_t)(member >> (64 - hll_p));
+      const uint64_t w = (member << hll_p) | (1ULL << (hll_p - 1));
+      const int rank = __builtin_clzll(w) + 1;
+      set_rows[*sn] = row;
+      set_pos[*sn] = (int32_t)((ridx << 6) | (uint32_t)rank);
+      set_touch[row] = 1;
+      (*sn)++;
+      break;
+    }
+  }
+}
+
 void vtpu_ingest(
     void* tblp, const uint64_t* keys, const uint8_t* types,
     const double* vals, const uint64_t* members, const float* wts,
@@ -713,40 +773,11 @@ void vtpu_ingest(
       meta[6 + tc]++;
       continue;
     }
-    switch (tc) {
-      case T_COUNTER:
-        counter_dense[row] += vals[i] * (double)wts[i];
-        counter_touch[row] = 1;
-        cn++;
-        break;
-      case T_GAUGE:
-        gauge_dense[row] = (float)vals[i];
-        gauge_mask[row] = 1;  // staging dirty mask (cleared per step)
-        gauge_touch[row] = 1;  // interval-scoped flush-emission mark
-        gn++;
-        break;
-      case T_TIMER:
-      case T_HISTOGRAM:
-        histo_rows[hn] = row;
-        histo_vals[hn] = (float)vals[i];
-        histo_wts[hn] = wts[i];
-        histo_touch[row] = 1;
-        hn++;
-        break;
-      case T_SET: {
-        // bit split parameterized by hll_p so utils/hashing.HLL_P
-        // stays the single source of truth
-        const uint64_t h = members[i];
-        const uint32_t ridx = (uint32_t)(h >> (64 - hll_p));
-        const uint64_t w = (h << hll_p) | (1ULL << (hll_p - 1));
-        const int rank = __builtin_clzll(w) + 1;
-        set_rows[sn] = row;
-        set_pos[sn] = (int32_t)((ridx << 6) | (uint32_t)rank);
-        set_touch[row] = 1;
-        sn++;
-        break;
-      }
-    }
+    combine_line(tc, row, vals[i], members[i], wts[i], hll_p,
+                 counter_dense, counter_touch, gauge_dense,
+                 gauge_mask, gauge_touch, histo_rows, histo_vals,
+                 histo_wts, histo_touch, set_rows, set_pos,
+                 set_touch, &hn, &sn, &cn, &gn);
   }
   meta[0] = hn;
   meta[1] = sn;
@@ -754,6 +785,84 @@ void vtpu_ingest(
   meta[3] += processed;
   meta[4] += cn;
   meta[5] += gn;
+}
+
+// Fused parse + probe + combine: one pass from raw newline-separated
+// bytes to dense/staged table state, no column materialization.  The
+// split design (vtpu_parse_batch -> vtpu_ingest) writes then re-reads
+// ~22 bytes of columns per line — measurable at 35M lines/s — and
+// exists so multi-reader servers can parse OUTSIDE the table lock;
+// single-reader pipelines (num_readers == 1, and the bench harness)
+// take this fused path instead.  Misses spill to compact columns
+// (python resolves identities, then replays them through vtpu_ingest
+// with the same staging/meta); event/service-check/error lines spill
+// to (off, len, kind) for the per-line slow path.
+void vtpu_parse_ingest(
+    const uint8_t* buf, int64_t len, void* tblp, int64_t hll_p,
+    double* counter_dense, uint8_t* counter_touch,
+    float* gauge_dense, uint8_t* gauge_mask, uint8_t* gauge_touch,
+    int32_t* histo_rows, float* histo_vals, float* histo_wts,
+    uint8_t* histo_touch,
+    int32_t* set_rows, int32_t* set_pos, uint8_t* set_touch,
+    uint64_t* m_keys, uint8_t* m_types, double* m_vals,
+    uint64_t* m_members, float* m_wts,
+    int64_t* m_off, int32_t* m_len,
+    int64_t* o_off, int32_t* o_len, uint8_t* o_kind,
+    int64_t* meta) {
+  const VtpuIndex* t = (const VtpuIndex*)tblp;
+  DelimMasks dm = build_masks(buf, len);
+  int64_t hn = meta[0], sn = meta[1], mn = 0, on = 0;
+  int64_t processed = 0, cn = 0, gn = 0;
+  // no probe prefetch here, unlike vtpu_ingest: the next line's key
+  // doesn't exist until the next line is parsed; the parse compute
+  // between probes provides the latency hiding instead
+  int64_t pos = 0;
+  while (pos < len) {
+    int64_t nlp = next_bit(dm.nl, pos, len);
+    const int64_t eol = nlp < 0 ? len : nlp;
+    int64_t n = eol - pos;
+    int64_t start = pos;
+    pos = eol + 1;
+    if (n == 0) continue;
+    LineParse lp{};
+    uint8_t tc = parse_line_core(buf, start, eol, dm, &lp);
+    if (tc > T_SET) {
+      o_off[on] = start;
+      o_len[on] = (int32_t)n;
+      o_kind[on] = tc;
+      on++;
+      continue;
+    }
+    const int32_t row = index_get(t, lp.key);
+    if (row == -1) {
+      m_keys[mn] = lp.key;
+      m_types[mn] = tc;
+      m_vals[mn] = lp.value;
+      m_members[mn] = lp.member;
+      m_wts[mn] = lp.weight;
+      m_off[mn] = start;
+      m_len[mn] = (int32_t)n;
+      mn++;
+      continue;
+    }
+    processed++;
+    if (row < 0) {  // DROPPED (-2): class table full
+      meta[6 + tc]++;
+      continue;
+    }
+    combine_line(tc, row, lp.value, lp.member, lp.weight, hll_p,
+                 counter_dense, counter_touch, gauge_dense,
+                 gauge_mask, gauge_touch, histo_rows, histo_vals,
+                 histo_wts, histo_touch, set_rows, set_pos,
+                 set_touch, &hn, &sn, &cn, &gn);
+  }
+  meta[0] = hn;
+  meta[1] = sn;
+  meta[2] = mn;
+  meta[3] += processed;
+  meta[4] += cn;
+  meta[5] += gn;
+  meta[11] = on;
 }
 
 // Within-row occurrence rank: rank[i] = number of earlier samples with
