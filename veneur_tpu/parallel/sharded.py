@@ -500,9 +500,20 @@ class ShardedAggregator:
     def swap(self) -> dict:
         """Interval boundary: push any staged work, merge, and reset
         the partial state for the next interval (the double-buffer
-        swap the single-chip table does at flush, worker.go:498)."""
+        swap the single-chip table does at flush, worker.go:498).
+
+        The merge is FENCED before returning: its collectives must
+        finish while no other device program can be dispatched.  On
+        an oversubscribed host (virtual CPU mesh, or a shared-core
+        TPU host under ingest load) a partition of an in-flight
+        collective can starve past XLA's 40s rendezvous termination
+        — which aborts the whole process — if later-dispatched
+        programs compete for the executor pool.  One synchronous
+        point per flush interval costs ~nothing next to what it
+        rules out."""
         self.step()
         merged = self._merge(self.state)
+        jax.block_until_ready(merged)
         self.state = empty_state(self.mesh, self.cfg)
         return merged
 
