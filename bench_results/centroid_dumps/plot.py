@@ -1,0 +1,111 @@
+"""Plot the per-centroid error dumps — the analog of the reference's
+tdigest/analysis/plots.r over the CSVs `bench.py --accuracy
+--dump-centroids` writes here.
+
+Offline tool, not part of the suite:
+
+    python bench_results/centroid_dumps/plot.py [outdir]
+
+Produces, per distribution:
+- centroid_error_<dist>.png: |est_cdf - real_cdf| per centroid vs its
+  estimated CDF position (the reference's centroid-error view: error
+  should pinch at the tails, bulge at the median)
+- quantile_error_<dist>.png: relative quantile error across the 1001-
+  point sweep
+- sizes_<dist>.png: centroid weight vs CDF position (the k-scale size
+  envelope)
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import sys
+from collections import defaultdict
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _rows(path):
+    with open(path, newline="") as f:
+        r = csv.DictReader(f)
+        yield from r
+
+
+def main() -> None:
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        print("matplotlib unavailable; CSVs are the artifact")
+        return
+    outdir = sys.argv[1] if len(sys.argv) > 1 else HERE
+    dists = sorted({f.split("centroid_errors_", 1)[1][:-4]
+                    for f in os.listdir(HERE)
+                    if f.startswith("centroid_errors_")})
+    for d in dists:
+        ce = list(_rows(os.path.join(HERE,
+                                     f"centroid_errors_{d}.csv")))
+        er = list(_rows(os.path.join(HERE, f"errors_{d}.csv")))
+        sz = list(_rows(os.path.join(HERE, f"sizes_{d}.csv")))
+
+        fig, ax = plt.subplots(figsize=(7, 4))
+        by_series = defaultdict(list)
+        for row in ce:
+            by_series[row["series"]].append(
+                (float(row["est_cdf"]),
+                 abs(float(row["est_cdf"]) - float(row["real_cdf"]))))
+        for s, pts in by_series.items():
+            pts.sort()
+            ax.plot([p[0] for p in pts], [p[1] for p in pts],
+                    lw=0.8, alpha=0.7)
+        ax.set_xlabel("estimated CDF position")
+        ax.set_ylabel("|est_cdf − real_cdf|")
+        ax.set_title(f"per-centroid CDF error — {d}")
+        fig.tight_layout()
+        fig.savefig(os.path.join(outdir, f"centroid_error_{d}.png"),
+                    dpi=120)
+        plt.close(fig)
+
+        fig, ax = plt.subplots(figsize=(7, 4))
+        by_series = defaultdict(list)
+        for row in er:
+            q = float(row["quantile"])
+            real = float(row["real_quantile"])
+            est = float(row["est_quantile"])
+            rel = abs(est - real) / max(abs(real), 1e-9)
+            by_series[row["series"]].append((q, rel))
+        for s, pts in by_series.items():
+            pts.sort()
+            ax.semilogy([p[0] for p in pts],
+                        [max(p[1], 1e-8) for p in pts],
+                        lw=0.8, alpha=0.7)
+        ax.set_xlabel("quantile")
+        ax.set_ylabel("relative error")
+        ax.set_title(f"quantile error sweep — {d}")
+        fig.tight_layout()
+        fig.savefig(os.path.join(outdir, f"quantile_error_{d}.png"),
+                    dpi=120)
+        plt.close(fig)
+
+        fig, ax = plt.subplots(figsize=(7, 4))
+        by_series = defaultdict(list)
+        for row in sz:
+            by_series[row["series"]].append(
+                (float(row["est_cdf"]), float(row["weight"])))
+        for s, pts in by_series.items():
+            pts.sort()
+            ax.plot([p[0] for p in pts], [p[1] for p in pts],
+                    lw=0.8, alpha=0.7)
+        ax.set_xlabel("CDF position")
+        ax.set_ylabel("centroid weight")
+        ax.set_title(f"centroid size envelope — {d}")
+        fig.tight_layout()
+        fig.savefig(os.path.join(outdir, f"sizes_{d}.png"), dpi=120)
+        plt.close(fig)
+        print(f"{d}: 3 plots")
+
+
+if __name__ == "__main__":
+    main()
