@@ -238,6 +238,12 @@ constexpr uint64_t kKeyScopeMult = 0xC2B2AE3D27D4EB4FULL;
 // other rarities.  Returns false on malformed.
 bool parse_value(const uint8_t* p, int64_t n, double* out) {
   if (n <= 0 || n > 64) return false;
+  if (n == 1) {  // ":1|c" style single-digit values dominate counters
+    const unsigned d = (unsigned)p[0] - '0';
+    if (d > 9) return false;
+    *out = (double)d;
+    return true;
+  }
   int64_t i = 0;
   bool neg = false;
   if (p[0] == '-') { neg = true; i = 1; }
@@ -320,9 +326,9 @@ struct LineParse {
   uint64_t key;
 };
 
-inline uint8_t parse_line_core(const uint8_t* buf, int64_t start,
-                               int64_t eol, const DelimMasks& dm,
-                               LineParse* o) {
+inline uint8_t parse_line_general(const uint8_t* buf, int64_t start,
+                                  int64_t eol, const DelimMasks& dm,
+                                  LineParse* o) {
   const uint8_t* line = buf + start;
   const int64_t n = eol - start;
 
@@ -435,6 +441,152 @@ inline uint8_t parse_line_core(const uint8_t* buf, int64_t start,
               ((uint64_t)sc * kKeyScopeMult)) + tagsum));
   o->tc = tc;
   return tc;
+}
+
+// ---- short-line fast path -------------------------------------------
+// Lines of <= 64 bytes (virtually all DogStatsD traffic) fit in ONE
+// 64-bit line-relative delimiter mask per plane: two funnel-shifted
+// word loads replace every next_bit call, and all field navigation is
+// register bit arithmetic (ctz + clear-lowest).  The general path
+// above stays the single source of truth for longer lines; the fuzz
+// agreement tests pin the two paths (and the pure-Python parser) to
+// identical outputs.
+
+inline uint64_t mask_below(int64_t x) {
+  return x >= 64 ? ~0ULL : ((1ULL << x) - 1);
+}
+
+// bits of plane m for line-relative positions [0, n), n <= 64
+inline uint64_t rel_mask(const uint64_t* m, int64_t nwords,
+                         int64_t start, int64_t n) {
+  const int64_t w = start >> 6;
+  const int s = (int)(start & 63);
+  uint64_t lo = m[w] >> s;
+  // w+1 >= nwords only when every position it would contribute lies
+  // past the buffer (and so past this line) — safe to skip
+  if (s && w + 1 < nwords) lo |= m[w + 1] << (64 - s);
+  return lo & mask_below(n);
+}
+
+inline uint8_t parse_line_fast(const uint8_t* buf, int64_t start,
+                               int64_t n, const DelimMasks& dm,
+                               LineParse* o) {
+  const uint8_t* line = buf + start;
+
+  // events / service checks -> slow path
+  if (n >= 3 && line[0] == '_') {
+    if (line[1] == 'e' && line[2] == '{') return T_EVENT;
+    if (n >= 4 && line[1] == 's' && line[2] == 'c' &&
+        line[3] == '|') return T_SERVICE_CHECK;
+  }
+
+  uint64_t mc = rel_mask(dm.colon, dm.nwords, start, n);
+  uint64_t mp = rel_mask(dm.pipe, dm.nwords, start, n);
+  if (!mc) return T_ERROR;
+  const int64_t ca = __builtin_ctzll(mc);
+  if (ca == 0) return T_ERROR;
+  if (!mp) return T_ERROR;
+  const int64_t pa = __builtin_ctzll(mp);
+  // a '|' before the colon means the first pipe-section has no
+  // name:value pair — reject as the reference does (parser.go:307)
+  if (pa < ca) return T_ERROR;
+  if (pa == ca + 1) return T_ERROR;
+  mp &= mp - 1;
+  const int64_t te = mp ? __builtin_ctzll(mp) : n;
+  const int64_t tlen = te - (pa + 1);
+  uint8_t tc;
+  const uint8_t t0 = tlen >= 1 ? line[pa + 1] : 0;
+  if (tlen == 1) {
+    switch (t0) {
+      case 'c': tc = T_COUNTER; break;
+      case 'g': tc = T_GAUGE; break;
+      case 'm': tc = T_TIMER; break;
+      case 'h': tc = T_HISTOGRAM; break;
+      case 'd': tc = T_HISTOGRAM; break;
+      case 's': tc = T_SET; break;
+      default: return T_ERROR;
+    }
+  } else if (tlen == 2 && t0 == 'm' && line[pa + 2] == 's') {
+    tc = T_TIMER;
+  } else {
+    return T_ERROR;
+  }
+
+  double rate = 1.0;
+  uint64_t tagsum = 0;
+  uint8_t sc = 0;
+  int64_t sec = te;
+  while (sec < n) {
+    // sec points at '|'; its bit is mp's lowest — pop it
+    const int64_t s0 = sec + 1;
+    if (s0 >= n) return T_ERROR;
+    mp &= mp - 1;
+    const int64_t s1 = mp ? __builtin_ctzll(mp) : n;
+    if (line[s0] == '@') {
+      if (!parse_value(line + s0 + 1, s1 - s0 - 1, &rate) ||
+          !(rate > 0.0 && rate <= 1.0)) {
+        return T_ERROR;
+      }
+    } else if (line[s0] == '#') {
+      // a later '#' section REPLACES tags and scope (last one wins)
+      tagsum = 0;
+      sc = 0;
+      uint64_t mt = rel_mask(dm.comma, dm.nwords, start, n) &
+                    mask_below(s1) & ~mask_below(s0 + 1);
+      int64_t t = s0 + 1;
+      while (t <= s1) {
+        const int64_t e = mt ? __builtin_ctzll(mt) : s1;
+        mt &= mt - 1;
+        const int64_t L = e - t;
+        if (L > 0) {
+          // scope magic tags: prefix match as the reference does
+          // (parser.go:397-407)
+          if (line[t] == 'v' && L >= 15 &&
+              memcmp(line + t, "veneurlocalonly", 15) == 0) {
+            sc = 1;
+          } else if (line[t] == 'v' && L >= 16 &&
+                     memcmp(line + t, "veneurglobalonly", 16) == 0) {
+            sc = 2;
+          } else {
+            tagsum += fmix64(fold64(line + t, (size_t)L));
+          }
+        }
+        t = e + 1;
+      }
+    } else {
+      return T_ERROR;
+    }
+    sec = s1;
+  }
+  if (tc == T_GAUGE && rate != 1.0) return T_ERROR;
+
+  const int64_t vlen = pa - (ca + 1);
+  if (tc == T_SET) {
+    o->member = fmix64(fnv1a64(kFnvOffset, line + ca + 1, vlen));
+  } else {
+    double v;
+    if (!parse_value(line + ca + 1, vlen, &v) ||
+        !std::isfinite(v)) {
+      return T_ERROR;
+    }
+    o->value = v;
+  }
+  o->weight = (float)(1.0 / rate);
+  o->scope = sc;
+  o->key = fmix64(
+      fold64(line, (size_t)ca) ^
+      fmix64((((uint64_t)tc * kKeyTypeMult) ^
+              ((uint64_t)sc * kKeyScopeMult)) + tagsum));
+  o->tc = tc;
+  return tc;
+}
+
+inline uint8_t parse_line_core(const uint8_t* buf, int64_t start,
+                               int64_t eol, const DelimMasks& dm,
+                               LineParse* o) {
+  const int64_t n = eol - start;
+  if (n <= 64) return parse_line_fast(buf, start, n, dm, o);
+  return parse_line_general(buf, start, eol, dm, o);
 }
 
 int64_t vtpu_parse_batch(
