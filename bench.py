@@ -1312,7 +1312,12 @@ def soak_bench() -> dict:
                  "duration_seconds": duration,
                  "interval_seconds": interval_s,
                  "offered_samples": sent_box[0],
-                 "samples": samples}
+                 "samples": samples,
+                 # per-stage flush timings over the run's retained
+                 # cycles (observe ring): attributes an interval-time
+                 # regression to a STAGE, plus steady-state compile
+                 # count (nonzero after warmup = shape drift)
+                 "flush_stages": srv.flush_ring.stage_summary()}
     if len(samples) >= 4:
         half = samples[len(samples) // 2:]
         ts = np.asarray([s["t"] for s in half])
@@ -1605,6 +1610,9 @@ def chain_bench() -> dict:
         proxy.shutdown()
         g.shutdown()
 
+    # per-stage timings from the local's flush ring — the traced half
+    # of the chain; readback + forward dominate here by design
+    out["flush_stages"] = local.flush_ring.stage_summary()
     out.update(_backend_info())
     out["captured_unix"] = round(time.time(), 1)
     _save_artifact("chain_bench", out)

@@ -11,6 +11,12 @@ import os
 # setdefault): the dev environment presets JAX_PLATFORMS to the real TPU
 # backend, but the suite needs the virtual 8-device CPU topology.
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The device-cost registry's cost_analysis() pays a SECOND compile per
+# new jit variant (observe/devicecost.py); across a suite that builds
+# many shape buckets that doubles compile time for no assertion value
+# (no test reads the flops estimates).  Respect an explicit override.
+os.environ.setdefault("VENEUR_TPU_COST_ANALYSIS", "0")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

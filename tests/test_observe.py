@@ -1,0 +1,231 @@
+"""Flush self-tracing + device-cost accounting (veneur_tpu/observe).
+
+Covers the observability acceptance surface: the per-flush SSF span
+tree delivered through the server's own trace client, the
+/debug/flushes ring records, the device-cost registry's compile
+detection (and its steady-state flatness — the property the
+``veneur.xla.compile_total`` metric exists to alarm on), and the two
+telemetry fixes (current-RSS gauge, stats_address config error).
+"""
+
+import socket
+import time
+import types
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from veneur_tpu import observe
+from veneur_tpu.core.config import read_config
+from veneur_tpu.core.server import Server
+from veneur_tpu.core.telemetry import Telemetry, _rss_bytes
+from veneur_tpu.observe.devicecost import DeviceCostRegistry
+from veneur_tpu.observe.flushring import FlushRecord, FlushRing
+from veneur_tpu.sinks.simple import CaptureSink
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+STAGES = ("snapshot", "device_dispatch", "readback_sync",
+          "host_emit", "sink_flush")
+
+
+# ---------------------------------------------------------------------
+# flush span tree
+
+def test_flush_cycle_emits_stage_span_tree():
+    """One flush -> a root ``flush`` span with one ``flush.<stage>``
+    child per pipeline stage, all in one trace, delivered to span
+    sinks through the server's own loopback trace client."""
+    cap = CaptureSink()
+    srv = Server(read_config(data={
+        "statsd_listen_addresses": [], "interval": "10s",
+        "hostname": "trace-host"}), extra_span_sinks=[cap])
+    srv.start()
+    try:
+        srv.handle_packet(b"obs.hits:3|c")
+        srv.handle_packet(b"obs.lat:12|ms")
+        srv.handle_packet(b"obs.users:u1|s")
+        srv.flush_once()
+        want = {"flush"} | {f"flush.{s}" for s in STAGES}
+        assert _wait(lambda: want <=
+                     {sp.name for sp in cap.spans}), \
+            sorted({sp.name for sp in cap.spans})
+        by_name = {sp.name: sp for sp in cap.spans}
+        root = by_name["flush"]
+        assert root.parent_id == 0
+        assert root.service == "veneur"
+        assert root.tags["flush.seq"] == "1"
+        for stage in STAGES:
+            sp = by_name[f"flush.{stage}"]
+            # every stage hangs off the root, in the root's trace
+            assert sp.parent_id == root.id
+            assert sp.trace_id == root.trace_id
+            assert sp.tags["stage"] == stage
+            assert sp.end_timestamp >= sp.start_timestamp
+        # >=5 distinct stage spans is the acceptance bar
+        assert len(STAGES) >= 5
+    finally:
+        srv.shutdown()
+
+
+def test_flush_ring_record_matches_cycle():
+    srv = Server(read_config(data={
+        "statsd_listen_addresses": [], "interval": "10s",
+        "hostname": "ring-host"}))
+    srv.start()
+    try:
+        srv.handle_packet(b"ring.hits:3|c")
+        srv.handle_packet(b"ring.lat:12|ms")
+        srv.flush_once()
+        srv.flush_once()
+        recs = srv.flush_ring.records()
+        assert [r.seq for r in recs] == [1, 2]
+        for rec in recs:
+            assert set(rec.stages) >= set(STAGES)
+            assert all(ns >= 0 for ns in rec.stages.values())
+            # stages are disjoint intervals inside the cycle
+            assert sum(rec.stages.values()) <= rec.duration_ns
+            assert rec.error == ""
+        # the interval that carried the metrics read them back
+        assert recs[0].readback_bytes > 0
+        assert recs[0].tally["counters"] == 1
+        assert recs[0].tally["histograms"] == 1
+        assert recs[0].metrics_emitted > 0
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------
+# compile stability (tier-1 acceptance criterion)
+
+def test_flush_jits_do_not_recompile_for_stable_shapes():
+    """Steady state: after warmup, consecutive same-shape flushes must
+    not add a single compile — a moving ``veneur.xla.compile_total``
+    on a stable workload is the shape-drift bug the registry exists
+    to catch.  ``stats_address`` points at a throwaway UDP port so
+    self-telemetry leaves the table alone (loopback injection would
+    legitimately change touched-row counts between intervals)."""
+    sink = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sink.bind(("127.0.0.1", 0))
+    srv = Server(read_config(data={
+        "statsd_listen_addresses": [], "interval": "10s",
+        "hostname": "jit-host",
+        "stats_address": f"127.0.0.1:{sink.getsockname()[1]}"}))
+    srv.start()
+    try:
+        packets = (b"stable.hits:3|c", b"stable.temp:7|g",
+                   b"stable.lat:12|ms", b"stable.users:u1|s")
+
+        def one_flush():
+            for p in packets:
+                srv.handle_packet(p)
+            srv.flush_once()
+
+        for _ in range(2):  # warmup: every shape bucket compiles here
+            one_flush()
+        before = observe.REGISTRY.totals()["compile_total"]
+        for _ in range(3):
+            one_flush()
+        after = observe.REGISTRY.totals()["compile_total"]
+        assert after == before, (
+            f"{after - before} recompile(s) across 3 same-shape "
+            f"flushes: {observe.REGISTRY.snapshot()['kernels']}")
+        # the ring records the same fact per cycle
+        assert all(r.compiles == 0
+                   for r in srv.flush_ring.records()[-3:])
+    finally:
+        srv.shutdown()
+        sink.close()
+
+
+# ---------------------------------------------------------------------
+# device-cost registry
+
+def test_instrumented_jit_counts_compiles_per_shape():
+    reg = DeviceCostRegistry()
+    fn = observe.instrument(
+        "t.double", jax.jit(lambda x: x * 2), registry=reg)
+    a = jnp.arange(8, dtype=jnp.float32)
+    fn(a)
+    fn(a)          # cache hit
+    fn(a[:4])      # new shape -> new variant
+    snap = reg.snapshot()["kernels"]["t.double"]
+    assert snap["calls"] == 3
+    assert snap["compiles"] == 2
+    assert snap["compile_duration_ns"] > 0
+    assert snap["dispatch_duration_ns"] >= snap["compile_duration_ns"]
+    totals = reg.totals()
+    assert totals["compile_total"] == 2
+    assert totals["readback_bytes_total"] == 0
+
+
+def test_instrumented_jit_forwards_wrapped_attrs():
+    reg = DeviceCostRegistry()
+    fn = observe.instrument(
+        "t.fwd", jax.jit(lambda x: x + 1), registry=reg)
+    a = jnp.zeros(4)
+    fn(a)
+    # lower() must reach the real jit (devicecost uses it for
+    # cost_analysis); _cache_size is the compile detector
+    assert fn.lower(a) is not None
+    assert fn._cache_size() >= 1
+
+
+def test_null_cycle_readback_still_counts():
+    before = observe.REGISTRY.totals()["readback_bytes_total"]
+    observe.NULL_CYCLE.add_readback(123)
+    assert observe.REGISTRY.totals()["readback_bytes_total"] == \
+        before + 123
+
+
+def test_flush_ring_bounded_and_summarized():
+    ring = FlushRing(capacity=4)
+    for _ in range(6):
+        rec = FlushRecord(seq=ring.next_seq())
+        rec.stages["host_emit"] = 100 * rec.seq
+        rec.readback_bytes = 10
+        ring.append(rec)
+    recs = ring.records()
+    assert [r.seq for r in recs] == [3, 4, 5, 6]  # oldest evicted
+    summ = ring.stage_summary()
+    assert summ["cycles"] == 4
+    assert summ["stages_ns"]["host_emit"] == {
+        "mean": 450, "max": 600, "last": 600, "count": 4}
+    assert summ["readback_bytes_mean"] == 10
+
+
+# ---------------------------------------------------------------------
+# telemetry fixes
+
+def test_rss_bytes_is_current_not_peak():
+    rss = _rss_bytes()
+    assert isinstance(rss, int)
+    assert 0 < rss < 1 << 42  # a real, sane byte count
+
+
+def _stub(addr):
+    return types.SimpleNamespace(
+        config=types.SimpleNamespace(stats_address=addr))
+
+
+@pytest.mark.parametrize("addr", ["localhost", "127.0.0.1",
+                                  "host:notaport"])
+def test_stats_address_without_port_is_config_error(addr):
+    with pytest.raises(ValueError, match="stats_address"):
+        Telemetry(_stub(addr))
+
+
+@pytest.mark.parametrize("addr", ["127.0.0.1:8125",
+                                  "udp://127.0.0.1:8125"])
+def test_stats_address_accepted_forms(addr):
+    t = Telemetry(_stub(addr))
+    assert t._addr == ("127.0.0.1", 8125)

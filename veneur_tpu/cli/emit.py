@@ -250,7 +250,12 @@ def _parse_when(text: str) -> int:
 
 
 def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(prog="veneur-emit")
+    # allow_abbrev=False: Go's flag package (the reference CLI) has no
+    # prefix matching, and abbreviation makes argparse reject a
+    # -command child arg like ``-c`` as "ambiguous" before REMAINDER
+    # can consume it
+    ap = argparse.ArgumentParser(prog="veneur-emit",
+                                 allow_abbrev=False)
     ap.add_argument("-hostport", required=True)
     ap.add_argument("-mode", default="metric",
                     choices=["metric", "event", "sc"],
@@ -308,7 +313,19 @@ def main(argv=None) -> int:
     ap.add_argument("-sc_hostname", default="")
     ap.add_argument("-sc_tags", default="")
     ap.add_argument("-sc_msg", default="")
+    # split the child command off BEFORE argparse sees it: even with
+    # allow_abbrev=False, 3.10's argparse prefix-matches single-dash
+    # options (bpo-39775), so a child arg like ``-c`` dies as
+    # "ambiguous" before REMAINDER can claim it
+    argv = list(sys.argv[1:] if argv is None else argv)
+    command_tail: list[str] = []
+    if "-command" in argv:
+        i = argv.index("-command")
+        command_tail = argv[i + 1:]
+        argv = argv[:i]
     args = ap.parse_args(argv)
+    if command_tail:
+        args.command = command_tail
 
     if args.debug:
         import logging

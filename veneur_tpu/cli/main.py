@@ -55,6 +55,12 @@ def main(argv=None) -> int:
         "serving: statsd=%s http=%s role=%s interval=%ss",
         cfg.statsd_listen_addresses, cfg.http_address,
         "local" if cfg.is_local() else "global", cfg.interval_seconds())
+    if server.http_port:
+        logging.getLogger("veneur_tpu").info(
+            "introspection on :%d — /debug/flushes (flush ring), "
+            "/debug/vars (stats + device costs), /debug/pprof/device"
+            "?seconds=N (jax profiler); see docs/observability.md",
+            server.http_port)
     stop.wait()
     server.shutdown()
     return 0

@@ -1,4 +1,4 @@
-"""Shared /debug/pprof + identity HTTP handlers.
+"""Shared /debug/* introspection HTTP handlers.
 
 The reference wires the same net/http/pprof surface onto BOTH the
 server's and the proxy's HTTP listeners (server: server.go Handler();
@@ -11,15 +11,22 @@ identity endpoints), so the Python equivalents live here once:
   (``?start=1``/``?stop=1`` toggle tracing — per-allocation overhead
   must be opt-in and revocable on a long-running process)
 - ``/debug/pprof/profile[?seconds=N]``: cProfile sample
+- ``/debug/pprof/device[?seconds=N]``: on-demand jax.profiler xplane
+  capture (the TPU-side profile net/http/pprof never had); the
+  response lists the artifact files to load into tensorboard/xprof
+- ``/debug/vars``: expvar-style JSON dump (stats dict + device-cost
+  registry), via ``vars_dump``
 
 Handlers are BaseHTTPRequestHandler methods; callers pass the request
 handler plus a per-process lock serializing the profiler (only one
-can be enabled per interpreter).
+can be enabled per interpreter — cProfile, the jax profiler, and
+``enable_profiling`` all contend for it).
 """
 
 from __future__ import annotations
 
 import io
+import json
 import threading
 import time
 
@@ -31,6 +38,24 @@ def respond_ok(handler, body: bytes = b"ok",
     handler.send_header("Content-Length", str(len(body)))
     handler.end_headers()
     handler.wfile.write(body)
+
+
+def vars_dump(handler, sources: dict) -> None:
+    """expvar's role (/debug/vars): one JSON object of live process
+    state.  ``sources`` maps section name -> already-snapshotted
+    plain data."""
+    respond_ok(handler,
+               json.dumps(sources, indent=1, default=str).encode(),
+               "application/json")
+
+
+def _query_seconds(query: str, default: float) -> float:
+    if "seconds=" in query:
+        try:
+            return float(query.split("seconds=")[1].split("&")[0])
+        except ValueError:
+            pass
+    return default
 
 
 def pprof(handler, lock: threading.Lock) -> None:
@@ -66,16 +91,27 @@ def pprof(handler, lock: threading.Lock) -> None:
             top = snap.statistics("lineno")[:50]
             respond_ok(handler,
                        "\n".join(str(s) for s in top).encode())
+    elif part == "device":
+        # on-demand jax profiler capture (observe/profiler.py); same
+        # serialization as /profile — one profiling tool per process
+        from veneur_tpu.observe import capture_device_profile
+        seconds = _query_seconds(query, 2.0)
+        if not lock.acquire(blocking=False):
+            handler.send_error(503, "profiling already in progress")
+            return
+        try:
+            result = capture_device_profile(seconds)
+        except Exception as e:
+            handler.send_error(500, f"device profile failed: {e}")
+            return
+        finally:
+            lock.release()
+        respond_ok(handler, json.dumps(result, indent=1).encode(),
+                   "application/json")
     elif part == "profile":
         import cProfile
         import pstats
-        seconds = 2.0
-        if "seconds=" in query:
-            try:
-                seconds = float(
-                    query.split("seconds=")[1].split("&")[0])
-            except ValueError:
-                pass
+        seconds = _query_seconds(query, 2.0)
         # only one profiler can be active per process (concurrent
         # requests or enable_profiling would raise): serialize, and
         # 503 on any other active profiling tool

@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from veneur_tpu import observe
 from veneur_tpu.core import metrics as im
 from veneur_tpu.core.table import RowMeta, Snapshot
 from veneur_tpu.ops import hll, segment, tdigest
@@ -45,12 +46,13 @@ DEFAULT_AGGREGATES = ("min", "max", "count")
 DEFAULT_PERCENTILES = (0.5, 0.75, 0.99)
 
 
-@jax.jit
-def _combine_stats(stats, imp):
+def _combine_stats_fn(stats, imp):
     """Device-side combine of the local-sample and imported stat
     planes (weight/sum/rsum add, min min, max max), so the host does
     one batched readback instead of ping-ponging stats -> host ->
-    device (each leg pays the tunnel's latency)."""
+    device (each leg pays the tunnel's latency).  Kept as a plain
+    function so the fused readout kernels inline it; the instrumented
+    ``_combine_stats`` below is the host-level entry point."""
     return jnp.stack([
         stats[:, segment.STAT_WEIGHT] + imp[:, segment.STAT_WEIGHT],
         jnp.minimum(stats[:, segment.STAT_MIN], imp[:, segment.STAT_MIN]),
@@ -60,14 +62,18 @@ def _combine_stats(stats, imp):
     ], axis=1)
 
 
+_combine_stats = observe.instrument("flusher.combine_stats",
+                                    jax.jit(_combine_stats_fn))
+
+
 @partial(jax.jit, static_argnames=("method",))
-def _histo_readout(stats, imp, means, weights, qs, method="interp"):
+def _histo_readout_jit(stats, imp, means, weights, qs, method="interp"):
     """_combine_stats plus the per-row quantile kernel in one
     dispatch — used only when someone will actually emit quantiles
     (the batched sort over every digest row is not free).  ``method``
     selects the interpolation (see ops/tdigest.quantile): "interp"
     (default, singleton-exact) or "reference" (Go-identical)."""
-    comb = _combine_stats(stats, imp)
+    comb = _combine_stats_fn(stats, imp)
     qfn = (tdigest._quantile if method == "reference"
            else tdigest._quantile_interp)
     qvals = qfn(means, weights, qs,
@@ -76,14 +82,18 @@ def _histo_readout(stats, imp, means, weights, qs, method="interp"):
     return comb, qvals
 
 
+_histo_readout = observe.instrument("flusher.histo_readout",
+                                    _histo_readout_jit)
+
+
 @partial(jax.jit, static_argnames=("method",))
-def _histo_readout_rows(stats, imp, means, weights, qs, idx,
-                        method="interp"):
+def _histo_readout_rows_jit(stats, imp, means, weights, qs, idx,
+                            method="interp"):
     """_histo_readout restricted to a padded row-index slice: both the
     readback bytes and the quantile kernel's batched sort scale with
     the touched-row count instead of the table capacity."""
     st = stats[idx]
-    comb = _combine_stats(st, imp[idx])
+    comb = _combine_stats_fn(st, imp[idx])
     qfn = (tdigest._quantile if method == "reference"
            else tdigest._quantile_interp)
     qvals = qfn(means[idx], weights[idx], qs,
@@ -92,12 +102,20 @@ def _histo_readout_rows(stats, imp, means, weights, qs, idx,
     return st, comb, qvals
 
 
+_histo_readout_rows = observe.instrument("flusher.histo_readout_rows",
+                                         _histo_readout_rows_jit)
+
+
 @jax.jit
-def _gather_rows(plane, idx):
+def _gather_rows_jit(plane, idx):
     """Compact selected rows on device before readback — d2h over the
     tunnel is ~10 MB/s, so reading a full register/centroid plane to
     forward a handful of touched rows would dominate the flush."""
     return plane[idx]
+
+
+_gather_rows = observe.instrument("flusher.gather_rows",
+                                  _gather_rows_jit)
 
 
 def _pad_idx(rows: list[int]) -> tuple[jnp.ndarray, int]:
@@ -159,24 +177,55 @@ class Flusher:
 
     # ------------------------------------------------------------------
 
-    def flush(self, snap: Snapshot, now: int | None = None) -> FlushResult:
+    def flush(self, snap: Snapshot, now: int | None = None,
+              cycle=None) -> FlushResult:
+        """``cycle`` is an observe.FlushCycle (or the NULL_CYCLE
+        default): stage spans and readback accounting for the three
+        phases this method owns — device dispatch, readback sync,
+        host emit."""
+        if cycle is None:
+            cycle = observe.NULL_CYCLE
         ts = int(now if now is not None else time.time())
         res = FlushResult()
-        pre = self._prefetch(snap)
-        self._flush_counters(snap, ts, res, pre)
-        self._flush_gauges(snap, ts, res, pre)
-        self._flush_histos(snap, ts, res, pre)
-        self._flush_sets(snap, ts, res, pre)
+        pre = self._prefetch(snap, cycle)
+        with cycle.stage("host_emit"):
+            self._flush_counters(snap, ts, res, pre)
+            self._flush_gauges(snap, ts, res, pre)
+            self._flush_histos(snap, ts, res, pre)
+            self._flush_sets(snap, ts, res, pre)
         res.tally["overflow"] = sum(snap.overflow.values())
         return res
 
     # ------------------------------------------------------------------
 
-    def _prefetch(self, snap: Snapshot) -> dict:
+    def _prefetch(self, snap: Snapshot, cycle=observe.NULL_CYCLE) -> dict:
         """Launch every device computation the flush needs, then pull
         all results to host in ONE pipelined jax.device_get — over the
         tunnel each separate synchronous readback pays ~90ms latency,
-        but async copies overlap to a single latency."""
+        but async copies overlap to a single latency.
+
+        Two traced stages: ``device_dispatch`` covers the async kernel
+        launches (dispatch wall time only), ``readback_sync`` covers
+        the blocking device_get plus host re-scatter — the stage whose
+        span duration IS the d2h cost an operator wants attributed."""
+        with cycle.stage("device_dispatch") as sp:
+            devs, pre, expand = self._dispatch(snap)
+            sp.add_tag("device_arrays", str(len(devs)))
+        with cycle.stage("readback_sync") as sp:
+            got = jax.device_get(devs)
+            nbytes = int(sum(getattr(v, "nbytes", 0)
+                             for v in got.values()))
+            cycle.add_readback(nbytes)
+            sp.add_tag("readback_bytes", str(nbytes))
+            pre.update(got)
+            for dev_key, out_key, rows, shape in expand:
+                out = pre.pop(dev_key)
+                full = np.zeros(shape, out.dtype)
+                full[rows] = out[:len(rows)]
+                pre[out_key] = full
+        return pre
+
+    def _dispatch(self, snap: Snapshot) -> tuple[dict, dict, list]:
         devs: dict = {}
         pre: dict = {}
         expand: list = []  # (dev_key, out_key, rows, full shape)
@@ -301,13 +350,7 @@ class Flusher:
                     devs["fwd_regs"] = _gather_rows(regs, idx)
                 if need_est:
                     devs["ests"] = hll.estimate(regs)
-        pre.update(jax.device_get(devs))
-        for dev_key, out_key, rows, shape in expand:
-            got = pre.pop(dev_key)
-            full = np.zeros(shape, got.dtype)
-            full[rows] = got[:len(rows)]
-            pre[out_key] = full
-        return pre
+        return devs, pre, expand
 
     # ------------------------------------------------------------------
 

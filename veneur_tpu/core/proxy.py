@@ -180,6 +180,21 @@ class ProxyServer:
                     debughttp.respond_ok(self, b"dev")
                 elif self.path.startswith("/debug/pprof"):
                     debughttp.pprof(self, proxy._pprof_lock)
+                elif self.path.startswith("/debug/vars"):
+                    # same expvar surface as the server's listener;
+                    # the proxy has no flush ring, but its routing
+                    # stats and any device-cost counters (none in a
+                    # pure-proxy process) dump identically
+                    from veneur_tpu import observe
+                    with proxy._stats_lock:
+                        stats = dict(proxy.stats)
+                    debughttp.vars_dump(self, {
+                        "version": __version__,
+                        "stats": stats,
+                        "devicecost": observe.REGISTRY.snapshot(),
+                        "destinations": len(proxy.ring.ring)
+                        if proxy.ring is not None else 0,
+                    })
                 else:
                     self.send_error(404)
 

@@ -26,6 +26,7 @@ import threading
 import time
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as _FuturesTimeout
 
 from veneur_tpu import __version__
 from veneur_tpu.core import metrics as im
@@ -205,6 +206,17 @@ class Server:
         self.trace_client = vtrace.Client(
             vtrace.ChannelBackend(self.span_worker.submit),
             capacity=256)
+        # flush self-observation: every cycle leaves a span tree in
+        # the span pipeline (via the loopback client above) and a
+        # record in the ring served at /debug/flushes; device-cost
+        # counters live in the process-global registry the flusher
+        # and table kernels are instrumented against
+        from veneur_tpu import observe
+        self.device_costs = observe.REGISTRY
+        self.flush_ring = observe.FlushRing()
+        self.flush_tracer = observe.FlushTracer(
+            self.trace_client, self.flush_ring,
+            registry=self.device_costs)
 
         self.events: list[dsd.Event] = []
         self.checks: list[dsd.ServiceCheck] = []
@@ -1022,6 +1034,27 @@ class Server:
                     # the role of net/http/pprof (reference
                     # http.go:52-57): live profiling without restart
                     self._pprof()
+                elif self.path.startswith("/debug/flushes"):
+                    from veneur_tpu.core import debughttp
+                    debughttp.respond_ok(
+                        self, server.flush_ring.to_json(),
+                        "application/json")
+                elif self.path.startswith("/debug/vars"):
+                    from veneur_tpu.core import debughttp
+                    with server._stats_lock:
+                        stats = dict(server.stats)
+                    debughttp.vars_dump(self, {
+                        "version": __version__,
+                        "stats": stats,
+                        "devicecost": server.device_costs.snapshot(),
+                        "trace_client": {
+                            "sent": server.trace_client.sent,
+                            "dropped": server.trace_client.dropped,
+                            "errors": server.trace_client.errors,
+                        },
+                        "last_flush_age_s": round(
+                            time.monotonic() - server.last_flush, 3),
+                    })
                 elif (self.path == "/quitquitquit" and
                       server.config.http_quit):
                     # graceful shutdown endpoint (reference
@@ -1140,17 +1173,25 @@ class Server:
             return FlushResult()
         t_flush0 = time.monotonic_ns()
         # self-trace the flush through the loopback client (reference
-        # flusher.go:29 StartSpan("flush")); the span re-enters the
-        # span pipeline and ssfmetrics extraction next interval
-        from veneur_tpu.trace import spans as _tspans
-        _flush_span = _tspans.Span("flush", service="veneur")
-        with self.lock:
-            snap = self.table.swap()
-            events = self.events
-            checks = self.checks
-            self.events, self.checks = [], []
-            status = self.table.take_status()
-        res = self.flusher.flush(snap)
+        # flusher.go:29 StartSpan("flush")): the cycle's root span plus
+        # one child per stage re-enter the span pipeline (and
+        # ssfmetrics extraction) next interval, and the cycle record
+        # lands in the /debug/flushes ring
+        with self.flush_tracer.cycle() as cyc:
+            res = self._flush_stages(cyc, t_flush0)
+        return res
+
+    def _flush_stages(self, cyc, t_flush0: int) -> FlushResult:
+        with cyc.stage("snapshot"):
+            with self.lock:
+                snap = self.table.swap()
+                events = self.events
+                checks = self.checks
+                self.events, self.checks = [], []
+                status = self.table.take_status()
+        # device_dispatch / readback_sync / host_emit stages happen
+        # inside the flusher, against the same cycle
+        res = self.flusher.flush(snap, cycle=cyc)
         # the interval's reads are done (forward rows hold copies);
         # recycle the host set plane into the table's reuse pool
         snap.release()
@@ -1185,45 +1226,59 @@ class Server:
             self._flush_pending[key] = fut
             futures.append(fut)
 
-        for sink in self.metric_sinks:
-            batch = sinks_base.route(res.metrics, sink.name, sink
-                                     if isinstance(sink,
-                                                   sinks_base.SinkBase)
-                                     else None)
-            submit(f"sink:{sink.name}", self._safe_sink_flush, sink,
-                   batch, events + checks)
-        for plugin in self.plugins:
-            submit(f"plugin:{plugin.name}", plugin.flush,
-                   list(res.metrics), self.flusher.hostname)
-        if self.is_local and res.forward:
-            submit("forward", self._forward, res.forward)
-        submit("spans", self.span_worker.flush)
-        # Wait for sink/forward/span tasks only within the interval
-        # budget — the reference gives each flush a ctx deadline of one
-        # interval (server.go:1022-1026) so a slow sink or a wedged
-        # global can never delay the next tick.  Overrunning tasks keep
-        # running on the pool and are counted, not cancelled.
-        deadline = t_flush0 / 1e9 + self.interval * 0.9
-        for f in futures:
-            try:
-                f.result(timeout=max(0.0,
-                                     deadline - time.monotonic()))
-            except TimeoutError:
-                self.bump("flush_slow_tasks")
-                log.warning("flush task overran the interval budget; "
-                            "continuing without it")
-            except Exception:
-                self.bump("flush_errors")
-                log.exception("flush task failed")
+        def traced_forward(rows):
+            # runs on the pool; the forward stage span hangs off the
+            # same cycle root (stage timing is lock-guarded)
+            with cyc.stage("forward") as sp:
+                sp.add_tag("rows", str(len(rows)))
+                self._forward(rows)
+
+        with cyc.stage("sink_flush"):
+            for sink in self.metric_sinks:
+                batch = sinks_base.route(
+                    res.metrics, sink.name, sink
+                    if isinstance(sink, sinks_base.SinkBase) else None)
+                submit(f"sink:{sink.name}", self._safe_sink_flush,
+                       sink, batch, events + checks)
+            for plugin in self.plugins:
+                submit(f"plugin:{plugin.name}", plugin.flush,
+                       list(res.metrics), self.flusher.hostname)
+            if self.is_local and res.forward:
+                submit("forward", traced_forward, res.forward)
+            submit("spans", self.span_worker.flush)
+            # Wait for sink/forward/span tasks only within the interval
+            # budget — the reference gives each flush a ctx deadline of
+            # one interval (server.go:1022-1026) so a slow sink or a
+            # wedged global can never delay the next tick.  Overrunning
+            # tasks keep running on the pool and are counted, not
+            # cancelled.
+            deadline = t_flush0 / 1e9 + self.interval * 0.9
+            for f in futures:
+                try:
+                    f.result(timeout=max(0.0,
+                                         deadline - time.monotonic()))
+                # futures.TimeoutError only aliases the builtin from
+                # 3.11; on 3.10 catching the builtin alone silently
+                # misfiles every budget overrun as a flush ERROR
+                except (TimeoutError, _FuturesTimeout):
+                    self.bump("flush_slow_tasks")
+                    log.warning("flush task overran the interval "
+                                "budget; continuing without it")
+                except Exception:
+                    self.bump("flush_errors")
+                    log.exception("flush task failed")
         with self._stats_lock:
             sink_durs = dict(self._sink_durations)
             self._sink_durations.clear()
+        cyc.record.metrics_emitted = len(res.metrics)
+        cyc.record.forward_rows = len(res.forward)
+        cyc.record.tally = dict(res.tally)
         try:
             self.telemetry.flush_tick(
-                res.tally, time.monotonic_ns() - t_flush0, sink_durs)
+                res.tally, time.monotonic_ns() - t_flush0, sink_durs,
+                record=cyc.record)
         except Exception:
             log.exception("self-telemetry emission failed")
-        _flush_span.finish(self.trace_client)
         return res
 
     def _safe_sink_flush(self, sink, batch, other) -> None:

@@ -118,7 +118,13 @@ class SpanWorker:
                     self._stats_cb("span_sink_errors")
                     log.exception("span sink %s ingest failed",
                                   sink.name)
-            self._stats_cb("spans_processed")
+            # the server's own flush-trace spans ride the same worker
+            # (observe/tracer.py) but must not inflate the USER span
+            # throughput counter operators alert on
+            if span.tags.get("veneur.internal") == "true":
+                self._stats_cb("self_spans_processed")
+            else:
+                self._stats_cb("spans_processed")
 
     def _task_done(self, i: int) -> None:
         with self._pending_lock:

@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from veneur_tpu import native
+from veneur_tpu import native, observe
 from veneur_tpu.ops import hll, segment, tdigest
 from veneur_tpu.protocol import columnar, dogstatsd as dsd
 from veneur_tpu.utils import hashing, intern, jitopts
@@ -48,19 +48,39 @@ from veneur_tpu.utils import hashing, intern, jitopts
 # host-precombined dense vectors (np.bincount / last-write collapse):
 # over the tunnel-attached TPU the h2d link is the bottleneck, so a
 # batch ships as R floats instead of 12 bytes/sample.
-_counter_dense_step = jax.jit(segment.counter_dense_update,
-                              donate_argnums=jitopts.donate(0))
-_gauge_dense_step = jax.jit(segment.gauge_dense_update, donate_argnums=jitopts.donate(0))
-_hll_step_packed = jax.jit(hll.insert_packed, donate_argnums=jitopts.donate(0))
-_hll_union_plane = jax.jit(hll.union, donate_argnums=jitopts.donate(0))
+# All are registered with the device-cost registry: steady-state
+# ingest must never recompile (a moving veneur.xla.compile_total is a
+# shape-drift bug), and the per-kernel dispatch/flops numbers feed
+# /debug/vars.
+_counter_dense_step = observe.instrument(
+    "table.counter_dense",
+    jax.jit(segment.counter_dense_update,
+            donate_argnums=jitopts.donate(0)))
+_gauge_dense_step = observe.instrument(
+    "table.gauge_dense",
+    jax.jit(segment.gauge_dense_update,
+            donate_argnums=jitopts.donate(0)))
+_hll_step_packed = observe.instrument(
+    "table.hll_insert_packed",
+    jax.jit(hll.insert_packed, donate_argnums=jitopts.donate(0)))
+_hll_union_plane = observe.instrument(
+    "table.hll_union",
+    jax.jit(hll.union, donate_argnums=jitopts.donate(0)))
 # global-tier merge steps (forwarded partial state; duplicates within a
 # batch reduce correctly because every column is an associative scatter)
-_histo_stats_merge = jax.jit(segment.merge_histo_stats, donate_argnums=jitopts.donate(0))
-_hll_merge_rows = jax.jit(hll.merge_rows, donate_argnums=jitopts.donate(0))
+_histo_stats_merge = observe.instrument(
+    "table.histo_stats_merge",
+    jax.jit(segment.merge_histo_stats,
+            donate_argnums=jitopts.donate(0)))
+_hll_merge_rows = observe.instrument(
+    "table.hll_merge_rows",
+    jax.jit(hll.merge_rows, donate_argnums=jitopts.donate(0)))
 # elementwise fold of host-computed per-row batch aggregates (see
 # _host_stats_fold); identity-filled untouched rows need no mask
-_histo_stats_fold = jax.jit(tdigest._combine_row_stats,
-                            donate_argnums=jitopts.donate(0))
+_histo_stats_fold = observe.instrument(
+    "table.histo_stats_fold",
+    jax.jit(tdigest._combine_row_stats,
+            donate_argnums=jitopts.donate(0)))
 
 _MIN_BUCKET = 256
 _MIN_BUCKET_WIDE = 8  # for batches whose rows are whole planes

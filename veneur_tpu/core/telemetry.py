@@ -17,10 +17,12 @@ from __future__ import annotations
 
 import gc
 import logging
+import os
 import resource
 import socket
 import time
 
+from veneur_tpu import observe
 from veneur_tpu.protocol import dogstatsd as dsd
 from veneur_tpu.protocol.addr import parse_addr
 
@@ -51,6 +53,21 @@ def _install_gc_hook() -> None:
 
 def _gc_pause_total_ns() -> int:
     return _GC_PAUSE["total_ns"]
+
+
+def _rss_bytes() -> int:
+    """CURRENT resident set size.  ``ru_maxrss`` is the lifetime PEAK
+    — on a server whose jit warmup transiently balloons memory it
+    never comes back down, so the heap gauge would flatline at the
+    high-water mark and hide every later change.  /proc/self/statm
+    field 2 is live resident pages; fall back to the peak only where
+    procfs is unavailable (non-Linux)."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * (os.sysconf("SC_PAGE_SIZE") or 4096)
+    except (OSError, ValueError, IndexError):
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
 
 log = logging.getLogger("veneur_tpu.telemetry")
 
@@ -85,11 +102,18 @@ class Telemetry:
         addr = server.config.stats_address
         if addr:
             # accept both url style (udp://host:port, as every other
-            # address key) and bare host:port
+            # address key) and bare host:port; a bare value with no
+            # port (e.g. "localhost") must fail as a CONFIG error at
+            # construction, not as a naked int() ValueError
             if "://" in addr:
                 _, host, port, _ = parse_addr(addr)
             else:
-                host, _, port = addr.rpartition(":")
+                host, sep, port = addr.rpartition(":")
+                if not sep or not port.isdigit():
+                    raise ValueError(
+                        f"stats_address {addr!r}: expected host:port "
+                        f"with a numeric port (e.g. "
+                        f"'127.0.0.1:8125' or 'udp://host:8125')")
                 port = int(port)
             self._addr = (host or "127.0.0.1", port)
             self._sock = socket.socket(socket.AF_INET,
@@ -105,9 +129,12 @@ class Telemetry:
         return d
 
     def flush_tick(self, tally: dict, flush_duration_ns: float,
-                   sink_durations: dict[str, float]) -> None:
+                   sink_durations: dict[str, float],
+                   record=None) -> None:
         """Called once per flush with the interval's numbers; builds
-        and emits the operator samples."""
+        and emits the operator samples.  ``record`` is the cycle's
+        observe.FlushRecord (per-stage durations), when the caller
+        traced the flush."""
         samples: list[dsd.Sample] = []
         cfg = self.server.config
         # per-type scope overrides + fixed extra tags on the server's
@@ -165,6 +192,29 @@ class Telemetry:
             timer("veneur.forward.duration_ns", fwd_ns)
 
         timer("veneur.flush.total_duration_ns", flush_duration_ns)
+        # per-stage flush timings (observe/tracer.py span tree) — the
+        # number that tells an operator WHERE the interval went:
+        # device dispatch vs readback sync vs host emit vs sink I/O
+        if record is not None:
+            for stage, ns in list(record.stages.items()):
+                timer("veneur.flush.stage_duration_ns", ns,
+                      (f"stage:{stage}",))
+        # device-cost registry deltas (observe/devicecost.py): compile
+        # activity in steady state means a hot-path jit silently
+        # recompiled — the shape-drift failure mode the registry
+        # exists to expose — and readback bytes price the d2h link
+        dev = observe.REGISTRY.totals()
+        self.server.stats["xla_compiles"] = dev["compile_total"]
+        count("veneur.xla.compile_total", self._delta("xla_compiles"))
+        self.server.stats["xla_compile_ns"] = \
+            dev["compile_duration_ns"]
+        compile_ns = self._delta("xla_compile_ns")
+        if compile_ns:
+            timer("veneur.xla.compile_duration_ns", compile_ns)
+        self.server.stats["device_readback_bytes"] = \
+            dev["readback_bytes_total"]
+        count("veneur.device.readback_bytes_total",
+              self._delta("device_readback_bytes"))
         if self.server.config.count_unique_timeseries:
             # touched-row counts ARE the unique-timeseries tally (the
             # reference's tallyTimeseries HLL exists because worker
@@ -213,8 +263,7 @@ class Telemetry:
         gauge("veneur.gc.number",
               sum(s.get("collections", 0) for s in counts))
         gauge("veneur.gc.pause_total_ns", _gc_pause_total_ns())
-        gauge("veneur.mem.heap_alloc_bytes",
-              resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024)
+        gauge("veneur.mem.heap_alloc_bytes", _rss_bytes())
         gauge("veneur.flush.flush_timestamp_ns", time.time_ns())
 
         self._emit(samples)

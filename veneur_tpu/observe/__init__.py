@@ -1,0 +1,33 @@
+"""Self-observation: the framework watching its own hot path.
+
+The reference veneur traces its own flushes (flusher.go:29
+``trace.StartSpanFromContext``) and exposes ``/debug/pprof``
+(http.go:52-57); this package is the TPU-aware extension of both:
+
+``devicecost`` — a registry of instrumented hot-path jitted callables
+    counting compiles, compile wall time, per-call dispatch time, XLA
+    ``cost_analysis()`` flops/bytes estimates, and cumulative
+    host<-device readback bytes.  A silently recompiling flush jit is
+    the exact failure mode SALSA-style adaptive sketches warn about
+    when state shapes drift — the compile counter makes it an
+    assertable, alertable number.
+``flushring``  — per-flush-cycle records (stage durations, readback
+    bytes, tallies) in a bounded ring, served at ``/debug/flushes``.
+``tracer``     — the flush cycle's nested SSF span tree (snapshot ->
+    device dispatch -> readback sync -> host emit -> sink flush ->
+    forward), emitted through the server's own loopback trace client
+    so flush spans flow to span sinks like any user trace.
+``profiler``   — on-demand ``jax.profiler`` captures for
+    ``/debug/pprof/device?seconds=N``.
+"""
+
+from veneur_tpu.observe.devicecost import (DeviceCostRegistry, REGISTRY,
+                                           instrument)
+from veneur_tpu.observe.flushring import FlushRecord, FlushRing
+from veneur_tpu.observe.tracer import (FlushCycle, FlushTracer,
+                                       NULL_CYCLE, NullCycle)
+from veneur_tpu.observe.profiler import capture_device_profile
+
+__all__ = ["DeviceCostRegistry", "REGISTRY", "instrument",
+           "FlushRecord", "FlushRing", "FlushCycle", "FlushTracer",
+           "NullCycle", "NULL_CYCLE", "capture_device_profile"]

@@ -1,0 +1,185 @@
+"""Device-cost accounting for hot-path jitted callables.
+
+``instrument(name, fn)`` wraps a ``jax.jit`` result; every call is
+timed and checked for a cache miss (a compile).  On a compile the
+wall time of that call is attributed to compilation — on a stable
+workload shape the flush jits must compile once per shape bucket and
+never again, so a moving compile counter in steady state is a bug
+(shape drift, cache eviction, or a donated-buffer retrace), not noise.
+
+Wall times here are DISPATCH times: jax dispatch is async, so a
+non-compiling call returns as soon as the work is enqueued.  The
+device-side cost lives in the ``cost_analysis()`` flops/bytes
+estimates captured at compile time; the synchronous end-to-end cost
+of pulling results to host is what ``add_readback`` accounts
+(flusher readbacks report their ``device_get`` byte volume here).
+
+``cost_analysis`` runs ``fn.lower(...).compile()`` a second time on
+compile events only; on a tunnel-attached device where compiles are
+expensive it can be disabled with ``VENEUR_TPU_COST_ANALYSIS=0``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+_COST_ANALYSIS = os.environ.get(
+    "VENEUR_TPU_COST_ANALYSIS", "1").lower() not in ("0", "false",
+                                                     "off")
+
+
+class _Entry:
+    """Counters for one instrumented callable (guarded by the
+    registry lock)."""
+
+    __slots__ = ("calls", "compiles", "compile_ns", "call_ns",
+                 "flops", "bytes_accessed")
+
+    def __init__(self):
+        self.calls = 0
+        self.compiles = 0
+        self.compile_ns = 0
+        self.call_ns = 0
+        # latest compiled variant's per-execution estimates (the
+        # newest shape bucket is the one the current interval runs)
+        self.flops = 0.0
+        self.bytes_accessed = 0.0
+
+    def snapshot(self) -> dict:
+        return {"calls": self.calls, "compiles": self.compiles,
+                "compile_duration_ns": self.compile_ns,
+                "dispatch_duration_ns": self.call_ns,
+                "est_flops_per_call": self.flops,
+                "est_bytes_accessed_per_call": self.bytes_accessed}
+
+
+class InstrumentedJit:
+    """Callable wrapper around one jitted function; transparently
+    forwards everything else (``lower``, ``_cache_size``, ...) to the
+    wrapped jit."""
+
+    def __init__(self, name: str, fn, registry: "DeviceCostRegistry"):
+        self.name = name
+        self.__wrapped__ = fn
+        self._registry = registry
+        self._seen = set()  # fallback signature cache (no _cache_size)
+
+    def __getattr__(self, attr):
+        return getattr(self.__wrapped__, attr)
+
+    def _cache_len(self) -> int | None:
+        size = getattr(self.__wrapped__, "_cache_size", None)
+        if size is None:
+            return None
+        try:
+            return size()
+        except Exception:
+            return None
+
+    def _sig(self, args, kwargs):
+        def one(a):
+            shape = getattr(a, "shape", None)
+            if shape is None:
+                return repr(a)
+            return (shape, str(getattr(a, "dtype", "")))
+        return (tuple(one(a) for a in args),
+                tuple(sorted((k, one(v)) for k, v in kwargs.items())))
+
+    def __call__(self, *args, **kwargs):
+        before = self._cache_len()
+        t0 = time.monotonic_ns()
+        out = self.__wrapped__(*args, **kwargs)
+        dt = time.monotonic_ns() - t0
+        if before is not None:
+            compiled = (self._cache_len() or 0) > before
+        else:
+            sig = self._sig(args, kwargs)
+            compiled = sig not in self._seen
+            self._seen.add(sig)
+        cost = None
+        if compiled and _COST_ANALYSIS:
+            cost = self._cost(args, kwargs)
+        self._registry._record(self.name, dt, compiled, cost)
+        return out
+
+    def _cost(self, args, kwargs) -> dict | None:
+        """XLA's own flops / bytes-accessed estimate for the variant
+        just compiled.  ``lower().compile()`` pays a second compile,
+        which is why this runs on compile events only."""
+        try:
+            analysis = (self.__wrapped__.lower(*args, **kwargs)
+                        .compile().cost_analysis())
+        except Exception:
+            return None
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else None
+        if not isinstance(analysis, dict):
+            return None
+        return {"flops": float(analysis.get("flops", 0.0)),
+                "bytes_accessed": float(
+                    analysis.get("bytes accessed", 0.0))}
+
+
+class DeviceCostRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: dict[str, _Entry] = {}
+        self._readback_bytes = 0
+
+    def instrument(self, name: str, fn) -> InstrumentedJit:
+        with self._lock:
+            self._entries.setdefault(name, _Entry())
+        return InstrumentedJit(name, fn, self)
+
+    def _record(self, name: str, dt_ns: int, compiled: bool,
+                cost: dict | None) -> None:
+        with self._lock:
+            e = self._entries.setdefault(name, _Entry())
+            e.calls += 1
+            e.call_ns += dt_ns
+            if compiled:
+                e.compiles += 1
+                e.compile_ns += dt_ns
+            if cost is not None:
+                e.flops = cost["flops"]
+                e.bytes_accessed = cost["bytes_accessed"]
+
+    def add_readback(self, nbytes: int) -> None:
+        with self._lock:
+            self._readback_bytes += int(nbytes)
+
+    # ------------------------------------------------------------------
+
+    def totals(self) -> dict:
+        """Cross-kernel totals — what Telemetry deltas per interval."""
+        with self._lock:
+            return {
+                "compile_total": sum(e.compiles
+                                     for e in self._entries.values()),
+                "compile_duration_ns": sum(
+                    e.compile_ns for e in self._entries.values()),
+                "dispatch_duration_ns": sum(
+                    e.call_ns for e in self._entries.values()),
+                "readback_bytes_total": self._readback_bytes,
+            }
+
+    def snapshot(self) -> dict:
+        """Full per-kernel dump for /debug/vars."""
+        with self._lock:
+            return {
+                "kernels": {name: e.snapshot()
+                            for name, e in self._entries.items()},
+                "readback_bytes_total": self._readback_bytes,
+            }
+
+
+# One process-global registry: the instrumented jits are module-level
+# objects (flusher/table kernels), so their counters are too.
+REGISTRY = DeviceCostRegistry()
+
+
+def instrument(name: str, fn,
+               registry: DeviceCostRegistry | None = None):
+    return (registry or REGISTRY).instrument(name, fn)

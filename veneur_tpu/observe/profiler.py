@@ -1,0 +1,46 @@
+"""On-demand jax profiler captures for /debug/pprof/device.
+
+``enable_profiling`` starts a trace for the process lifetime
+(core/server.py); this is the live counterpart — an operator grabs N
+seconds of xplane trace from a RUNNING server without a restart, the
+way ``/debug/pprof/profile?seconds=N`` grabs a cProfile sample.  The
+capture lands under a fresh directory (default ``/tmp``) and the
+response lists the artifact files to fetch into tensorboard/xprof.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+
+MAX_SECONDS = 30.0
+
+
+def capture_device_profile(seconds: float,
+                           base_dir: str | None = None) -> dict:
+    """Run jax.profiler for ``seconds`` (capped) and return
+    ``{"dir": ..., "seconds": ..., "files": [{name, bytes}, ...]}``.
+
+    The caller serializes (only one profiler per process); raised
+    errors are the caller's to map onto an HTTP status.
+    """
+    import jax
+
+    seconds = max(0.05, min(float(seconds), MAX_SECONDS))
+    out_dir = tempfile.mkdtemp(prefix="veneur-device-profile-",
+                               dir=base_dir)
+    jax.profiler.start_trace(out_dir)
+    try:
+        time.sleep(seconds)
+    finally:
+        jax.profiler.stop_trace()
+    files = []
+    for root, _dirs, names in os.walk(out_dir):
+        for name in names:
+            path = os.path.join(root, name)
+            files.append({
+                "name": os.path.relpath(path, out_dir),
+                "bytes": os.path.getsize(path)})
+    return {"dir": out_dir, "seconds": seconds,
+            "files": sorted(files, key=lambda f: f["name"])}

@@ -1,0 +1,124 @@
+"""Flush self-tracing: one nested SSF span tree per flush cycle.
+
+The reference wraps its flush in ``trace.StartSpanFromContext``
+(flusher.go:29) and child spans per phase; here ``FlushTracer.cycle``
+opens the root ``flush`` span and ``FlushCycle.stage`` hangs one
+child per pipeline stage off it:
+
+    flush
+      +- flush.snapshot          table swap under the ingest lock
+      +- flush.device_dispatch   combine/readout jit dispatch (async)
+      +- flush.readback_sync     device_get — the d2h sync point
+      +- flush.host_emit         InterMetric assembly from row metadata
+      +- flush.sink_flush        per-sink fan-out + interval-budget wait
+      +- flush.forward           upstream ship (local tier only)
+
+Spans go through the server's own loopback trace client, so they flow
+to span sinks (and ssfmetrics extraction) like any user trace.  Each
+cycle also fills a ``FlushRecord`` for the ``/debug/flushes`` ring.
+
+``NULL_CYCLE`` is the no-tracer stand-in for direct ``Flusher.flush``
+callers (tests, benches): stages are free, but readback accounting
+still reaches the device-cost registry.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+from veneur_tpu.observe.devicecost import REGISTRY
+from veneur_tpu.observe.flushring import FlushRecord, FlushRing
+
+
+class _NullSpan:
+    def add_tag(self, key, value):
+        pass
+
+
+class NullCycle:
+    """Stage spans are no-ops; readback bytes still count."""
+
+    record = None
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        yield _NullSpan()
+
+    def add_readback(self, nbytes: int) -> None:
+        REGISTRY.add_readback(nbytes)
+
+
+NULL_CYCLE = NullCycle()
+
+
+class FlushCycle:
+    def __init__(self, root, client, record: FlushRecord, registry):
+        self.root = root
+        self._client = client
+        self.record = record
+        self._registry = registry
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        """Time one pipeline stage as a child span of the flush root.
+        Safe to enter from pool threads (the forward stage runs on
+        one); re-entering a stage name accumulates its ns."""
+        sp = self.root.child(f"flush.{name}")
+        sp.add_tag("stage", name)
+        sp.add_tag("veneur.internal", "true")
+        t0 = time.monotonic_ns()
+        try:
+            yield sp
+        except BaseException as e:
+            sp.set_error(e)
+            raise
+        finally:
+            dt = time.monotonic_ns() - t0
+            with self._lock:
+                self.record.stages[name] = (
+                    self.record.stages.get(name, 0) + dt)
+            sp.finish(self._client)
+
+    def add_readback(self, nbytes: int) -> None:
+        self._registry.add_readback(nbytes)
+        with self._lock:
+            self.record.readback_bytes += int(nbytes)
+
+
+class FlushTracer:
+    def __init__(self, client, ring: FlushRing, registry=None,
+                 service: str = "veneur"):
+        self.client = client
+        self.ring = ring
+        self.registry = registry or REGISTRY
+        self.service = service
+
+    @contextlib.contextmanager
+    def cycle(self):
+        from veneur_tpu.trace.spans import Span
+        record = FlushRecord(seq=self.ring.next_seq(),
+                             start_unix=time.time())
+        # the internal marker exempts these spans from the user-span
+        # throughput counter and the uniqueness sketch (core/spans.py,
+        # sinks/ssfmetrics.py) — they still reach every span sink
+        root = Span("flush", service=self.service,
+                    tags={"veneur.internal": "true"})
+        cyc = FlushCycle(root, self.client, record, self.registry)
+        compiles0 = self.registry.totals()["compile_total"]
+        t0 = time.monotonic_ns()
+        try:
+            yield cyc
+        except BaseException as e:
+            root.set_error(e)
+            record.error = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            record.duration_ns = time.monotonic_ns() - t0
+            record.compiles = (self.registry.totals()["compile_total"]
+                               - compiles0)
+            root.add_tag("flush.seq", str(record.seq))
+            root.finish(self.client)
+            self.ring.append(record)

@@ -40,9 +40,14 @@ class MetricExtractionSink:
             self.objective_timer_name))
         # span-population uniqueness sketch, delivery-sampled
         # (reference metrics.go:128 ConvertSpanUniquenessMetrics at
-        # a fixed 1% rate)
-        samples.extend(ssf_convert.convert_span_uniqueness_metrics(
-            span, self.uniqueness_rate))
+        # a fixed 1% rate).  Self-trace spans (observe/tracer.py) are
+        # exempt: their names are a small constant set, and the random
+        # sampling would inject table rows mid-interval, making the
+        # server's own metric counts nondeterministic.
+        if span.tags.get("veneur.internal") != "true":
+            samples.extend(
+                ssf_convert.convert_span_uniqueness_metrics(
+                    span, self.uniqueness_rate))
         if invalid:
             # counted into the pipeline itself like the reference's
             # self-reported ssf.error_total (metrics.go:92-106)
