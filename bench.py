@@ -106,6 +106,14 @@ def _backend_info() -> dict:
         from veneur_tpu.ops import tdigest as _td
         info["gates"]["merge_resolved"] = _td.resolved_merge_mode()
         info["gates"]["merge_fallback"] = _td._FALLBACK_MODE
+        # fused global-merge batching: "auto" resolves against the
+        # merge gate above (stack iff pallas)
+        from veneur_tpu.core import table as _tbl
+        mode = _tbl._fused_import_mode()
+        if mode == "auto":
+            mode = ("stack" if info["gates"]["merge_resolved"]
+                    == "pallas" else "legacy")
+        info["gates"]["fused_import_resolved"] = mode
     except Exception:
         pass
     try:
@@ -117,6 +125,19 @@ def _backend_info() -> dict:
                      "jax_version": jax.__version__})
     except Exception as e:  # pragma: no cover - dead-link path
         info.update({"platform": "unknown", "platform_error": str(e)})
+    try:
+        # persistent-cache traffic THIS process saw (the monitoring
+        # listener compile_cache.enable installed at import): lets a
+        # BENCH_r* trajectory tell compile cost from a steady-state
+        # regression
+        from veneur_tpu.observe.devicecost import REGISTRY
+        totals = REGISTRY.totals()
+        info["gates"]["compile_cache_hits"] = \
+            totals["compile_cache_hits"]
+        info["gates"]["compile_cache_misses"] = \
+            totals["compile_cache_misses"]
+    except Exception:
+        pass
     return info
 
 
@@ -228,9 +249,16 @@ def _interval_result(total, dt, per_interval, cold):
     n = len(per_interval)
     steady = sorted(per_interval[FLUSH_LAG:]) or sorted(per_interval)
     med = steady[len(steady) // 2]
+    # warm mean: drop the first timed interval too — the cold interval
+    # is already untimed, but the first steady pass can still carry
+    # residual compile/row-allocation; this is the number to compare
+    # against mean_samples_per_sec to see pure compile drag
+    warm = per_interval[1:] or per_interval
+    warm_mean = (total / n) * len(warm) / sum(warm)
     return {"samples": total, "seconds": round(dt, 4),
             "samples_per_sec": round(total / n / med, 1),
             "mean_samples_per_sec": round(total / dt, 1),
+            "warm_mean_samples_per_sec": round(warm_mean, 1),
             "interval_seconds": [round(x, 4) for x in per_interval],
             "intervals": n,
             "cold_interval_seconds": round(cold, 4)}
@@ -520,6 +548,8 @@ def bench_global_merge() -> dict:
     res_d["items"] = res_d.pop("samples")
     res_d["items_per_sec"] = res_d.pop("samples_per_sec")
     res_d["mean_items_per_sec"] = res_d.pop("mean_samples_per_sec")
+    res_d["warm_mean_items_per_sec"] = res_d.pop(
+        "warm_mean_samples_per_sec")
     res_d["locals"] = n_locals
     res_d["quantile_rows_read"] = int(np.isfinite(q).all(axis=1).sum())
 
@@ -1734,7 +1764,16 @@ def _assemble(configs: dict, t_start: float,
             merge_resolved=_resolve_merge_for(
                 stamp.get("platform", "unknown")),
             merge_fallback=os.environ.get(
-                "VENEUR_TPU_MERGE_FALLBACK", "scatter")),
+                "VENEUR_TPU_MERGE_FALLBACK", "scatter"),
+            # cache traffic summed over the config children's own
+            # stamps (counted in-process by each child's monitoring
+            # listener — no jax import here, see above)
+            compile_cache_hits=sum(
+                v.get("gates", {}).get("compile_cache_hits", 0)
+                for v in configs.values() if isinstance(v, dict)),
+            compile_cache_misses=sum(
+                v.get("gates", {}).get("compile_cache_misses", 0)
+                for v in configs.values() if isinstance(v, dict))),
         "platform_mixed": sorted(platforms) if len(platforms) > 1
         else None,
         "quick": QUICK,

@@ -60,7 +60,7 @@ def main():
     table._set_pos_rows.clear()
     table._set_pos.clear()
     table._staged_n = 0
-    tf = t(lambda: table._hll_host_fold(srows, spos))
+    tf = t(lambda: table._hll_host_fold(table._state, srows, spos))
     print(f"hll_host_fold:    {tf*1e3:8.2f} ms  ({n/tf/1e6:.1f}M members/s)")
 
     # phase 4: estimate_np over the 1024x16384 plane

@@ -138,7 +138,7 @@ def test_histogram_roundtrip_preserves_quantiles():
     src = MetricTable(TableConfig())
     for i in range(0, len(samples), 500):
         src._histo_device_step(
-            np.zeros(500, np.int32), samples[i:i + 500],
+            src._state, np.zeros(500, np.int32), samples[i:i + 500],
             np.ones(500, np.float32))
     stats = np.asarray(src.histo_stats)[0]
     row = ForwardRow(_meta("lat", dsd.TIMER, ("svc:x",)), "histo",

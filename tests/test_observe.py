@@ -34,8 +34,13 @@ def _wait(pred, timeout=10.0):
     return False
 
 
-STAGES = ("snapshot", "device_dispatch", "readback_sync",
+STAGES = ("snapshot", "dispatch", "device_wait",
           "host_emit", "sink_flush")
+# renamed-stage dashboard aliases: recorded in stage ns (so legacy
+# veneur.flush.stage_duration_ns series keep flowing) but NOT emitted
+# as their own spans
+LEGACY_ALIASES = {"dispatch": "device_dispatch",
+                  "device_wait": "readback_sync"}
 
 
 # ---------------------------------------------------------------------
@@ -89,11 +94,17 @@ def test_flush_ring_record_matches_cycle():
         srv.flush_once()
         recs = srv.flush_ring.records()
         assert [r.seq for r in recs] == [1, 2]
+        aliases = set(LEGACY_ALIASES.values())
         for rec in recs:
-            assert set(rec.stages) >= set(STAGES)
+            assert set(rec.stages) >= set(STAGES) | aliases
             assert all(ns >= 0 for ns in rec.stages.values())
-            # stages are disjoint intervals inside the cycle
-            assert sum(rec.stages.values()) <= rec.duration_ns
+            # each alias mirrors its renamed stage exactly
+            for new, old in LEGACY_ALIASES.items():
+                assert rec.stages[old] == rec.stages[new]
+            # canonical stages are disjoint intervals inside the
+            # cycle (aliases are recording duplicates, not stages)
+            assert sum(ns for k, ns in rec.stages.items()
+                       if k not in aliases) <= rec.duration_ns
             assert rec.error == ""
         # the interval that carried the metrics read them back
         assert recs[0].readback_bytes > 0

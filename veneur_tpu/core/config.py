@@ -295,6 +295,17 @@ class Config:
     # bounding host staging memory and smoothing device work instead of
     # landing the whole interval's batch at the flush boundary
     tpu_stage_flush_samples: int = 65536
+    # overlapped device pipeline: detach staged work under the ingest
+    # lock and dispatch the jitted combine kernels outside it, with
+    # the flush split into begin_swap (locked, O(µs)) / complete_swap
+    # (unlocked).  VENEUR_TPU_PIPELINE=0 is the serial escape hatch —
+    # every device_step/swap runs inline under the lock as before.
+    tpu_pipeline: bool = True
+    # compile every canonical kernel shape at startup (against a
+    # scratch table) so the first flush interval doesn't eat the XLA
+    # compiles; off by default because it adds seconds to process
+    # start when the persistent compilation cache is cold
+    tpu_warmup: bool = False
     # multi-chip global tier: nonzero runs the table as SPMD sharded
     # planes over a (shard, series) jax Mesh of ALL visible devices,
     # with this many entries on the shard (ingest-parallel) axis; the

@@ -97,12 +97,16 @@ class Server:
                  extra_span_sinks: list | None = None):
         self.config = config
         self._maybe_fall_back_to_cpu()
+        # before the table below triggers the first jit compiles;
+        # restarts then hit the on-disk cache (the fast half of the
+        # watchdog's crash-and-restart model).  enable() also installs
+        # the jax.monitoring listener that counts persistent-cache
+        # hits/misses into the device-cost registry.
+        from veneur_tpu.utils import compile_cache
         if config.compile_cache_dir:
-            # before the table below triggers the first jit compiles;
-            # restarts then hit the on-disk cache (the fast half of
-            # the watchdog's crash-and-restart model)
-            from veneur_tpu.utils import compile_cache
             compile_cache.enable(config.compile_cache_dir)
+        elif os.environ.get(compile_cache.ENV_VAR):
+            compile_cache.enable_from_env()
         self.interval = config.interval_seconds()
         self.is_local = config.is_local()
         table_cfg = TableConfig(
@@ -161,6 +165,13 @@ class Server:
         """Everything downstream of table construction — shared by the
         single-chip and mesh-sharded table paths."""
         self.lock = threading.Lock()
+        # overlapped device pipeline (VENEUR_TPU_PIPELINE): staged work
+        # is detached under self.lock in O(µs) and the jitted combine
+        # kernels dispatch outside it, so ingest never stalls behind
+        # XLA.  ShardedTable has its own step machinery, hence the
+        # capability probe rather than a bare config check.
+        self.pipeline = (bool(getattr(config, "tpu_pipeline", True))
+                         and hasattr(self.table, "take_staged"))
         self.sentry = None  # set by _build_sinks when sentry_dsn is
         self.flusher = Flusher(
             is_local=self.is_local,
@@ -259,6 +270,10 @@ class Server:
         self.grpc_servers: list = []
         self.grpc_ports: list[int] = []
         self._grpc_client = None
+
+        if getattr(config, "tpu_warmup", False) and \
+                hasattr(self.table, "take_staged"):
+            self._warmup()
 
     # ------------------------------------------------------------------
     # construction
@@ -481,15 +496,46 @@ class Server:
             return
         self.bump("packets_received")
         errors = processed = dropped = 0
+        # parse every line lock-free first, then take ONE self.lock
+        # round for the whole datagram — multi-line packets previously
+        # paid a lock acquisition per sample (they already tallied
+        # stats once per packet)
+        samples: list = []
+        events: list = []
+        checks: list = []
         for line in dsd.split_packet(data):
             try:
                 parsed = dsd.parse_line(line)
             except dsd.ParseError:
                 errors += 1
                 continue
-            p, d = self.ingest_parsed(parsed, bump=False)
-            processed += p
-            dropped += d
+            if isinstance(parsed, dsd.Sample):
+                samples.append(parsed)
+            elif isinstance(parsed, dsd.Event):
+                events.append(parsed)
+            elif isinstance(parsed, dsd.ServiceCheck):
+                # service checks ingest as STATUS samples but never
+                # count as dropped (matching ingest_parsed)
+                checks.append(parsed)
+        work = None
+        if samples or events or checks:
+            with self.lock:
+                for s in samples:
+                    processed += 1
+                    if not self.table.ingest(s):
+                        dropped += 1
+                for chk in checks:
+                    processed += 1
+                    self.table.ingest(dsd.Sample(
+                        name=chk.name, type=dsd.STATUS,
+                        value=float(chk.status), tags=chk.tags,
+                        message=chk.message))
+                if events:
+                    self.events.extend(events)
+                if checks:
+                    self.checks.extend(checks)
+                work = self._maybe_device_step_locked()
+        self._apply_staged(work)
         # one stats-lock round per packet, not per line
         if errors:
             self.bump("packet_errors", errors)
@@ -505,7 +551,8 @@ class Server:
         if isinstance(parsed, dsd.Sample):
             with self.lock:
                 ok = self.table.ingest(parsed)
-                self._maybe_device_step_locked()
+                work = self._maybe_device_step_locked()
+            self._apply_staged(work)
             processed = 1
             dropped = 0 if ok else 1
         elif isinstance(parsed, dsd.Event):
@@ -527,11 +574,57 @@ class Server:
                 self.bump("metrics_dropped", dropped)
         return processed, dropped
 
-    def _maybe_device_step_locked(self) -> None:
+    def _maybe_device_step_locked(self):
         """Mid-interval device step once enough samples are staged
-        (bounds host staging memory; caller holds self.lock)."""
-        if self.table.staged() >= self.config.tpu_stage_flush_samples:
-            self.table.device_step()
+        (bounds host staging memory; caller holds self.lock).
+
+        Pipelined mode returns the detached staged work — the caller
+        MUST hand it to ``_apply_staged`` after releasing self.lock so
+        the XLA dispatch happens outside the ingest critical section.
+        Serial mode (VENEUR_TPU_PIPELINE=0, or a table without the
+        staged-work API) dispatches inline and returns None."""
+        if self.table.staged() < self.config.tpu_stage_flush_samples:
+            return None
+        if self.pipeline:
+            return self.table.take_staged()
+        self.table.device_step()
+        return None
+
+    def _apply_staged(self, work) -> None:
+        """Dispatch detached staged work outside the ingest lock (the
+        flush's complete_swap waits for every pending apply, so no
+        sample is lost or double-counted across the swap)."""
+        if work is not None:
+            self.table.apply_staged(work)
+
+    def _warmup(self) -> None:
+        """Compile the canonical kernel shapes before traffic arrives
+        (VENEUR_TPU_WARMUP): a scratch table with the server's exact
+        geometry takes one sample of each kind through a device step,
+        swap, and flush readout, so the first real interval dispatches
+        from the jit (or persistent compilation) cache instead of
+        eating the cold compiles.  The jitted kernels are module-level
+        objects, so warming them through the scratch table warms the
+        live one."""
+        t0 = time.monotonic()
+        scratch = MetricTable(TableConfig(
+            counter_rows=self.config.tpu_counter_rows,
+            gauge_rows=self.config.tpu_gauge_rows,
+            histo_rows=self.config.tpu_histo_rows,
+            set_rows=self.config.tpu_set_rows,
+            compression=self.config.tpu_compression,
+            histo_slots=self.config.tpu_histo_slots))
+        for s in (dsd.Sample("veneur.warmup", dsd.COUNTER, 1.0),
+                  dsd.Sample("veneur.warmup", dsd.GAUGE, 1.0),
+                  dsd.Sample("veneur.warmup", dsd.HISTOGRAM, 1.0),
+                  dsd.Sample("veneur.warmup", dsd.TIMER, 1.0),
+                  dsd.Sample("veneur.warmup", dsd.SET, "w")):
+            scratch.ingest(s)
+        snap = scratch.swap()
+        self.flusher.flush(snap)
+        snap.release()
+        log.info("kernel warmup finished in %.2fs",
+                 time.monotonic() - t0)
 
     # ------------------------------------------------------------------
     # listeners
@@ -910,7 +1003,8 @@ class Server:
             with self.lock:
                 processed, dropped, others = \
                     self.table.ingest_buffer(buf)
-                self._maybe_device_step_locked()
+                work = self._maybe_device_step_locked()
+            self._apply_staged(work)
             for off, ln, _kind in others:
                 try:
                     parsed = dsd.parse_line(buf[off:off + ln])
@@ -927,7 +1021,8 @@ class Server:
             pb = parser.parse(b"\n".join(good), copy=False)
             with self.lock:
                 processed, dropped = self.table.ingest_columns(pb)
-                self._maybe_device_step_locked()
+                work = self._maybe_device_step_locked()
+            self._apply_staged(work)
             # events / service checks / malformed lines: per-line
             # slow path
             slow = np.nonzero(pb.type_code > columnar.CODE_SET)[0]
@@ -1081,7 +1176,8 @@ class Server:
                         with server.lock:
                             acc, dropped = http_import.apply_import(
                                 server.table, items)
-                            server._maybe_device_step_locked()
+                            work = server._maybe_device_step_locked()
+                        server._apply_staged(work)
                         server.bump("imports_received", acc)
                         server.bump("metrics_dropped", dropped)
                         server.bump("import_response_ns",
@@ -1182,15 +1278,30 @@ class Server:
         return res
 
     def _flush_stages(self, cyc, t_flush0: int) -> FlushResult:
-        with cyc.stage("snapshot"):
-            with self.lock:
-                snap = self.table.swap()
-                events = self.events
-                checks = self.checks
-                self.events, self.checks = [], []
-                status = self.table.take_status()
-        # device_dispatch / readback_sync / host_emit stages happen
-        # inside the flusher, against the same cycle
+        if self.pipeline:
+            # pipelined swap: only the O(µs) buffer detach + metadata
+            # capture happens under the ingest lock; the final combine
+            # dispatch (swap_apply) waits out in-flight staged applies
+            # and runs with ingest already admitted to the new interval
+            with cyc.stage("snapshot"):
+                with self.lock:
+                    pend = self.table.begin_swap()
+                    events = self.events
+                    checks = self.checks
+                    self.events, self.checks = [], []
+                    status = self.table.take_status()
+            with cyc.stage("swap_apply"):
+                snap = self.table.complete_swap(pend)
+        else:
+            with cyc.stage("snapshot"):
+                with self.lock:
+                    snap = self.table.swap()
+                    events = self.events
+                    checks = self.checks
+                    self.events, self.checks = [], []
+                    status = self.table.take_status()
+        # dispatch / device_wait / host_emit stages happen inside the
+        # flusher, against the same cycle
         res = self.flusher.flush(snap, cycle=cyc)
         # the interval's reads are done (forward rows hold copies);
         # recycle the host set plane into the table's reuse pool

@@ -30,6 +30,7 @@ matching their modest role in the reference (samplers/samplers.go:307).
 from __future__ import annotations
 
 import os
+import threading
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any
@@ -111,6 +112,97 @@ def _pad_np(arr: np.ndarray, length: int, fill) -> np.ndarray:
     out = np.full(length, fill, arr.dtype)
     out[:len(arr)] = arr
     return out
+
+
+def _ladder_floor(n: int) -> int:
+    """Largest wide-ladder bucket <= n (inverse of _bucket_len): the
+    per-wire spill threshold for the stacked merge must itself be a
+    ladder value, or bucketing the observed depth could round the
+    stack width past the fused kernel's chunk bound."""
+    b = best = _MIN_BUCKET_WIDE
+    while b <= n:
+        best = b
+        if b + b // 2 <= n:
+            best = b + b // 2
+        b *= 2
+    return best
+
+
+def _fused_import_mode() -> str:
+    """VENEUR_TPU_FUSED_IMPORT: unset/"auto" (default) picks per
+    backend at apply time — the stacked kernel where the Pallas merge
+    gate engages (each scan step stays inside the kernel's lane
+    bound, where the flat merge's combined width would blow it and
+    fall back), the flat rank-interleaved merge elsewhere (fewer
+    total FLOPs when every path is scatter anyway).  "1"/"stack"
+    forces the stacked call; "0"/"perwire" keeps one kernel call per
+    wire — bit-identical to the stacked mode (same merge body, order,
+    and operand shapes), kept as the reference for
+    tests/test_pipeline.py; "legacy" restores the flat
+    rank-interleaved staging path from before the fusion."""
+    raw = os.environ.get("VENEUR_TPU_FUSED_IMPORT", "auto").lower()
+    if raw in ("0", "false", "off", "perwire", "per-wire"):
+        return "perwire"
+    if raw == "legacy":
+        return "legacy"
+    if raw in ("", "auto"):
+        return "auto"
+    return "stack"
+
+
+def _state_property(name: str) -> property:
+    def _get(self):
+        return getattr(self._state, name)
+
+    def _set(self, value):
+        setattr(self._state, name, value)
+
+    return property(_get, _set)
+
+
+class _IntervalState:
+    """One interval's device-resident accumulation state.  The table
+    has exactly one CURRENT state receiving new staging; at a swap
+    boundary the outgoing object stays pinned by any in-flight staged
+    work that still targets it (take_staged binds the state at detach
+    time), so a late apply can never land in the wrong interval — the
+    object identity IS the generation guarantee, and ``pending`` is
+    the count complete_swap waits out before snapshotting."""
+
+    __slots__ = ("gen", "pending", "fresh", "counters", "gauges",
+                 "histo_stats", "histo_import_stats", "histo_means",
+                 "histo_weights", "hll_regs", "hll_host_plane",
+                 "hll_host_ez", "hll_host_inv", "hll_device_touched")
+
+    def __init__(self, gen: int):
+        self.gen = gen
+        self.pending = 0
+        self.fresh: set = set()
+        self.hll_host_plane: np.ndarray | None = None
+        self.hll_host_ez: np.ndarray | None = None
+        self.hll_host_inv: np.ndarray | None = None
+        self.hll_device_touched = False
+
+
+class _StagedWork:
+    """Staging buffers detached under the ingest lock (O(µs): list and
+    dense-buffer handoffs, no concatenation or hashing), applied to
+    the pinned interval state outside it (apply_staged)."""
+
+    __slots__ = ("state", "final", "counter", "gauge", "histo",
+                 "digest", "wire_parts", "set_parts", "stats_parts",
+                 "set_import", "empty")
+
+
+class _PendingSwap:
+    """begin_swap's output: the final detached staging plus the row
+    metadata captured at the interval boundary, everything
+    complete_swap needs to finish the snapshot off-lock."""
+
+    __slots__ = ("work", "state", "counter_meta", "counter_touched",
+                 "gauge_meta", "gauge_touched", "histo_meta",
+                 "histo_touched", "set_meta", "set_touched",
+                 "overflow")
 
 
 @dataclass
@@ -399,13 +491,10 @@ class MetricTable:
         self._set_import_touched: np.ndarray | None = None
 
         # host register plane for raw set traffic (lazy; see
-        # TableConfig.host_set_plane_max_bytes) + device-touch flag,
-        # plus fold-maintained per-row estimate statistics (native
-        # path only; see hll.estimate_from_stats)
-        self._hll_host_plane: np.ndarray | None = None
-        self._hll_host_ez: np.ndarray | None = None
-        self._hll_host_inv: np.ndarray | None = None
-        self._hll_device_touched = False
+        # TableConfig.host_set_plane_max_bytes), device-touch flag,
+        # and fold-maintained per-row estimate statistics all live on
+        # the interval state (_IntervalState) — forwarded as
+        # _hll_host_plane/_hll_host_ez/_hll_host_inv below.
         # cleared planes handed back by consumed snapshots
         # (Snapshot.release); list ops are GIL-atomic, so the flusher
         # thread appends while the ingest thread pops
@@ -447,45 +536,82 @@ class MetricTable:
         # walking the staging lists)
         self._staged_n = 0
 
+        # fused global merge staging: one part per decoded wire list
+        # (rows, means, weights), stacked at apply time into one
+        # (n_wires, rows, K) kernel call — see _wire_digest_step
+        self._wire_digest_parts: list[tuple] = []
+        self._wire_digest_n = 0
+        self.fused_import_mode = _fused_import_mode()
+        # widest ladder bucket the stacked merge may use per wire;
+        # rows deeper than this in one wire spill to the ranked path
+        self._wire_stack_kmax = _ladder_floor(self._eff_histo_slots)
+
+        # pipelined apply machinery: device dispatch serializes on
+        # _device_lock so staged work applies outside the ingest lock;
+        # _pending_cv guards per-state pending counts (take_staged
+        # increments, apply_staged decrements, complete_swap waits)
+        self._device_lock = threading.Lock()
+        self._pending_cv = threading.Condition()
+
         self._init_state()
 
     _KINDS = ("counter", "gauge", "histo", "hll")
 
     def _init_state(self):
-        self._fresh: set = set()
+        st = _IntervalState(self.gen)
         for kind in self._KINDS:
-            self._alloc_state(kind)
+            self._alloc_state(st, kind)
+        self._state = st
 
-    def _alloc_state(self, kind: str) -> None:
+    def _alloc_state(self, st: _IntervalState, kind: str) -> None:
         c = self.config
         if kind == "counter":
-            self.counters = segment.empty_counter_state(c.counter_rows)
+            st.counters = segment.empty_counter_state(c.counter_rows)
         elif kind == "gauge":
-            self.gauges = segment.empty_gauge_state(c.gauge_rows)
+            st.gauges = segment.empty_gauge_state(c.gauge_rows)
         elif kind == "histo":
             # ALL FOUR histo planes freshen as one kind: the flusher
             # reads local + import stats under one touched gate, so a
             # split freshness would let a stale import plane from a
             # prior interval leak into every later flush
-            self.histo_stats = segment.empty_histo_stats(c.histo_rows)
-            self.histo_import_stats = segment.empty_histo_stats(
+            st.histo_stats = segment.empty_histo_stats(c.histo_rows)
+            st.histo_import_stats = segment.empty_histo_stats(
                 c.histo_rows)
-            self.histo_means, self.histo_weights = tdigest.empty_state(
+            st.histo_means, st.histo_weights = tdigest.empty_state(
                 c.histo_rows, self.capacity)
         elif kind == "hll":
-            self.hll_regs = hll.empty_state(c.set_rows)
+            st.hll_regs = hll.empty_state(c.set_rows)
 
-    def _ensure_fresh(self, kind: str) -> None:
+    def _ensure_fresh(self, st: _IntervalState, kind: str) -> None:
         """Lazy per-type state reinit.  After a swap the old planes
         belong to the snapshot; a type is only given NEW zeroed planes
         when something actually touches it — per-kernel dispatch on
         the tunnel link costs ~10ms, so re-zeroing every state family
         every interval dominated sparse intervals.  Alloc BEFORE
-        discarding from _fresh so an allocation failure can't leave
+        discarding from fresh so an allocation failure can't leave
         the table aliasing (and later donating) a snapshot's plane."""
-        if kind in self._fresh:
-            self._alloc_state(kind)
-            self._fresh.discard(kind)
+        if kind in st.fresh:
+            self._alloc_state(st, kind)
+            st.fresh.discard(kind)
+
+    # ------------------------------------------------------------------
+    # interval-state forwarding: direct consumers (tests, benches, the
+    # sharded aggregator's shards) address the CURRENT interval's
+    # planes as plain table attributes; the pipelined apply path pins
+    # explicit _IntervalState objects instead (take_staged/begin_swap)
+
+    counters = _state_property("counters")
+    gauges = _state_property("gauges")
+    histo_stats = _state_property("histo_stats")
+    histo_import_stats = _state_property("histo_import_stats")
+    histo_means = _state_property("histo_means")
+    histo_weights = _state_property("histo_weights")
+    hll_regs = _state_property("hll_regs")
+    _hll_host_plane = _state_property("hll_host_plane")
+    _hll_host_ez = _state_property("hll_host_ez")
+    _hll_host_inv = _state_property("hll_host_inv")
+    _hll_device_touched = _state_property("hll_device_touched")
+    _fresh = _state_property("fresh")
 
     # ------------------------------------------------------------------
     # ingest
@@ -1049,10 +1175,19 @@ class MetricTable:
                                       self.gen)
             self._staged_n += len(rows)
         if len(cent_rows):
-            self._digest_stage.append(
-                np.ascontiguousarray(cent_rows, np.int32),
-                np.ascontiguousarray(cent_means, np.float32),
-                np.ascontiguousarray(cent_weights, np.float32))
+            part = (np.ascontiguousarray(cent_rows, np.int32),
+                    np.ascontiguousarray(cent_means, np.float32),
+                    np.ascontiguousarray(cent_weights, np.float32))
+            if self.fused_import_mode == "legacy":
+                # pre-fusion behavior: all wires' centroids interleave
+                # by within-row rank into one flat ranked merge
+                self._digest_stage.append(*part)
+            else:
+                # one part per wire list: the apply stacks the whole
+                # cycle into a single (n_wires, rows, K) kernel call
+                # (_wire_digest_step)
+                self._wire_digest_parts.append(part)
+                self._wire_digest_n += len(cent_rows)
             self._staged_n += len(cent_rows)
 
     def import_set(self, name: str, tags: tuple[str, ...],
@@ -1075,7 +1210,9 @@ class MetricTable:
     # device step
 
     def device_step(self, final: bool = False) -> None:
-        """Push all staged samples to the device as batched updates.
+        """Push all staged samples to the device as batched updates
+        (serial form: detach + apply back-to-back; the pipelined path
+        is take_staged/apply_staged).
 
         Counters and gauges are pre-combined on host into dense per-row
         vectors (duplicate rows collapse — legal because counter merge
@@ -1089,120 +1226,200 @@ class MetricTable:
         swap) or past ``histo_merge_samples`` — per-step digest merges
         multiply cluster work by the number of steps per interval, and
         whole-interval set batches dedup into the register plane."""
+        w = self._detach_staged(final)
+        if w.empty:
+            return
+        with self._device_lock:
+            self._apply_work(w)
+
+    def take_staged(self, final: bool = False) -> _StagedWork | None:
+        """Pipelined half 1: detach the staging buffers in O(µs) and
+        pin the current interval state.  MUST run under the same lock
+        that serializes ingest and begin_swap — the pending count it
+        bumps is what complete_swap waits out, so the bump has to be
+        atomic with the detach (a swap slipping between them could
+        snapshot before this work lands and lose its samples)."""
+        w = self._detach_staged(final)
+        if w.empty:
+            return None
+        with self._pending_cv:
+            w.state.pending += 1
+        return w
+
+    def apply_staged(self, w: _StagedWork) -> None:
+        """Pipelined half 2: run the detached work's host concat/hash
+        and jitted dispatch OUTSIDE the ingest lock.  Any thread may
+        call it; applies serialize on the table's device lock.  Order
+        between two mid-interval applies is immaterial — every staged
+        family merges associatively (counter add, gauge last-write
+        only ships in the single final work, digest merge order only
+        perturbs centroid placement, set register max) — and the
+        pinned state guarantees the right interval."""
+        try:
+            with self._device_lock:
+                self._apply_work(w)
+        finally:
+            with self._pending_cv:
+                w.state.pending -= 1
+                self._pending_cv.notify_all()
+
+    def _detach_staged(self, final: bool) -> _StagedWork:
+        """Hand off staging buffers for one apply.  Runs under the
+        ingest lock and does NO concatenation, hashing, or device
+        work: dense buffers swap for zeroed ones, list staging swaps
+        for empty lists — the O(n) work happens in _apply_work."""
         c = self.config
+        w = _StagedWork()
+        w.state = self._state
+        w.final = final
+        w.counter = w.gauge = w.histo = w.digest = None
+        w.wire_parts = w.set_parts = w.stats_parts = None
+        w.set_import = None
         self._staged_n = 0
         # counters/gauges are DENSE per-row interval accumulators —
         # nothing grows with sample count — so their single O(R) ship
         # happens once, at the swap, not per device step (mid-interval
         # ships doubled the h2d bytes for zero benefit)
         if self._counter_dirty and final:
-            self._ensure_fresh("counter")
-            self.counters = _counter_dense_step(
-                self.counters, self._counter_dense.astype(np.float32))
-            self._counter_dense.fill(0.0)
+            w.counter = self._counter_dense
+            self._counter_dense = np.zeros(c.counter_rows, np.float64)
             self._counter_dirty = False
-
         if self._gauge_dirty and final:
-            self._ensure_fresh("gauge")
-            # .copy(): the h2d transfer is async and the staging buffer
-            # is mutated by the very next ingest
-            self.gauges = _gauge_dense_step(
-                self.gauges, self._gauge_dense.copy(),
-                self._gauge_mask.astype(bool))
-            self._gauge_mask.fill(0)
+            w.gauge = (self._gauge_dense, self._gauge_mask)
+            self._gauge_dense = np.zeros(c.gauge_rows, np.float32)
+            self._gauge_mask = np.zeros(c.gauge_rows, np.uint8)
             self._gauge_dirty = False
-
-        if final or len(self._histo_stage) >= c.histo_merge_samples:
-            batch = self._histo_stage.take()
-            if batch is not None:
-                self._histo_device_step(*batch, with_stats=True)
-
-        if final or len(self._digest_stage) >= c.histo_merge_samples:
-            batch = self._digest_stage.take()
-            if batch is not None:
-                self._histo_device_step(*batch, with_stats=False)
-
+        if self._histo_stage.rows and (
+                final or
+                len(self._histo_stage) >= c.histo_merge_samples):
+            w.histo = self._histo_stage
+            self._histo_stage = _Staging()
+        if self._digest_stage.rows and (
+                final or
+                len(self._digest_stage) >= c.histo_merge_samples):
+            w.digest = self._digest_stage
+            self._digest_stage = _Staging()
+        if self._wire_digest_parts and (
+                final or self._wire_digest_n >= c.histo_merge_samples):
+            w.wire_parts = self._wire_digest_parts
+            self._wire_digest_parts = []
+            self._wire_digest_n = 0
         staged_sets = (len(self._set_rows) +
                        sum(len(r) for r in self._set_pos_rows))
         if (staged_sets and
                 (final or staged_sets >= c.histo_merge_samples)):
+            w.set_parts = (self._set_rows, self._set_members,
+                           self._set_pos_rows, self._set_pos)
+            self._set_rows, self._set_members = [], []
+            self._set_pos_rows, self._set_pos = [], []
+        # Import-side staging flushes at the swap like the digest
+        # stage: a global node receiving K wire lists per interval
+        # otherwise pays K small dispatches (and, for sets, ships
+        # every list's register planes separately — the cross-list
+        # dedup collapsed 64 MB/interval to ~2 MB once deferred).
+        # Size gates bound host staging between swaps.
+        if self._stats_import_parts and (
+                final or
+                sum(len(p[0]) for p in self._stats_import_parts)
+                >= (1 << 16)):
+            w.stats_parts = self._stats_import_parts
+            self._stats_import_parts = []
+        if (final and self._set_import_touched is not None and
+                self._set_import_touched.any()):
+            w.set_import = (self._set_import_plane,
+                            self._set_import_touched)
+            self._set_import_plane = None
+            self._set_import_touched = None
+        w.empty = (w.counter is None and w.gauge is None and
+                   w.histo is None and w.digest is None and
+                   w.wire_parts is None and w.set_parts is None and
+                   w.stats_parts is None and w.set_import is None)
+        return w
+
+    def _apply_work(self, w: _StagedWork) -> None:
+        """Apply detached staging to its pinned interval state: the
+        concat/hash host work and every jitted dispatch — everything
+        the ingest lock must NOT cover.  Caller holds _device_lock."""
+        st = w.state
+        c = self.config
+        if w.counter is not None:
+            self._ensure_fresh(st, "counter")
+            st.counters = _counter_dense_step(
+                st.counters, w.counter.astype(np.float32))
+        if w.gauge is not None:
+            dense, mask = w.gauge
+            self._ensure_fresh(st, "gauge")
+            st.gauges = _gauge_dense_step(st.gauges, dense,
+                                          mask.astype(bool))
+        if w.histo is not None:
+            batch = w.histo.take()
+            if batch is not None:
+                self._histo_device_step(st, *batch, with_stats=True)
+        if w.digest is not None:
+            batch = w.digest.take()
+            if batch is not None:
+                self._histo_device_step(st, *batch, with_stats=False)
+        if w.wire_parts:
+            self._wire_digest_step(st, w.wire_parts)
+        if w.set_parts is not None:
+            set_rows, set_members, pos_rows, pos = w.set_parts
             parts_rows, parts_pos = [], []
-            if self._set_rows:
-                idx, rank = hashing.hash_members(self._set_members)
-                parts_rows.append(np.asarray(self._set_rows, np.int32))
+            if set_rows:
+                idx, rank = hashing.hash_members(set_members)
+                parts_rows.append(np.asarray(set_rows, np.int32))
                 parts_pos.append(hll.pack_positions(idx, rank))
-                self._set_rows, self._set_members = [], []
-            if self._set_pos_rows:
-                parts_rows.extend(self._set_pos_rows)
-                parts_pos.extend(self._set_pos)
-                self._set_pos_rows, self._set_pos = [], []
+            parts_rows.extend(pos_rows)
+            parts_pos.extend(pos)
             srows = np.concatenate(parts_rows)
             spos = np.concatenate(parts_pos)
             if c.set_rows * hll.M <= c.host_set_plane_max_bytes:
                 # device-free path: fold into the host plane; the
                 # flusher estimates/forwards from it directly
-                self._hll_host_fold(srows, spos)
-            elif not self._hll_plane_step(srows, spos):
-                self._ensure_fresh("hll")
-                self._hll_device_touched = True
+                self._hll_host_fold(st, srows, spos)
+            elif not self._hll_plane_step(st, srows, spos):
+                self._ensure_fresh(st, "hll")
+                st.hll_device_touched = True
                 b = _bucket_len(len(srows))
-                self.hll_regs = _hll_step_packed(
-                    self.hll_regs,
+                st.hll_regs = _hll_step_packed(
+                    st.hll_regs,
                     jnp.asarray(_pad_np(srows, b, c.set_rows)),
                     jnp.asarray(_pad_np(spos, b, 0)))
-
-        # Import-side staging flushes at the swap like the digest
-        # stage: a global node receiving K wire lists per interval
-        # otherwise pays K small dispatches (and, for sets, ships
-        # every list's register planes separately — the cross-list
-        # dedup below collapsed 64 MB/interval to ~2 MB once
-        # deferred).  Size gates bound host staging between swaps.
-        if self._stats_import_parts and (
-                final or
-                sum(len(p[0]) for p in self._stats_import_parts)
-                >= (1 << 16)):
-            rows = np.concatenate(
-                [p[0] for p in self._stats_import_parts])
-            vals = np.concatenate(
-                [p[1] for p in self._stats_import_parts])
-            self._stats_import_parts = []
+        if w.stats_parts is not None:
+            rows = np.concatenate([p[0] for p in w.stats_parts])
+            vals = np.concatenate([p[1] for p in w.stats_parts])
             # padding row ids are out of bounds -> dropped by the
             # scatter, so padding row contents never participate
             b = _bucket_len(len(rows), wide=True)
             padded = np.zeros((b, vals.shape[1]), np.float32)
             padded[:len(vals)] = vals
-            self._ensure_fresh("histo")
-            self.histo_import_stats = _histo_stats_merge(
-                self.histo_import_stats,
+            self._ensure_fresh(st, "histo")
+            st.histo_import_stats = _histo_stats_merge(
+                st.histo_import_stats,
                 jnp.asarray(_pad_np(rows, b, c.histo_rows)),
                 jnp.asarray(padded))
-
-        if (final and self._set_import_touched is not None and
-                self._set_import_touched.any()):
+        if w.set_import is not None:
+            plane, touched = w.set_import
             # imports fold into the host plane at receive time, so
             # the swap ships just the touched rows, pre-deduped (a
             # fleet of locals forwards the SAME series: K received
             # planes for U series ship as U rows, not K)
-            rows = np.nonzero(self._set_import_touched)[0].astype(
-                np.int32)
-            regs = self._set_import_plane[rows]
-            self._set_import_plane[rows] = 0
-            self._set_import_touched[:] = False
-            self._hll_device_touched = True
+            rows = np.nonzero(touched)[0].astype(np.int32)
+            regs = plane[rows]
+            st.hll_device_touched = True
             # wide rows (16 KiB each): small bucket floor, padding a
             # 256-row plane for one import would cost 4 MiB of
             # host->device bandwidth per flush
             b = _bucket_len(len(rows), wide=True)
             padded = np.zeros((b, regs.shape[1]), np.uint8)
             padded[:len(regs)] = regs
-            self._ensure_fresh("hll")
-            self.hll_regs = _hll_merge_rows(
-                self.hll_regs,
+            self._ensure_fresh(st, "hll")
+            st.hll_regs = _hll_merge_rows(
+                st.hll_regs,
                 jnp.asarray(_pad_np(rows, b, c.set_rows)),
                 jnp.asarray(padded))
 
-    def _histo_device_step(self, rows: np.ndarray, vals: np.ndarray,
-                           wts: np.ndarray,
+    def _histo_device_step(self, st: _IntervalState, rows: np.ndarray,
+                           vals: np.ndarray, wts: np.ndarray,
                            with_stats: bool = True) -> None:
         """Histo ingest: ONE fused device pass per batch — ranked
         scatter into dense planes, local aggregates folded as plane
@@ -1217,8 +1434,8 @@ class MetricTable:
         # skip shipping the weights column entirely
         unit = bool(np.all(wts == 1.0))
         if with_stats and self._lib is not None and len(rows):
-            handled, spill = self._histo_plane_step(rows, vals, wts,
-                                                    unit)
+            handled, spill = self._histo_plane_step(st, rows, vals,
+                                                    wts, unit)
             if handled:
                 if spill is None:
                     return
@@ -1233,7 +1450,8 @@ class MetricTable:
         rank, max_count = self._rank(rows)
         eff = self._eff_histo_slots
         if max_count <= eff:
-            self._digest_merge(rows, vals, wts, rank, unit, with_stats)
+            self._digest_merge(st, rows, vals, wts, rank, unit,
+                               with_stats)
             return
         # Deep batch (a row carries more samples than one merge
         # width): fold the local aggregates on host once (exact), then
@@ -1246,19 +1464,20 @@ class MetricTable:
         # compile variants and h2d bytes is worth its lossier
         # collapse-then-merge accuracy.
         if with_stats:
-            self._host_stats_fold(rows, vals, wts)
+            self._host_stats_fold(st, rows, vals, wts)
             with_stats = False
         n_chunks = -(-max_count // eff)
         if n_chunks > 64:
             rows, vals, wts = self._host_precluster(rows, vals, wts)
             rank, max_count = self._rank(rows)
             if max_count <= eff:
-                self._digest_merge(rows, vals, wts, rank, False, False)
+                self._digest_merge(st, rows, vals, wts, rank, False,
+                                   False)
                 return
             n_chunks = -(-max_count // eff)
-        self._digest_merge_scan(rows, vals, wts, rank, n_chunks)
+        self._digest_merge_scan(st, rows, vals, wts, rank, n_chunks)
 
-    def _host_stats_fold(self, rows, vals, wts) -> None:
+    def _host_stats_fold(self, st, rows, vals, wts) -> None:
         """Fold a batch's per-row local aggregates into the device
         stats plane from HOST-computed exact values (numpy bincount
         reductions) — used when the batch bypasses the plane step but
@@ -1279,9 +1498,9 @@ class MetricTable:
             rows[nz], weights=wts[nz] / vals[nz], minlength=R)[:R]
         np.minimum.at(batch[:, segment.STAT_MIN], rows, vals)
         np.maximum.at(batch[:, segment.STAT_MAX], rows, vals)
-        self._ensure_fresh("histo")
-        self.histo_stats = _histo_stats_fold(
-            self.histo_stats, jnp.asarray(batch))
+        self._ensure_fresh(st, "histo")
+        st.histo_stats = _histo_stats_fold(
+            st.histo_stats, jnp.asarray(batch))
 
     def _host_precluster(self, rows, vals, wts
                          ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -1316,7 +1535,7 @@ class MetricTable:
                 (cwv / np.maximum(cw_sum, 1e-30)).astype(np.float32),
                 cw_sum.astype(np.float32))
 
-    def _histo_plane_step(self, rows, vals, wts, unit):
+    def _histo_plane_step(self, st, rows, vals, wts, unit):
         """Host-densified plane ingest (native vtpu_dense_plane +
         tdigest.ingest_plane_pre*): ships a dense value plane instead
         of 12 bytes/sample.  Three transfer reductions compose here:
@@ -1406,19 +1625,19 @@ class MetricTable:
         batch_stats = batch_stats.astype(np.float32)
         if f16:
             plane_v = plane_v.astype(np.float16)
-        self._ensure_fresh("histo")
+        self._ensure_fresh(st, "histo")
         if unit:
-            (self.histo_means, self.histo_weights,
-             self.histo_stats) = tdigest.ingest_plane_pre_unit(
-                self.histo_means, self.histo_weights,
-                self.histo_stats, jnp.asarray(batch_stats),
+            (st.histo_means, st.histo_weights,
+             st.histo_stats) = tdigest.ingest_plane_pre_unit(
+                st.histo_means, st.histo_weights,
+                st.histo_stats, jnp.asarray(batch_stats),
                 jnp.asarray(counts), jnp.asarray(plane_v),
                 compression=c.compression)
         else:
-            (self.histo_means, self.histo_weights,
-             self.histo_stats) = tdigest.ingest_plane_pre(
-                self.histo_means, self.histo_weights,
-                self.histo_stats, jnp.asarray(batch_stats),
+            (st.histo_means, st.histo_weights,
+             st.histo_stats) = tdigest.ingest_plane_pre(
+                st.histo_means, st.histo_weights,
+                st.histo_stats, jnp.asarray(batch_stats),
                 jnp.asarray(plane_v), jnp.asarray(plane_w),
                 compression=c.compression)
         if spill:
@@ -1428,24 +1647,24 @@ class MetricTable:
                 else ov_wts[:spill].copy())
         return True, None
 
-    def _hll_host_fold(self, rows: np.ndarray, pos: np.ndarray) -> None:
+    def _hll_host_fold(self, st: _IntervalState, rows: np.ndarray,
+                       pos: np.ndarray) -> None:
         """Fold packed member positions into the persistent host
         register plane for this interval — no device dispatch at all
         (see TableConfig.host_set_plane_max_bytes)."""
         c = self.config
-        if self._hll_host_plane is None:
+        if st.hll_host_plane is None:
             if self._plane_pool:
-                self._hll_host_plane = self._plane_pool.pop()
+                st.hll_host_plane = self._plane_pool.pop()
             else:
-                self._hll_host_plane = np.zeros((c.set_rows, hll.M),
-                                                np.uint8)
+                st.hll_host_plane = np.zeros((c.set_rows, hll.M),
+                                             np.uint8)
             if self._lib is not None:
                 # all-zero row: every register counts in ez and
                 # contributes 2^0 to the inverse-power sum
-                self._hll_host_ez = np.full(c.set_rows, hll.M,
-                                            np.int32)
-                self._hll_host_inv = np.full(c.set_rows, float(hll.M),
-                                             np.float64)
+                st.hll_host_ez = np.full(c.set_rows, hll.M, np.int32)
+                st.hll_host_inv = np.full(c.set_rows, float(hll.M),
+                                          np.float64)
         rows = np.ascontiguousarray(rows, np.int32)
         pos = np.ascontiguousarray(pos, np.int32)
         if self._lib is not None:
@@ -1454,16 +1673,16 @@ class MetricTable:
             self._lib.vtpu_hll_plane_stats(
                 rows.ctypes.data_as(i32p), pos.ctypes.data_as(i32p),
                 len(rows), c.set_rows, hll.M,
-                self._hll_host_plane.ctypes.data_as(
+                st.hll_host_plane.ctypes.data_as(
                     ct.POINTER(ct.c_uint8)),
-                self._hll_host_inv.ctypes.data_as(
+                st.hll_host_inv.ctypes.data_as(
                     ct.POINTER(ct.c_double)),
-                self._hll_host_ez.ctypes.data_as(i32p))
+                st.hll_host_ez.ctypes.data_as(i32p))
             return
         idx = pos >> 6
         rank = (pos & 0x3F).astype(np.uint8)
         live = (rows >= 0) & (rows < c.set_rows)
-        np.maximum.at(self._hll_host_plane,
+        np.maximum.at(st.hll_host_plane,
                       (rows[live], idx[live]), rank[live])
 
     def _recycle_plane(self, plane: np.ndarray) -> None:
@@ -1477,8 +1696,8 @@ class MetricTable:
             plane.fill(0)
             self._plane_pool.append(plane)
 
-    def _hll_plane_step(self, rows: np.ndarray, pos: np.ndarray
-                        ) -> bool:
+    def _hll_plane_step(self, st: _IntervalState, rows: np.ndarray,
+                        pos: np.ndarray) -> bool:
         """Fold the interval's packed member positions into a host
         register plane (native vtpu_hll_plane) and union it on device
         with one elementwise max — ships R*16384 plane bytes instead
@@ -1498,24 +1717,29 @@ class MetricTable:
             rows.ctypes.data_as(i32p), pos.ctypes.data_as(i32p), n,
             c.set_rows, hll.M,
             plane.ctypes.data_as(ct.POINTER(ct.c_uint8)))
-        self._ensure_fresh("hll")
-        self._hll_device_touched = True
-        self.hll_regs = _hll_union_plane(self.hll_regs,
-                                         jnp.asarray(plane))
+        self._ensure_fresh(st, "hll")
+        st.hll_device_touched = True
+        st.hll_regs = _hll_union_plane(st.hll_regs,
+                                       jnp.asarray(plane))
         return True
 
-    def _rank(self, rows: np.ndarray) -> tuple[np.ndarray, int]:
-        """Within-row occurrence rank + max per-row count."""
+    def _rank(self, rows: np.ndarray,
+              num_rows: int | None = None) -> tuple[np.ndarray, int]:
+        """Within-row occurrence rank + max per-row count.  ``rows``
+        may be local (subset) indices when ``num_rows`` bounds them —
+        the wire-stack builder ranks within union-row space."""
         n = len(rows)
+        if num_rows is None:
+            num_rows = self.config.histo_rows
         rows = np.ascontiguousarray(rows, np.int32)
         if self._lib is not None:
             import ctypes as ct
             i32p = ct.POINTER(ct.c_int32)
-            counts = np.zeros(self.config.histo_rows, np.int32)
+            counts = np.zeros(num_rows, np.int32)
             rank = np.empty(n, np.int32)
             self._lib.vtpu_rank(
                 rows.ctypes.data_as(i32p), n,
-                self.config.histo_rows,
+                num_rows,
                 counts.ctypes.data_as(i32p),
                 rank.ctypes.data_as(i32p))
             return rank, int(counts.max(initial=0))
@@ -1529,10 +1753,10 @@ class MetricTable:
         rank[order] = np.arange(n) - start
         return rank, int(rank.max(initial=-1)) + 1
 
-    def _digest_merge(self, rows, vals, wts, rank, unit,
+    def _digest_merge(self, st, rows, vals, wts, rank, unit,
                       with_stats) -> None:
         c = self.config
-        self._ensure_fresh("histo")
+        self._ensure_fresh(st, "histo")
         b = _bucket_len(len(rows))
         vals_dev = jnp.asarray(_pad_np(vals, b, 0.0))
         rank_dev = jnp.asarray(_pad_np(rank, b, 0))
@@ -1562,43 +1786,43 @@ class MetricTable:
             if unit:
                 fn = (tdigest.ingest_ranked_unit_rows if sub
                       else tdigest.ingest_ranked_unit)
-                args = (self.histo_means, self.histo_weights,
-                        self.histo_stats)
+                args = (st.histo_means, st.histo_weights,
+                        st.histo_stats)
                 args += (idx_dev,) if sub else ()
-                (self.histo_means, self.histo_weights,
-                 self.histo_stats) = fn(
+                (st.histo_means, st.histo_weights,
+                 st.histo_stats) = fn(
                     *args, rows_dev, rank_dev, vals_dev,
                     slots=slots, compression=c.compression)
             else:
                 fn = (tdigest.ingest_ranked_rows if sub
                       else tdigest.ingest_ranked)
-                args = (self.histo_means, self.histo_weights,
-                        self.histo_stats)
+                args = (st.histo_means, st.histo_weights,
+                        st.histo_stats)
                 args += (idx_dev,) if sub else ()
-                (self.histo_means, self.histo_weights,
-                 self.histo_stats) = fn(
+                (st.histo_means, st.histo_weights,
+                 st.histo_stats) = fn(
                     *args, rows_dev, rank_dev, vals_dev,
                     jnp.asarray(_pad_np(wts, b, 0.0)),
                     slots=slots, compression=c.compression)
         elif unit:
             fn = (tdigest.add_samples_ranked_unit_rows if sub
                   else tdigest.add_samples_ranked_unit)
-            args = (self.histo_means, self.histo_weights)
+            args = (st.histo_means, st.histo_weights)
             args += (idx_dev,) if sub else ()
-            self.histo_means, self.histo_weights = fn(
+            st.histo_means, st.histo_weights = fn(
                 *args, rows_dev, rank_dev, vals_dev, slots=slots,
                 compression=c.compression)
         else:
             fn = (tdigest.add_samples_ranked_rows if sub
                   else tdigest.add_samples_ranked)
-            args = (self.histo_means, self.histo_weights)
+            args = (st.histo_means, st.histo_weights)
             args += (idx_dev,) if sub else ()
-            self.histo_means, self.histo_weights = fn(
+            st.histo_means, st.histo_weights = fn(
                 *args, rows_dev, rank_dev, vals_dev,
                 jnp.asarray(_pad_np(wts, b, 0.0)),
                 slots=slots, compression=c.compression)
 
-    def _digest_merge_scan(self, rows, vals, wts, rank,
+    def _digest_merge_scan(self, st, rows, vals, wts, rank,
                            n_chunks: int) -> None:
         """Digest-only merge of a deep batch (per-row counts beyond
         one merge width) in ONE device dispatch: lax.scan merges an
@@ -1615,7 +1839,7 @@ class MetricTable:
         slice+merge).  Skewed deep batches (plane would blow past 2x
         the flat bytes) keep the flat scatter-scan."""
         c = self.config
-        self._ensure_fresh("histo")
+        self._ensure_fresh(st, "histo")
         eff = self._eff_histo_slots
         nc = 1 << max(0, (n_chunks - 1).bit_length())
         uniq = np.unique(rows)
@@ -1636,16 +1860,16 @@ class MetricTable:
             if sub:
                 idx_dev = jnp.asarray(_pad_np(
                     uniq.astype(np.int32), mb, c.histo_rows))
-                self.histo_means, self.histo_weights = \
+                st.histo_means, st.histo_weights = \
                     tdigest.merge_dense_scan_rows(
-                        self.histo_means, self.histo_weights,
+                        st.histo_means, st.histo_weights,
                         idx_dev, jnp.asarray(plane_v),
                         jnp.asarray(plane_w), slots=eff,
                         n_chunks=nc, compression=c.compression)
             else:
-                self.histo_means, self.histo_weights = \
+                st.histo_means, st.histo_weights = \
                     tdigest.merge_dense_scan(
-                        self.histo_means, self.histo_weights,
+                        st.histo_means, st.histo_weights,
                         jnp.asarray(plane_v), jnp.asarray(plane_w),
                         slots=eff, n_chunks=nc,
                         compression=c.compression)
@@ -1659,74 +1883,188 @@ class MetricTable:
             rows_dev = jnp.asarray(_pad_np(local, b, mb))
             idx_dev = jnp.asarray(_pad_np(
                 uniq.astype(np.int32), mb, c.histo_rows))
-            self.histo_means, self.histo_weights = \
+            st.histo_means, st.histo_weights = \
                 tdigest.add_samples_ranked_scan_rows(
-                    self.histo_means, self.histo_weights, idx_dev,
+                    st.histo_means, st.histo_weights, idx_dev,
                     rows_dev, rank_dev, vals_dev, wts_dev,
                     slots=eff, n_chunks=nc,
                     compression=c.compression)
         else:
             rows_dev = jnp.asarray(_pad_np(rows, b, c.histo_rows))
-            self.histo_means, self.histo_weights = \
+            st.histo_means, st.histo_weights = \
                 tdigest.add_samples_ranked_scan(
-                    self.histo_means, self.histo_weights, rows_dev,
+                    st.histo_means, st.histo_weights, rows_dev,
                     rank_dev, vals_dev, wts_dev,
                     slots=eff, n_chunks=nc,
                     compression=c.compression)
+
+    def _wire_digest_step(self, st: _IntervalState,
+                          parts: list[tuple]) -> None:
+        """Fused global merge: a cycle's decoded wire digests — one
+        (rows, means, weights) part per forwarded MetricList — stack
+        into (n_wires, union_rows, K) centroid planes and fold with
+        ONE jitted call (tdigest.merge_wire_stack_rows: lax.scan over
+        the wire axis, Pallas merge body when the gate engages)
+        instead of one dispatch per wire.
+
+        Per-row merge ORDER is wire arrival order in both the stacked
+        and per-wire modes, and every merge step sees operands of
+        identical width, so the two modes are bit-identical
+        (tests/test_pipeline.py locks this).  Rows deeper than the
+        stack width within one wire spill to the flat ranked path
+        (exact, just not fused); a batch whose union-row bucket
+        exceeds half the plane falls back entirely.
+
+        The default mode is "auto": the stacked scan pays off exactly
+        where the Pallas merge gate engages — each scan step's
+        operand width stays inside the kernel's lane bound, while the
+        flat merge's combined width (sum of all wires' depths) blows
+        past it and drops to the slow chunked fallback.  Where every
+        path is scatter anyway (CPU/GPU) the flat merge does strictly
+        fewer FLOPs, so auto keeps it there."""
+        c = self.config
+        parts = [p for p in parts if len(p[0])]
+        if not parts:
+            return
+        mode = self.fused_import_mode
+        if mode == "auto":
+            mode = ("stack"
+                    if tdigest.resolved_merge_mode() == "pallas"
+                    else "legacy")
+
+        def _flat() -> None:
+            self._histo_device_step(
+                st, np.concatenate([p[0] for p in parts]),
+                np.concatenate([p[1] for p in parts]),
+                np.concatenate([p[2] for p in parts]),
+                with_stats=False)
+
+        if mode == "legacy" or len(parts) == 1:
+            _flat()
+            return
+        uniq = np.unique(np.concatenate([p[0] for p in parts]))
+        mb = _bucket_len(len(uniq))
+        if mb * 2 > c.histo_rows:
+            _flat()
+            return
+        kmax = self._wire_stack_kmax
+        built = []
+        spill = _Staging()
+        kdeep = 0
+        for rows, means, wts in parts:
+            rows = np.ascontiguousarray(rows, np.int32)
+            local = np.searchsorted(uniq, rows).astype(np.int32)
+            rank, maxc = self._rank(local, num_rows=len(uniq))
+            if maxc > kmax:
+                over = rank >= kmax
+                spill.append(rows[over], means[over], wts[over])
+                keep = ~over
+                local, rank = local[keep], rank[keep]
+                means, wts = means[keep], wts[keep]
+                maxc = kmax
+            built.append((local, rank, means, wts))
+            kdeep = max(kdeep, maxc)
+        K = _bucket_len(kdeep, wide=True)
+        idx_dev = jnp.asarray(_pad_np(
+            uniq.astype(np.int32), mb, c.histo_rows))
+        self._ensure_fresh(st, "histo")
+        if mode == "stack":
+            wb = _bucket_len(len(built), wide=True)
+            stack_m = np.zeros((wb, mb, K), np.float32)
+            stack_w = np.zeros((wb, mb, K), np.float32)
+            live = np.zeros(wb, bool)
+            for i, (local, rank, means, wts) in enumerate(built):
+                stack_m[i, local, rank] = means
+                stack_w[i, local, rank] = wts
+                live[i] = True
+            st.histo_means, st.histo_weights = \
+                tdigest.merge_wire_stack_rows(
+                    st.histo_means, st.histo_weights, idx_dev,
+                    jnp.asarray(stack_m), jnp.asarray(stack_w),
+                    jnp.asarray(live), compression=c.compression)
+        else:
+            # per-wire reference mode (VENEUR_TPU_FUSED_IMPORT=0):
+            # same kernel, same union rows and width, one wire per
+            # call — the bit-exact baseline the fused mode is tested
+            # against, and the escape hatch if the fusion misbehaves
+            wb = _MIN_BUCKET_WIDE
+            live = np.zeros(wb, bool)
+            live[0] = True
+            live_dev = jnp.asarray(live)
+            for local, rank, means, wts in built:
+                stack_m = np.zeros((wb, mb, K), np.float32)
+                stack_w = np.zeros((wb, mb, K), np.float32)
+                stack_m[0, local, rank] = means
+                stack_w[0, local, rank] = wts
+                st.histo_means, st.histo_weights = \
+                    tdigest.merge_wire_stack_rows(
+                        st.histo_means, st.histo_weights, idx_dev,
+                        jnp.asarray(stack_m), jnp.asarray(stack_w),
+                        live_dev, compression=c.compression)
+        batch = spill.take()
+        if batch is not None:
+            self._histo_device_step(st, *batch, with_stats=False)
 
     # ------------------------------------------------------------------
     # flush boundary
 
     def swap(self) -> Snapshot:
         """End the interval: push remaining staging, hand the device
-        arrays to the caller, re-seed fresh state, maybe compact."""
-        self.device_step(final=True)
+        arrays to the caller, re-seed fresh state, maybe compact.
+        Serial form of begin_swap + complete_swap."""
+        return self.complete_swap(self.begin_swap())
+
+    def begin_swap(self) -> _PendingSwap:
+        """Swap half 1, under the caller's ingest lock: detach the
+        final staging (O(µs), no device work), capture the interval's
+        row metadata, install a fresh interval state, bump the
+        generation, and run end-of-interval index compaction.  The
+        heavy device apply and snapshot assembly happen in
+        complete_swap, which the pipelined flush runs OUTSIDE the
+        ingest lock so ingest into the new interval proceeds while
+        the old interval's final merge and readback are in flight."""
+        st = self._state
+        work = self._detach_staged(final=True)
         # the native ingest marks touched[] but defers last_gen (gen is
         # constant within an interval, so one vectorized stamp here is
         # equivalent to stamping per batch)
         for idx in (self.counter_idx, self.gauge_idx, self.histo_idx,
                     self.set_idx):
             idx.last_gen[idx.touched] = self.gen
-        snap = Snapshot(
-            gen=self.gen,
-            counters=self.counters,
-            counter_meta=list(self.counter_idx.meta),
-            counter_touched=self.counter_idx.touched.copy(),
-            gauges=self.gauges,
-            gauge_meta=list(self.gauge_idx.meta),
-            gauge_touched=self.gauge_idx.touched.copy(),
-            histo_stats=self.histo_stats,
-            histo_import_stats=self.histo_import_stats,
-            histo_means=self.histo_means,
-            histo_weights=self.histo_weights,
-            histo_meta=list(self.histo_idx.meta),
-            histo_touched=self.histo_idx.touched.copy(),
-            hll_regs=self.hll_regs,
-            set_meta=list(self.set_idx.meta),
-            set_touched=self.set_idx.touched.copy(),
-            hll_host_plane=self._hll_host_plane,
-            hll_device_touched=self._hll_device_touched,
-            hll_host_ez=self._hll_host_ez,
-            hll_host_inv=self._hll_host_inv,
-            recycle=self._recycle_plane,
-            overflow={
-                "counter": self.counter_idx.overflow,
-                "gauge": self.gauge_idx.overflow,
-                "histo": self.histo_idx.overflow,
-                "set": self.set_idx.overflow,
-            },
-        )
-        # the host set plane belongs to the snapshot now
-        self._hll_host_plane = None
-        self._hll_host_ez = None
-        self._hll_host_inv = None
-        self._hll_device_touched = False
-        # the old planes belong to the snapshot now; fresh ones are
-        # allocated lazily on first touch (see _ensure_fresh) — a
-        # snapshot of an untouched type keeps referencing the pristine
-        # plane, which is never donated because the first touch of the
-        # NEXT interval allocates a new one before any donating update
-        self._fresh = set(self._KINDS)
+        pend = _PendingSwap()
+        pend.work = work
+        pend.state = st
+        pend.counter_meta = list(self.counter_idx.meta)
+        pend.counter_touched = self.counter_idx.touched.copy()
+        pend.gauge_meta = list(self.gauge_idx.meta)
+        pend.gauge_touched = self.gauge_idx.touched.copy()
+        pend.histo_meta = list(self.histo_idx.meta)
+        pend.histo_touched = self.histo_idx.touched.copy()
+        pend.set_meta = list(self.set_idx.meta)
+        pend.set_touched = self.set_idx.touched.copy()
+        pend.overflow = {
+            "counter": self.counter_idx.overflow,
+            "gauge": self.gauge_idx.overflow,
+            "histo": self.histo_idx.overflow,
+            "set": self.set_idx.overflow,
+        }
+        # the old planes belong to the outgoing state (and, soon, its
+        # snapshot); the new interval ADOPTS the array references with
+        # every kind marked fresh — new zeroed planes are allocated
+        # lazily on first touch (see _ensure_fresh), so an untouched
+        # type's snapshot keeps referencing the pristine plane, which
+        # is never donated because the first touch of the NEXT
+        # interval allocates a new one before any donating update
+        ns = _IntervalState(self.gen + 1)
+        ns.counters = st.counters
+        ns.gauges = st.gauges
+        ns.histo_stats = st.histo_stats
+        ns.histo_import_stats = st.histo_import_stats
+        ns.histo_means = st.histo_means
+        ns.histo_weights = st.histo_weights
+        ns.hll_regs = st.hll_regs
+        ns.fresh = set(self._KINDS)
+        self._state = ns
         self.gen += 1
         compacted = False
         for idx in (self.counter_idx, self.gauge_idx, self.histo_idx,
@@ -1766,7 +2104,47 @@ class MetricTable:
             # the same renumbered rows — drop it; the next wire list
             # re-resolves through the slow path
             self.import_row_cache.clear()
-        return snap
+        return pend
+
+    def complete_swap(self, pend: _PendingSwap) -> Snapshot:
+        """Swap half 2 — needs no ingest lock.  Waits out any
+        in-flight pipelined applies still targeting the outgoing
+        interval (the pending count only reaches zero once every
+        pre-swap take_staged has landed — that, plus work pinning its
+        state object, is the generation guarantee: no sample lost, no
+        sample double-counted across the buffer swap), applies the
+        final detached staging, and assembles the snapshot."""
+        with self._pending_cv:
+            while pend.state.pending:
+                self._pending_cv.wait()
+        if not pend.work.empty:
+            with self._device_lock:
+                self._apply_work(pend.work)
+        st = pend.state
+        return Snapshot(
+            gen=st.gen,
+            counters=st.counters,
+            counter_meta=pend.counter_meta,
+            counter_touched=pend.counter_touched,
+            gauges=st.gauges,
+            gauge_meta=pend.gauge_meta,
+            gauge_touched=pend.gauge_touched,
+            histo_stats=st.histo_stats,
+            histo_import_stats=st.histo_import_stats,
+            histo_means=st.histo_means,
+            histo_weights=st.histo_weights,
+            histo_meta=pend.histo_meta,
+            histo_touched=pend.histo_touched,
+            hll_regs=st.hll_regs,
+            set_meta=pend.set_meta,
+            set_touched=pend.set_touched,
+            hll_host_plane=st.hll_host_plane,
+            hll_device_touched=st.hll_device_touched,
+            hll_host_ez=st.hll_host_ez,
+            hll_host_inv=st.hll_host_inv,
+            recycle=self._recycle_plane,
+            overflow=pend.overflow,
+        )
 
     def take_status(self):
         out = self.status

@@ -215,6 +215,15 @@ class Telemetry:
             dev["readback_bytes_total"]
         count("veneur.device.readback_bytes_total",
               self._delta("device_readback_bytes"))
+        # persistent compilation cache traffic: hits are compiles the
+        # disk cache absorbed (startup/restart cost, not steady-state)
+        self.server.stats["xla_cache_hits"] = dev["compile_cache_hits"]
+        self.server.stats["xla_cache_misses"] = \
+            dev["compile_cache_misses"]
+        count("veneur.xla.compile_cache_hits",
+              self._delta("xla_cache_hits"))
+        count("veneur.xla.compile_cache_misses",
+              self._delta("xla_cache_misses"))
         if self.server.config.count_unique_timeseries:
             # touched-row counts ARE the unique-timeseries tally (the
             # reference's tallyTimeseries HLL exists because worker

@@ -626,7 +626,8 @@ class ImportServer:
         core = self._core
         with core.lock:
             acc, dropped = apply_metric_list_bytes(core.table, request)
-            core._maybe_device_step_locked()
+            work = core._maybe_device_step_locked()
+        core._apply_staged(work)
         core.bump("imports_received", acc)
         core.bump("received_grpc", acc + dropped)
         if dropped:

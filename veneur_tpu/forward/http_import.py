@@ -127,7 +127,65 @@ def decode_body(body: bytes, content_encoding: str = "") -> list[dict]:
     return items
 
 
-def _apply_reference_item(table: MetricTable, it: dict) -> bool:
+class _WireBatch:
+    """One decoded /import body = one wire: its histo items accumulate
+    here and stage as a SINGLE ``import_histo_batch`` part, so a
+    cycle's wires stack into one fused merge kernel call
+    (table._wire_digest_step) instead of one dispatch per series.
+    Validation matches ``import_histo`` item for item — a malformed
+    item raises out of ``add`` before anything is recorded, keeping
+    apply_import's per-item isolation."""
+
+    def __init__(self, table: MetricTable):
+        from veneur_tpu.ops import segment
+        self._table = table
+        self._stat_cols = segment.HISTO_STAT_COLS
+        self._rows: list[int] = []
+        self._stats: list[np.ndarray] = []
+        self._crows: list[np.ndarray] = []
+        self._means: list[np.ndarray] = []
+        self._weights: list[np.ndarray] = []
+
+    def add(self, name: str, mtype: str, tags: tuple[str, ...],
+            stats: np.ndarray, means: np.ndarray, weights: np.ndarray,
+            scope: str = dsd.SCOPE_DEFAULT) -> bool:
+        stats = np.asarray(stats, np.float32)
+        means = np.asarray(means, np.float32)
+        weights = np.asarray(weights, np.float32)
+        if stats.shape != (self._stat_cols,):
+            raise ValueError(f"bad stats shape {stats.shape}")
+        if means.shape != weights.shape or means.ndim != 1:
+            raise ValueError(
+                f"centroid shape mismatch {means.shape}/{weights.shape}")
+        row = self._table.import_histo_row(name, mtype, tags, scope)
+        if row is None:
+            return False
+        self._rows.append(row)
+        self._stats.append(stats)
+        live = weights > 0
+        if live.any():
+            self._crows.append(
+                np.full(int(live.sum()), row, np.int32))
+            self._means.append(means[live])
+            self._weights.append(weights[live])
+        return True
+
+    def stage(self) -> None:
+        if not self._rows:
+            return
+        empty_i = np.empty(0, np.int32)
+        empty_f = np.empty(0, np.float32)
+        self._table.import_histo_batch(
+            np.asarray(self._rows, np.int32),
+            np.stack(self._stats),
+            np.concatenate(self._crows) if self._crows else empty_i,
+            np.concatenate(self._means) if self._means else empty_f,
+            np.concatenate(self._weights) if self._weights
+            else empty_f)
+
+
+def _apply_reference_item(table: MetricTable, it: dict,
+                          batch: "_WireBatch | None" = None) -> bool:
     """Merge one REFERENCE-schema JSONMetric (opaque base64 value;
     the wire a Go local's flushForward produces)."""
     from veneur_tpu.forward import gob_codec, hll_codec
@@ -169,7 +227,8 @@ def _apply_reference_item(table: MetricTable, it: dict) -> bool:
              d["max"] if w else segment.STAT_MAX_EMPTY,
              float((d["means"] * d["weights"]).sum()),
              d["rsum"] if w else 0.0], np.float32)
-        return table.import_histo(
+        add = batch.add if batch is not None else table.import_histo
+        return add(
             name, dsd.TIMER if mtype == "timer" else dsd.HISTOGRAM,
             tags, stats, d["means"], d["weights"])
     if mtype == "set":
@@ -182,6 +241,10 @@ def apply_import(table: MetricTable, items: list[dict]) -> tuple[int, int]:
     (accepted, dropped).  The receiving half of reference
     http.go:63 ImportMetrics / worker.go:438 ImportMetricGRPC."""
     accepted = dropped = 0
+    # this body is one forwarded wire: histo items accumulate into a
+    # single staged part (fused global merge), everything else stages
+    # as before
+    batch = _WireBatch(table)
     for it in items:
         # per-item isolation: one malformed item is dropped-and-counted
         # without aborting the rest of the batch (the reference drops
@@ -191,7 +254,7 @@ def apply_import(table: MetricTable, items: list[dict]) -> tuple[int, int]:
                 # reference JSONMetric: opaque base64 value bytes and
                 # no "kind" field (native items always carry one, and
                 # their counter/gauge "value" is a JSON number)
-                ok = _apply_reference_item(table, it)
+                ok = _apply_reference_item(table, it, batch)
                 accepted += int(ok)
                 dropped += int(not ok)
                 continue
@@ -206,7 +269,7 @@ def apply_import(table: MetricTable, items: list[dict]) -> tuple[int, int]:
             elif kind == "histo":
                 means = _unb64(it["means"], np.float32)
                 weights = _unb64(it["weights"], np.float32)
-                ok = table.import_histo(
+                ok = batch.add(
                     name, it.get("type", dsd.HISTOGRAM), tags,
                     np.asarray(it["stats"], np.float32), means, weights,
                     scope=it.get("scope", dsd.SCOPE_DEFAULT))
@@ -225,4 +288,5 @@ def apply_import(table: MetricTable, items: list[dict]) -> tuple[int, int]:
             continue
         accepted += int(ok)
         dropped += int(not ok)
+    batch.stage()
     return accepted, dropped

@@ -127,6 +127,11 @@ class DeviceCostRegistry:
         self._lock = threading.Lock()
         self._entries: dict[str, _Entry] = {}
         self._readback_bytes = 0
+        # persistent compilation cache traffic (fed by the
+        # jax.monitoring listener utils/compile_cache installs): a hit
+        # is a compile that loaded from disk instead of running XLA
+        self._cache_hits = 0
+        self._cache_misses = 0
 
     def instrument(self, name: str, fn) -> InstrumentedJit:
         with self._lock:
@@ -150,6 +155,14 @@ class DeviceCostRegistry:
         with self._lock:
             self._readback_bytes += int(nbytes)
 
+    def add_cache_hit(self) -> None:
+        with self._lock:
+            self._cache_hits += 1
+
+    def add_cache_miss(self) -> None:
+        with self._lock:
+            self._cache_misses += 1
+
     # ------------------------------------------------------------------
 
     def totals(self) -> dict:
@@ -163,6 +176,8 @@ class DeviceCostRegistry:
                 "dispatch_duration_ns": sum(
                     e.call_ns for e in self._entries.values()),
                 "readback_bytes_total": self._readback_bytes,
+                "compile_cache_hits": self._cache_hits,
+                "compile_cache_misses": self._cache_misses,
             }
 
     def snapshot(self) -> dict:
@@ -172,6 +187,8 @@ class DeviceCostRegistry:
                 "kernels": {name: e.snapshot()
                             for name, e in self._entries.items()},
                 "readback_bytes_total": self._readback_bytes,
+                "compile_cache_hits": self._cache_hits,
+                "compile_cache_misses": self._cache_misses,
             }
 
 

@@ -6,12 +6,21 @@ opens the root ``flush`` span and ``FlushCycle.stage`` hangs one
 child per pipeline stage off it:
 
     flush
-      +- flush.snapshot          table swap under the ingest lock
-      +- flush.device_dispatch   combine/readout jit dispatch (async)
-      +- flush.readback_sync     device_get — the d2h sync point
-      +- flush.host_emit         InterMetric assembly from row metadata
-      +- flush.sink_flush        per-sink fan-out + interval-budget wait
-      +- flush.forward           upstream ship (local tier only)
+      +- flush.snapshot     staging detach + metadata capture under the
+      |                     ingest lock (pipelined: O(µs) begin_swap)
+      +- flush.swap_apply   final combine dispatch after the lock drops
+      |                     (pipelined mode only)
+      +- flush.dispatch     combine/readout jit dispatch (async)
+      +- flush.device_wait  device_get — the d2h sync point
+      +- flush.host_emit    InterMetric assembly from row metadata
+      +- flush.sink_flush   per-sink fan-out + interval-budget wait
+      +- flush.forward      upstream ship (local tier only)
+
+``dispatch`` / ``device_wait`` replaced the old ``device_dispatch`` /
+``readback_sync`` names when dispatch and readback stopped running
+back-to-back; stage timings are recorded under BOTH the new and old
+names (``stage(..., alias=...)``) so dashboards keyed on the old
+``veneur.flush.stage_duration_ns`` series keep working.
 
 Spans go through the server's own loopback trace client, so they flow
 to span sinks (and ssfmetrics extraction) like any user trace.  Each
@@ -43,7 +52,7 @@ class NullCycle:
     record = None
 
     @contextlib.contextmanager
-    def stage(self, name: str):
+    def stage(self, name: str, alias: str | None = None):
         yield _NullSpan()
 
     def add_readback(self, nbytes: int) -> None:
@@ -62,10 +71,12 @@ class FlushCycle:
         self._lock = threading.Lock()
 
     @contextlib.contextmanager
-    def stage(self, name: str):
+    def stage(self, name: str, alias: str | None = None):
         """Time one pipeline stage as a child span of the flush root.
         Safe to enter from pool threads (the forward stage runs on
-        one); re-entering a stage name accumulates its ns."""
+        one); re-entering a stage name accumulates its ns.  ``alias``
+        records the same ns under a legacy stage name too, so renamed
+        stages don't break dashboards keyed on the old series."""
         sp = self.root.child(f"flush.{name}")
         sp.add_tag("stage", name)
         sp.add_tag("veneur.internal", "true")
@@ -80,6 +91,9 @@ class FlushCycle:
             with self._lock:
                 self.record.stages[name] = (
                     self.record.stages.get(name, 0) + dt)
+                if alias is not None:
+                    self.record.stages[alias] = (
+                        self.record.stages.get(alias, 0) + dt)
             sp.finish(self._client)
 
     def add_readback(self, nbytes: int) -> None:

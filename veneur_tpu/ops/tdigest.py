@@ -706,6 +706,49 @@ def merge_dense_scan_rows(means: Array, weights: Array,
             weights.at[row_idx].set(sub_w, mode="drop"))
 
 
+@partial(jax.jit, static_argnames=("compression",),
+         donate_argnums=jitopts.donate(0, 1))
+def merge_wire_stack_rows(means: Array, weights: Array,
+                          row_idx: Array, stack_m: Array,
+                          stack_w: Array, live: Array,
+                          compression: float = DEFAULT_COMPRESSION
+                          ) -> tuple[Array, Array]:
+    """Fused global merge: fold a stack of per-wire centroid planes
+    f32[W, U, K] into the gathered row subset in ONE dispatch — a
+    lax.scan over the wire axis whose body is the same _merge_impl
+    (Pallas-fused when supported(cap, K) engages) the per-wire path
+    runs, in the same order, so the result is bit-identical to W
+    sequential per-wire merges of the same planes.
+
+    ``live`` (bool[W]) masks padding wires: W is bucketed to bound
+    compile variants, and a dead wire's step must be the IDENTITY via
+    lax.cond — merging an all-empty batch is not a no-op (the k-scale
+    cluster pass may still re-cluster adjacent centroids), so a
+    jnp.where over an unconditional merge would corrupt parity."""
+    sub_m = _take_rows(means, row_idx)
+    sub_w = _take_rows(weights, row_idx)
+
+    def step(carry, wire):
+        m, w = carry
+        wm, ww, alive = wire
+
+        def do_merge(operands):
+            m, w, wm, ww = operands
+            return _merge_impl(m, w, wm, ww, compression=compression)
+
+        def skip(operands):
+            m, w, _, _ = operands
+            return m, w
+
+        return jax.lax.cond(alive, do_merge, skip,
+                            (m, w, wm, ww)), None
+
+    (sub_m, sub_w), _ = jax.lax.scan(step, (sub_m, sub_w),
+                                     (stack_m, stack_w, live))
+    return (means.at[row_idx].set(sub_m, mode="drop"),
+            weights.at[row_idx].set(sub_w, mode="drop"))
+
+
 def _combine_row_stats(stats: Array, batch_stats: Array) -> Array:
     """Elementwise fold of per-row batch aggregates (host-accumulated
     by vtpu_dense_plane) into the stats plane — columns follow

@@ -4,12 +4,23 @@ Restart-after-crash (the flush-watchdog model) pays ~0.3s per kernel
 load instead of 20-40s cold compiles when the cache is enabled.  The
 policy knobs (minimum compile time worth persisting) live here so the
 server and the bench can't drift.
+
+``VENEUR_TPU_COMPILE_CACHE`` gates the cache for embedders that go
+through ``enable_from_env``: unset/``1`` uses the per-user default
+directory, ``0``/``off`` disables persistence, any other value is
+taken as the cache directory path.
 """
 
 from __future__ import annotations
 
 import os
 import tempfile
+
+ENV_VAR = "VENEUR_TPU_COMPILE_CACHE"
+
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+_monitoring_installed = False
 
 
 def default_cache_dir() -> str:
@@ -33,4 +44,42 @@ def enable(path: str) -> bool:
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       0.5)
+    install_monitoring()
     return warm
+
+
+def enable_from_env() -> bool | None:
+    """Enable the persistent cache per ``VENEUR_TPU_COMPILE_CACHE``
+    (see module docstring).  Returns the warm flag from ``enable``,
+    or None when the env var disables persistence."""
+    raw = os.environ.get(ENV_VAR, "").strip()
+    if raw.lower() in ("0", "off", "false", "no"):
+        return None
+    if raw in ("", "1", "on", "true", "yes"):
+        return enable(default_cache_dir())
+    return enable(raw)
+
+
+def install_monitoring(registry=None) -> None:
+    """Feed JAX's persistent-cache hit/miss events into the device
+    cost registry so /debug/vars and the bench can distinguish a disk
+    load from a real XLA compile.  Idempotent; safe when the running
+    jax predates the events (the listener just never fires)."""
+    global _monitoring_installed
+    if _monitoring_installed:
+        return
+    if registry is None:
+        from veneur_tpu.observe.devicecost import REGISTRY as registry
+    try:
+        from jax import monitoring
+    except ImportError:
+        return
+
+    def _on_event(event, **kwargs):
+        if event == _HIT_EVENT:
+            registry.add_cache_hit()
+        elif event == _MISS_EVENT:
+            registry.add_cache_miss()
+
+    monitoring.register_event_listener(_on_event)
+    _monitoring_installed = True
