@@ -155,6 +155,10 @@ def _block(table):
 
 STEADY_INTERVALS = 7
 FLUSH_LAG = 2  # intervals a flush readback may trail its swap
+# steady passes per config: the headline is the MEDIAN of the
+# per-pass rates, so one bad host/link window lands on one pass
+# instead of the published number
+BENCH_PASSES = max(1, int(os.environ.get("VENEUR_BENCH_PASSES", "3")))
 
 
 def _ingest_interval(table, bufs, parser):
@@ -230,11 +234,54 @@ def _run_config(bufs, flush_launch, **table_kw):
     def one_ingest():
         total_box[0] += _ingest_interval(table, bufs, parser)
 
-    per_interval, dt, outs = _steady_loop(
+    return _steady_passes(
         one_ingest, lambda: flush_launch(table.swap()),
-        finalize=lambda: _block(table))
-    return (_interval_result(total_box[0], dt, per_interval, cold),
-            outs[-1])
+        lambda: _block(table), total_box, cold)
+
+
+def _steady_passes(one_ingest, one_launch, finalize, total_box, cold):
+    """BENCH_PASSES steady loops over a warm table; returns
+    (_median_pass_result(...), last flush output).  A pass that
+    trips the budget guard ends the sweep early — at least one pass
+    always completes."""
+    passes = []
+    outs_last = None
+    for pn in range(BENCH_PASSES):
+        start = total_box[0]
+        per_interval, dt, outs = _steady_loop(one_ingest, one_launch,
+                                              finalize=finalize)
+        if outs:
+            outs_last = outs[-1]
+        passes.append(_interval_result(total_box[0] - start, dt,
+                                       per_interval, cold))
+        if pn + 1 < BENCH_PASSES and _over_budget():
+            break
+    return _median_pass_result(passes), outs_last
+
+
+def _median_pass_result(passes: list[dict]) -> dict:
+    """Collapse per-pass results: headline rate = median of the pass
+    rates; interval detail comes from the median pass; totals sum
+    over every pass; the raw per-pass intervals are all retained
+    (satellite: the artifact must show what the median summarizes)."""
+    rates = [p["samples_per_sec"] for p in passes]
+    mid = sorted(range(len(rates)), key=lambda i: rates[i])[
+        len(rates) // 2]
+    res = dict(passes[mid])
+    res["samples"] = sum(p["samples"] for p in passes)
+    res["seconds"] = round(sum(p["seconds"] for p in passes), 4)
+    res["samples_per_sec"] = sorted(rates)[len(rates) // 2]
+    if res["seconds"]:
+        res["mean_samples_per_sec"] = round(
+            res["samples"] / res["seconds"], 1)
+    res["pass_rates"] = rates
+    res["passes"] = [
+        {k: p[k] for k in ("samples", "seconds", "samples_per_sec",
+                           "mean_samples_per_sec",
+                           "warm_mean_samples_per_sec",
+                           "interval_seconds", "intervals")}
+        for p in passes]
+    return res
 
 
 def _interval_result(total, dt, per_interval, cold):
@@ -379,16 +426,15 @@ def bench_timers() -> dict:
     flush_launch(table.swap())()
     _block(table)
 
-    ran = [0]
+    total_box = [0]
 
     def timed_ingest():
         one_ingest(table)
-        ran[0] += 1
+        total_box[0] += n
 
-    per_interval, dt, outs = _steady_loop(
+    res, quant = _steady_passes(
         timed_ingest, lambda: flush_launch(table.swap()),
-        finalize=lambda: _block(table))
-    quant = outs[-1]
+        lambda: _block(table), total_box, cold)
 
     errs = {0.5: [], 0.9: [], 0.99: []}
     check = rng.choice(n_series, min(200, n_series), replace=False)
@@ -400,8 +446,6 @@ def bench_timers() -> dict:
             exact = float(np.quantile(sv, p))
             errs[p].append(abs(quant[s, qi] - exact) /
                            max(abs(exact), 1e-9))
-    total = n * ran[0]
-    res = _interval_result(total, dt, per_interval, cold)
     res.update({
         "p50_err_mean": float(np.mean(errs[0.5])),
         "p90_err_mean": float(np.mean(errs[0.9])),
@@ -539,11 +583,9 @@ def bench_global_merge() -> dict:
     def one_ingest():
         total_box[0] += one_interval()
 
-    per_interval, dt, outs = _steady_loop(
+    res_d, (q, est) = _steady_passes(
         one_ingest, lambda: flush_launch(dst.swap()),
-        finalize=lambda: _block(dst))
-    q, est = outs[-1]
-    res_d = _interval_result(total_box[0], dt, per_interval, cold)
+        lambda: _block(dst), total_box, cold)
     # every digest item re-merges raw_per_digest-equivalent samples
     res_d["items"] = res_d.pop("samples")
     res_d["items_per_sec"] = res_d.pop("samples_per_sec")
@@ -1131,6 +1173,93 @@ def sockets_bench() -> dict:
             }
         finally:
             srv.shutdown()
+
+    # ---- multi-reader sweep: SO_REUSEPORT reader scaling on the
+    # fused shard path (readers parse+probe lock-free against the RCU
+    # index, then take the table lock only for the O(touched-rows)
+    # merge).  Loadgen still timeshares the host, so the sweep shows
+    # SCALING SHAPE, not isolated per-reader capacity; the per-reader
+    # breakdown from the device-cost registry shows how evenly the
+    # kernel spread the flows.
+    sweep = {}
+    for n_readers in (1, 2, 4):
+        srv = Server(read_config(data={
+            "statsd_listen_addresses": ["udp://127.0.0.1:0"],
+            "interval": "3s",
+            "hostname": "bench",
+            "num_readers": n_readers,
+            "accelerator_probe_timeout": "5s"}))
+        srv.start()
+        try:
+            port = srv.statsd_ports[0]
+            pkts = []
+            for i in range(4096):
+                lines = [f"svc.req.count.{(i * 25 + j) % 1000}:"
+                         f"{1 + (j % 9)}|c".encode()
+                         for j in range(25)]
+                pkts.append(b"\n".join(lines))
+            stop = threading.Event()
+            sent = [0]
+
+            def blast():
+                socks = []
+                # several source sockets so REUSEPORT's 4-tuple hash
+                # actually spreads flows across the readers
+                for _ in range(8):
+                    s = socket_mod.socket(socket_mod.AF_INET,
+                                          socket_mod.SOCK_DGRAM)
+                    s.connect(("127.0.0.1", port))
+                    socks.append(s)
+                n = 0
+                while not stop.is_set():
+                    for k, p in enumerate(pkts):
+                        try:
+                            socks[k & 7].send(p)
+                        except OSError:
+                            pass
+                        n += 1
+                    sent[0] = n
+                for s in socks:
+                    s.close()
+
+            base_pkts = srv.stats.get("packets_received", 0)
+            base_metrics = srv.stats.get("metrics_processed", 0)
+            # device_costs is the process-global registry and reader
+            # thread names repeat per server, so the breakdown is a
+            # delta against this sweep step's starting counters
+            base_readers = srv.device_costs.snapshot().get(
+                "readers", {})
+            t = threading.Thread(target=blast, daemon=True)
+            t0 = time.perf_counter()
+            t.start()
+            time.sleep(duration)
+            stop.set()
+            t.join(10.0)
+            dt = time.perf_counter() - t0
+            time.sleep(0.5)
+            got_pkts = srv.stats.get("packets_received", 0) - base_pkts
+            got_metrics = (srv.stats.get("metrics_processed", 0) -
+                           base_metrics)
+            readers = srv.device_costs.snapshot().get("readers", {})
+            per_reader = {}
+            for name, r in sorted(readers.items()):
+                b = base_readers.get(name, {})
+                d = {k: r[k] - b.get(k, 0)
+                     for k in ("packets", "samples", "fused_batches",
+                               "batches")}
+                if d["batches"]:
+                    per_reader[name] = d
+            sweep[f"readers_{n_readers}"] = {
+                "seconds": round(dt, 3),
+                "offered_packets": sent[0],
+                "received_packets": got_pkts,
+                "packets_per_sec": round(got_pkts / dt, 1),
+                "metrics_per_sec": round(got_metrics / dt, 1),
+                "per_reader": per_reader,
+            }
+        finally:
+            srv.shutdown()
+    out["reader_sweep"] = sweep
 
     # ---- burst->drain: the receive ceiling isolated from loadgen
     # timesharing.  On a 1-core host rate-vs-loss conflates sender
@@ -1787,6 +1916,39 @@ def _assemble(configs: dict, t_start: float,
     return out
 
 
+def _summary_line(out: dict) -> str:
+    """Compact (<1KB) machine-readable verdict printed AFTER the full
+    blob: the driver captures a bounded tail of stdout, and a long
+    final blob can lose its opening brace to mid-token truncation
+    (that cost round 5 its machine-readable record).  Per-config rate
+    + error only — the full artifact is the line above and the
+    run_*.json on disk."""
+    cfgs = {}
+    for k, v in (out.get("configs") or {}).items():
+        if not isinstance(v, dict):
+            continue
+        row: dict = {}
+        for key in ("samples_per_sec", "items_per_sec",
+                    "packets_per_sec"):
+            if v.get(key) is not None:
+                row["rate"] = v[key]
+                break
+        if v.get("error"):
+            row["error"] = str(v["error"])[:80]
+        if v.get("skipped"):
+            row["skipped"] = True
+        cfgs[k] = row
+    return json.dumps(
+        {"bench_summary": True,
+         "value": out.get("value"),
+         "vs_baseline": out.get("vs_baseline"),
+         "platform": out.get("platform"),
+         "error": (str(out["error"])[:120]
+                   if out.get("error") else None),
+         "configs": cfgs},
+        separators=(",", ":"))
+
+
 def main() -> None:
     """Orchestrator: probe in short retries across the budget, start
     configs the moment a probe succeeds, run each in its own killable
@@ -1802,14 +1964,16 @@ def main() -> None:
         on_attempt=lambda i, rem: print(
             f"# probe attempt {i} ({rem:.0f}s left)", file=sys.stderr))
     if err is not None:
-        print(json.dumps({
+        out = {
             "metric": "aggregation_samples_per_sec_chip",
             "value": None, "unit": "samples/sec", "vs_baseline": None,
             "error": err,
             "platform": "unreachable",
             "platform_pin": _PLATFORM_PIN or None,
             "probe_budget_seconds": round(probe_budget, 1),
-            "wall_seconds": round(time.time() - t_start, 1)}))
+            "wall_seconds": round(time.time() - t_start, 1)}
+        print(json.dumps(out))
+        print(_summary_line(out))
         return
 
     configs: dict = {}
@@ -1852,6 +2016,7 @@ def main() -> None:
     except OSError:
         pass
     print(json.dumps(out))
+    print(_summary_line(out))
 
 
 if __name__ == "__main__":

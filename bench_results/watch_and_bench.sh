@@ -1,16 +1,18 @@
 #!/bin/bash
-# Device-link watcher, round 5.  Each healthy window: full bench at
-# production defaults -> per-config keep-best in watch_bench_r5.json
-# (round-5 code only; round-4's watch_bench_auto.json is frozen),
-# a raw per-window history line in watch_windows_r5.jsonl (feeds the
+# Device-link watcher, round 6.  Each healthy window: full bench at
+# production defaults -> per-config keep-best in watch_bench_r6.json
+# (round-6 code only; the round-4/round-5 captures are frozen),
+# a raw per-window history line in watch_windows_r6.jsonl (feeds the
 # median-of-windows column next to keep-best), and a Mosaic-compiled
 # fused-merge parity check (bench.py --pallas-parity) whose verdict
-# is appended to watch_parity_log.jsonl.  The frozen round-4
-# captures (watch_bench_stdout.json, watch_bench_auto.json) are
-# never overwritten.
+# is appended to watch_parity_log.jsonl.  Round 6 stamps every
+# window row with host loadavg + the tunnel probe RTT (before and
+# after the bench) and a derived `degraded` flag, so the published
+# median-of-windows can exclude or footnote windows where the host
+# core or the link was visibly unwell.
 cd /root/repo
 LOG=bench_results/watch.log
-echo "$(date -u +%FT%TZ) watcher start (round 5)" >> "$LOG"
+echo "$(date -u +%FT%TZ) watcher start (round 6)" >> "$LOG"
 
 keep_best() {  # $1 candidate stdout, $2 best-so-far artifact
   python - "$1" "$2" <<'EOF'
@@ -92,23 +94,41 @@ for i in $(seq 1 2000); do
     echo "$(date -u +%FT%TZ) watcher deadline reached; stopping" >> "$LOG"
     exit 0
   fi
+  # timed probe: the wall time of one end-to-end device touch IS the
+  # tunnel RTT figure the window rows stamp (healthy: a few seconds)
   out=$(timeout 120 python -c "
 from veneur_tpu.utils import devprobe
-import json
+import json, time
+t0 = time.monotonic()
 err, info = devprobe.probe_device_info(45)
+info['probe_rtt_s'] = round(time.monotonic() - t0, 2)
 print(err or 'HEALTHY ' + json.dumps(info))" 2>&1 | tail -1)
   echo "$(date -u +%FT%TZ) probe[$i]: $out" >> "$LOG"
   case "$out" in HEALTHY*)
+    echo "$out" > /tmp/watch_probe_pre
     echo "$(date -u +%FT%TZ) link healthy -> full bench (defaults)" >> "$LOG"
     VENEUR_BENCH_BUDGET=1800 timeout 2100 python bench.py \
         > /tmp/watch_bench_candidate.json 2>> "$LOG"
     echo "$(date -u +%FT%TZ) bench done rc=$?" >> "$LOG"
-    keep_best /tmp/watch_bench_candidate.json \
-        bench_results/watch_bench_r5.json >> "$LOG" 2>&1
-    # raw per-window rates: the median-of-windows statistic published
-    # next to keep-best needs every window, not just the winner
-    python - <<'PYEOF' >> bench_results/watch_windows_r5.jsonl 2>> "$LOG"
+    # post-bench RTT probe: a window that STARTED healthy can end on
+    # a stalled link; the pre/post pair bounds when it went bad
+    timeout 90 python -c "
+from veneur_tpu.utils import devprobe
 import json, time
+t0 = time.monotonic()
+err, _ = devprobe.probe_device_info(30)
+print(json.dumps({'err': err,
+                  'probe_rtt_s': round(time.monotonic() - t0, 2)}))" \
+        > /tmp/watch_probe_post 2>> "$LOG"
+    keep_best /tmp/watch_bench_candidate.json \
+        bench_results/watch_bench_r6.json >> "$LOG" 2>&1
+    # raw per-window rates: the median-of-windows statistic published
+    # next to keep-best needs every window, not just the winner.
+    # Round 6: each row carries loadavg + pre/post tunnel RTT and a
+    # degraded flag (shared host core or slow link) so the medians
+    # are interpretable without the watch.log.
+    python - <<'PYEOF' >> bench_results/watch_windows_r6.jsonl 2>> "$LOG"
+import json, os, time
 try:
     with open("/tmp/watch_bench_candidate.json") as f:
         lines = [l for l in f.read().splitlines() if l.startswith("{")]
@@ -121,6 +141,34 @@ try:
             r = v.get("samples_per_sec") or v.get("items_per_sec")
             if r:
                 row[k] = r
+    try:
+        row["loadavg"] = [round(x, 2) for x in os.getloadavg()]
+    except OSError:
+        row["loadavg"] = None
+    rtt_pre = rtt_post = None
+    try:
+        with open("/tmp/watch_probe_pre") as f:
+            rtt_pre = json.loads(
+                f.read().split("HEALTHY ", 1)[1]).get("probe_rtt_s")
+    except Exception:
+        pass
+    try:
+        with open("/tmp/watch_probe_post") as f:
+            post = json.loads(f.read().strip().splitlines()[-1])
+        rtt_post = post.get("probe_rtt_s")
+        post_err = post.get("err")
+    except Exception:
+        post_err = "post probe unreadable"
+    row["rtt_pre_s"] = rtt_pre
+    row["rtt_post_s"] = rtt_post
+    # degraded: the builder was sharing the one host core (loadavg
+    # well above 1), or either RTT blew past the healthy profile,
+    # or the link died before the post probe
+    load1 = (row["loadavg"] or [0])[0]
+    row["degraded"] = bool(
+        load1 > 1.5 or
+        (rtt_pre or 0) > 15 or (rtt_post or 0) > 15 or
+        post_err is not None)
     print(json.dumps(row))
 except Exception as e:
     print(json.dumps({"ts": round(time.time(), 1), "error": str(e)}))

@@ -238,6 +238,69 @@ def test_chain_bench_artifact_committed():
     assert "platform" in d and "gates" in d
 
 
+def _bench_module():
+    import importlib.util
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench.py")
+    spec = importlib.util.spec_from_file_location("_bench_mod", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_bench_mod"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_summary_line_compact_and_parseable():
+    """The post-blob summary line is the driver's machine-readable
+    record when its bounded tail capture truncates the full artifact
+    (BENCH_r05 lost its record exactly that way): it must stay under
+    1KB with every config populated — including long error strings —
+    and parse as standalone JSON."""
+    m = _bench_module()
+    configs = {
+        "0_counters_1k_names": {"samples_per_sec": 19.4e6,
+                                "platform": "cpu"},
+        "1_cardinality_100k": {"samples_per_sec": 10.3e6},
+        "2_timers_10k_series": {"error": "config timed out " * 40},
+        "3_sets_1m_uniques": {"skipped": True, "reason": "link down"},
+        "4_global_merge": {"items_per_sec": 46600.0},
+    }
+    out = m._assemble(configs, 0.0, {"platform": "cpu"})
+    line = m._summary_line(out)
+    assert len(line) < 1024
+    d = json.loads(line)
+    assert d["bench_summary"] is True
+    assert d["configs"]["0_counters_1k_names"]["rate"] == 19.4e6
+    assert d["configs"]["4_global_merge"]["rate"] == 46600.0
+    assert len(d["configs"]["2_timers_10k_series"]["error"]) <= 80
+    assert d["configs"]["3_sets_1m_uniques"]["skipped"] is True
+
+
+def test_median_pass_result_headline_is_median():
+    """Multi-pass headline: the published rate must be the median of
+    the per-pass rates (one bad host/link window lands on one pass),
+    with totals summed and every pass's raw intervals retained."""
+    m = _bench_module()
+
+    def mk(rate, total=700):
+        return {"samples": total, "seconds": total / rate,
+                "samples_per_sec": rate,
+                "mean_samples_per_sec": rate,
+                "warm_mean_samples_per_sec": rate,
+                "interval_seconds": [0.1] * 7, "intervals": 7,
+                "cold_interval_seconds": 0.5}
+
+    res = m._median_pass_result([mk(100.0), mk(10.0), mk(90.0)])
+    assert res["samples_per_sec"] == 90.0
+    assert sorted(res["pass_rates"]) == [10.0, 90.0, 100.0]
+    assert res["samples"] == 2100
+    assert len(res["passes"]) == 3
+    assert all(len(p["interval_seconds"]) == 7 for p in res["passes"])
+    # degenerate single pass (budget-tripped sweep) passes through
+    one = m._median_pass_result([mk(50.0)])
+    assert one["samples_per_sec"] == 50.0 and one["pass_rates"] == [50.0]
+
+
 def test_soak_artifact_committed_and_stable():
     """The committed 20-minute soak artifact must carry passing
     stability verdicts (RSS slope, thread flatness, flush cadence) —

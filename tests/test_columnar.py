@@ -250,8 +250,15 @@ def test_native_parser_fuzz_agreement(parser):
     reference parser with the same type, value, weight, scope and
     identity hash — and the native side must never crash or hang."""
     rng = np.random.default_rng(1234)
+    # lengths span 1-100 bytes so BOTH native bodies are fuzzed: the
+    # <=64-byte parse_line_fast AND the general scan behind it (the
+    # original stems maxed out ~40 bytes and never left the fast path)
     valid_stems = [b"name:1|c", b"a.b:3.5|ms|#x:1,y:2",
-                   b"s:m|s", b"g:-2|g", b"h:9|h|@0.5|#t:1"]
+                   b"s:m|s", b"g:-2|g", b"h:9|h|@0.5|#t:1",
+                   b"svc.api.request.duration.seconds:12.75|ms|@0.25"
+                   b"|#env:production,region:us-east-1,zone:a",
+                   b"svc.api.unique.callers.by.route:member-id-x|s"
+                   b"|#route:/v1/import,proto:grpc"]
     lines = []
     for i in range(3000):
         kind = i % 3
@@ -262,11 +269,11 @@ def test_native_parser_fuzz_agreement(parser):
                 base[pos] = rng.integers(32, 127)
             lines.append(bytes(base))
         elif kind == 1:  # random printable
-            n = int(rng.integers(1, 40))
+            n = int(rng.integers(1, 100))
             lines.append(bytes(rng.integers(32, 127, n,
                                             dtype=np.uint8)))
         else:  # raw binary (no newline: that's the framing delimiter)
-            n = int(rng.integers(1, 40))
+            n = int(rng.integers(1, 100))
             raw = rng.integers(0, 256, n, dtype=np.uint8)
             raw[raw == 10] = 11
             lines.append(bytes(raw))
@@ -298,3 +305,39 @@ def test_native_parser_fuzz_agreement(parser):
         assert int(pb.key_hash[i]) == expect, line
         checked += 1
     assert checked > 100  # mutations keep plenty of valid lines
+
+
+def test_dispatch_boundary_agreement(parser):
+    """Valid lines at every length 56-71 bytes, straddling the native
+    parser's 64-byte dispatch (native/dsd_parse.cpp parse_line_core
+    routes n <= 64 to parse_line_fast, longer lines to the general
+    scan).  A divergence between the two bodies surfaces exactly
+    here; each length runs every metric type and must agree with the
+    Python reference on type, value, weight, scope and identity
+    hash."""
+    suffixes = (b"|c", b"|g", b"|ms|@0.5", b"|h", b"|s",
+                b"|c|#env:prod,zone:a")
+    for target in range(56, 72):
+        for suffix in suffixes:
+            val = b"m1" if suffix == b"|s" else b"12.5"
+            pad = target - 1 - len(val) - len(suffix) - 1  # ':' + lead
+            if pad < 0:
+                continue
+            line = b"n" + b"x" * pad + b":" + val + suffix
+            assert len(line) == target
+            pb = parser.parse(line)
+            assert pb.n == 1
+            tc = int(pb.type_code[0])
+            assert tc <= columnar.CODE_SET, line
+            s = dsd.parse_metric(line)
+            assert TYPE_CODES[s.type] == tc, line
+            assert SCOPE_CODES[s.scope] == int(pb.scope[0]), line
+            assert float(pb.weight[0]) == pytest.approx(
+                1.0 / s.sample_rate, rel=1e-6), line
+            if s.type != dsd.SET:
+                assert float(pb.value[0]) == pytest.approx(
+                    float(s.value), rel=1e-9), line
+            expect = hashing.key_hash64(
+                s.name, TYPE_CODES[s.type], s.tags,
+                SCOPE_CODES[s.scope])
+            assert int(pb.key_hash[0]) == expect, line

@@ -1,0 +1,166 @@
+"""LogLog-Beta estimator BIAS pinning across register occupancy.
+
+Round 5 published "HLL max error 1.88% is probably noise" without a
+test behind it.  This suite converts that into a committed check: it
+sweeps register occupancy from sparse to ~full and compares the error
+DISTRIBUTION (mean/std/max over independent trials), not just the
+max, against what the p=14 LogLog-Beta constants promise
+(arXiv:1612.02284; the reference's vendored hyperloglog/utils.go
+beta14): the estimator is asymptotically unbiased, so the MEAN
+relative error per regime must sit at ~0 within the trial-count
+standard error, while any single trial may legitimately stray ~2
+standard errors (~1.6%) — exactly the round-5 observation.
+
+Two precision arms run the same planes:
+
+- ``f64``: the host paths (``estimate_np`` rescan and the
+  fold-maintained ``estimate_from_stats``) keep ez/inv_sum in f64;
+- ``f32``: the device ``estimate`` formula — f32 registers, f32
+  ``exp2`` reduction — the arithmetic the HBM plane path actually
+  executes (identical XLA ops on the CPU backend, only speed
+  differs).
+
+There is no f16 HLL register path in the tree (the
+``VENEUR_TPU_F16_PLANE`` gate covers histo value planes only), so the
+half-precision arm here is a BOUND: ``estimate_from_stats`` with the
+sufficient statistics quantized through float16, recording what a
+hypothetical f16 stats-shipping gate would cost.  Its distribution is
+recorded in the artifact; the bias assert for it is looser.
+
+The per-regime distributions are persisted to
+``bench_results/hll_bias.json`` so the published accuracy claims cite
+a regenerable artifact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from veneur_tpu.ops import hll
+from veneur_tpu.utils import hashing
+
+TRIALS = 32
+# cardinality -> expected register occupancy 1 - exp(-n/M):
+# 100 -> 0.6% (linear-counting regime), 1k -> 6%, 5k -> 26%,
+# 16384 -> 63%, 50k -> 95%, 150k -> 99.99% (rank-dominated regime)
+REGIMES = (100, 1_000, 5_000, 16_384, 50_000, 150_000)
+# mean over TRIALS i.i.d. trials has standard error ~= 0.81%/sqrt(T);
+# gate at ~4 sigma so a true bias trips it but sampling noise doesn't
+MEAN_TOL = 4.0 * 0.0081 / np.sqrt(TRIALS)
+
+
+def _planes(rng: np.random.Generator, n: int) -> np.ndarray:
+    """TRIALS independent rows, each holding n distinct uniform-hash
+    members.  Uniform u64s stand in for member hashes — the sweep
+    pins the ESTIMATOR given ideal hashes; hash quality has its own
+    test (test_hll.test_rank_distribution_sane)."""
+    plane = np.zeros((TRIALS, hll.M), np.uint8)
+    for r in range(TRIALS):
+        h = rng.integers(0, 2**64, n, dtype=np.uint64)
+        idx, rank = hashing.hll_position(h)
+        np.maximum.at(plane[r], idx, rank.astype(np.uint8))
+    return plane
+
+
+def _stats(plane: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    ez = (plane == 0).sum(axis=-1).astype(np.float64)
+    lut = np.exp2(-np.arange(64, dtype=np.float64))
+    return ez, lut[plane].sum(axis=-1)
+
+
+def _dist(est: np.ndarray, n: int) -> dict:
+    rel = est.astype(np.float64) / n - 1.0
+    return {"mean": float(rel.mean()), "std": float(rel.std()),
+            "max_abs": float(np.abs(rel).max())}
+
+
+@pytest.fixture(scope="module")
+def sweep():
+    import jax
+    rng = np.random.default_rng(140)
+    out = {}
+    for n in REGIMES:
+        plane = _planes(rng, n)
+        ez, inv = _stats(plane)
+        occupancy = float(1.0 - ez.mean() / hll.M)
+        est64 = hll.estimate_from_stats(ez, inv)
+        rescan = hll.estimate_np(plane)
+        # the rescan and the stats form are the same f64 math — any
+        # divergence is a bookkeeping bug, not estimator noise
+        np.testing.assert_allclose(rescan, est64, rtol=1e-6)
+        est32 = np.asarray(hll.estimate(jax.numpy.asarray(plane)))
+        est16 = hll.estimate_from_stats(
+            ez.astype(np.float16), inv.astype(np.float16))
+        out[n] = {
+            "occupancy": occupancy,
+            "f64": _dist(est64, n),
+            "f32": _dist(est32, n),
+            "f16_stats_bound": _dist(est16, n),
+            "f32_vs_f64_max_rel": float(
+                (np.abs(est32.astype(np.float64) - est64) / n).max()),
+        }
+    return out
+
+
+def test_occupancy_sweep_covers_sparse_to_full(sweep):
+    occ = [sweep[n]["occupancy"] for n in REGIMES]
+    assert occ == sorted(occ)
+    assert occ[0] < 0.01 and occ[-1] > 0.999
+
+
+@pytest.mark.parametrize("n", REGIMES)
+def test_mean_error_unbiased_per_regime(sweep, n):
+    """The LogLog-Beta claim under test: per occupancy regime the
+    estimator's mean relative error is ~0 — individual trials may
+    stray ~1.6% (2 s.e.), the average may not."""
+    for arm in ("f64", "f32"):
+        d = sweep[n][arm]
+        assert abs(d["mean"]) < MEAN_TOL, (arm, d)
+        # per-trial spread stays near the sketch's 0.81% standard
+        # error in every regime (loose: small-n linear-counting is
+        # tighter, near-full occupancy slightly wider)
+        assert d["std"] < 0.025, (arm, d)
+        assert d["max_abs"] < 0.05, (arm, d)
+
+
+@pytest.mark.parametrize("n", REGIMES)
+def test_f32_matches_f64_within_accumulation_noise(sweep, n):
+    """The device's f32 reduction vs the host's f64 stats: the 16384-
+    term exp2 sum loses ~2^-17 relative in f32 — invisible next to
+    the 0.81% sketch error.  A real divergence here means the device
+    formula drifted from the reference constants."""
+    assert sweep[n]["f32_vs_f64_max_rel"] < 1e-3
+
+
+def test_f16_stats_bound_recorded(sweep):
+    """The hypothetical f16 stats arm: quantizing ez/inv_sum to half
+    precision costs real accuracy at high occupancy (inv_sum ~ O(1)
+    with 2^-10 steps against register sums of ~1e-2 contributions) —
+    the bound exists to show the gate would NOT be free, which is why
+    the shipping paths stay f64/f32.  Only sanity-gated here; the
+    artifact carries the distribution."""
+    for n in REGIMES:
+        d = sweep[n]["f16_stats_bound"]
+        assert abs(d["mean"]) < 0.05, (n, d)
+
+
+def test_artifact_written(sweep):
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "bench_results", "hll_bias.json")
+    payload = {
+        "p": hashing.HLL_P, "m": hll.M, "trials": TRIALS,
+        "mean_tolerance": float(MEAN_TOL),
+        "regimes": {str(n): sweep[n] for n in REGIMES},
+    }
+    try:
+        with open(os.path.abspath(path), "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+    except OSError:
+        pytest.skip("bench_results/ not writable")
+    with open(os.path.abspath(path)) as f:
+        assert json.load(f)["regimes"]

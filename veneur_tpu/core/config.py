@@ -301,6 +301,14 @@ class Config:
     # (unlocked).  VENEUR_TPU_PIPELINE=0 is the serial escape hatch —
     # every device_step/swap runs inline under the lock as before.
     tpu_pipeline: bool = True
+    # multi-reader fused native ingest: with num_readers > 1, each
+    # SO_REUSEPORT reader runs the fused parse+probe+combine C pass
+    # lock-free against per-reader scratch (probes ride the native
+    # index's RCU inner table) and only the O(touched-rows) merge into
+    # shared staging holds the table lock.
+    # VENEUR_TPU_MULTI_READER_FUSED=0 falls back to the split
+    # parse-then-ingest_columns path.
+    tpu_multi_reader_fused: bool = True
     # compile every canonical kernel shape at startup (against a
     # scratch table) so the first flush interval doesn't eat the XLA
     # compiles; off by default because it adds seconds to process

@@ -916,6 +916,17 @@ class Server:
         parser = columnar.ColumnarParser()
         if not parser.available:
             parser = None
+        # multi-reader fused ingest: a per-reader shard runs the fused
+        # parse+probe+combine C pass lock-free against private scratch
+        # (index probes are RCU-safe), holding self.lock only for the
+        # miss-resolve + O(touched-rows) merge.  Single-reader servers
+        # keep the whole-pass-under-lock path (nothing contends).
+        shard = None
+        if (parser is not None and self.config.num_readers > 1 and
+                getattr(self.config, "tpu_multi_reader_fused", True)):
+            make = getattr(self.table, "make_reader_shard", None)
+            if make is not None:
+                shard = make()
         max_batch = self.config.reader_batch_packets
         # native bulk drain: one recvmmsg syscall per batch instead of
         # one recv + bytes object per packet (see vtpu_recv_drain);
@@ -969,19 +980,29 @@ class Server:
             # stale cached .so: packets process one per loop; a
             # MSG_DONTWAIT sweep would BLOCK on the timeout socket,
             # CPython retries flagged recvs until the timeout)
-            self.handle_packet_batch(
+            t0 = time.monotonic_ns()
+            processed = self.handle_packet_batch(
                 batch, parser, drained=drained,
-                drained_pkts=int(drain_n.value) if drained else 0)
+                drained_pkts=int(drain_n.value) if drained else 0,
+                shard=shard)
+            self.device_costs.add_reader_batch(
+                threading.current_thread().name, n_pkts, processed,
+                time.monotonic_ns() - t0, fused=shard is not None)
             self.bump(f"received_{proto}", n_pkts)
 
     def handle_packet_batch(self, packets: list[bytes], parser,
                             drained: bytes | None = None,
-                            drained_pkts: int = 0) -> None:
+                            drained_pkts: int = 0,
+                            shard=None) -> int:
         """Columnar ingest of many datagrams: one native parse, one
         table lock, one stats round.  ``drained`` is a pre-validated
         newline-joined chunk from the native recvmmsg drain (each
         datagram already bounded/oversize-rejected in C), so it skips
-        the per-packet length check."""
+        the per-packet length check.  ``shard`` is this reader
+        thread's ReaderShard on the multi-reader fused path: parse
+        and combine run lock-free against the shard's scratch, and
+        only the miss-resolve + merge holds the lock.  Returns the
+        processed sample count."""
         errors = 0
         good = []
         for p in packets:
@@ -992,13 +1013,30 @@ class Server:
         self.bump("packets_received", len(good) + drained_pkts)
         if drained is not None:
             good.append(drained)
-        if self.config.num_readers <= 1 and \
+        if shard is not None:
+            buf = b"\n".join(good)
+            shard.parse(buf)  # lock-free fused pass
+            with self.lock:
+                processed, dropped, others = shard.commit()
+                work = self._maybe_device_step_locked()
+            self._apply_staged(work)
+            shard.reset()  # scrub local scratch off the lock
+            for off, ln, _kind in others:
+                try:
+                    parsed = dsd.parse_line(buf[off:off + ln])
+                except dsd.ParseError:
+                    errors += 1
+                    continue
+                p, d = self.ingest_parsed(parsed, bump=False)
+                processed += p
+                dropped += d
+        elif self.config.num_readers <= 1 and \
                 getattr(self.table, "_lib", None) is not None:
             # single reader: nothing contends for the table lock, so
             # the fused native parse+probe+combine pass (no column
             # materialization) replaces parse-then-ingest; the split
             # design exists so MULTI-reader servers parse outside the
-            # lock
+            # lock (and the fused multi-reader path above shards it)
             buf = b"\n".join(good)
             with self.lock:
                 processed, dropped, others = \
@@ -1042,6 +1080,7 @@ class Server:
             self.bump("metrics_processed", processed)
         if dropped:
             self.bump("metrics_dropped", dropped)
+        return processed
 
     def _tcp_acceptor(self, sock: socket.socket) -> None:
         import ssl as _ssl

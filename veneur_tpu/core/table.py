@@ -503,6 +503,15 @@ class MetricTable:
         # fused parse+ingest scratch (see ingest_buffer), grow-only
         self._fused_scratch: dict | None = None
 
+        # row-renumbering epoch: bumped (under the caller's ingest
+        # lock) whenever compaction renumbers rows and rebuilds the
+        # key index.  Reader shards record it before their lock-free
+        # fused pass; a mismatch at commit time means the shard's
+        # locally combined row ids are stale, and the raw buffer is
+        # re-ingested through the locked path instead (rare: at most
+        # once per reader per compacting flush)
+        self._reindex_epoch = 0
+
         self.status: dict[tuple, tuple[float, str, tuple[str, ...]]] = {}
         # gRPC import fast path: native import-identity hash -> row
         # (-1 for known-dropped items), maintained by
@@ -2104,6 +2113,10 @@ class MetricTable:
             # the same renumbered rows — drop it; the next wire list
             # re-resolves through the slow path
             self.import_row_cache.clear()
+            # invalidate reader shards' lock-free probes: any fused
+            # pass that began against pre-compaction row numbering
+            # must discard and re-ingest (ReaderShard.commit)
+            self._reindex_epoch += 1
         return pend
 
     def complete_swap(self, pend: _PendingSwap) -> Snapshot:
@@ -2150,3 +2163,238 @@ class MetricTable:
         out = self.status
         self.status = {}
         return out
+
+    def make_reader_shard(self) -> "ReaderShard | None":
+        """Per-reader-thread fused-ingest scratch for the multi-reader
+        SO_REUSEPORT path, or None when the native fused pass isn't
+        available (the caller falls back to split parse +
+        ingest_columns)."""
+        if self._lib is None or not isinstance(
+                self.key_index, intern.NativeHashIndex):
+            return None
+        return ReaderShard(self)
+
+
+class ReaderShard:
+    """One reader thread's private half of the fused native ingest.
+
+    The single-reader fused path (``MetricTable.ingest_buffer``) holds
+    the table lock across the whole parse+probe+combine C pass.  With
+    N SO_REUSEPORT readers that serializes the hot loop; this shard
+    splits it so the O(lines) work runs concurrently on every reader:
+
+    - ``parse(buf)`` — NO lock: ``vtpu_parse_ingest`` combines into
+      this shard's private dense/append scratch.  Index probes are
+      lock-free (the native index publishes an immutable-capacity
+      inner table RCU-style); every output buffer is shard-private;
+      the delimiter-mask scratch is thread_local in C.
+    - ``commit()`` — under the caller's ingest lock: resolve misses
+      (new-series row allocation, batched per unique identity),
+      replay them, then merge the shard's touched rows into the
+      shared staging in O(touched rows + appended samples).
+    - ``reset()`` — NO lock: zero the rows commit() touched.
+
+    A compaction between parse and commit renumbers rows; the table's
+    ``_reindex_epoch`` detects that, and commit discards the scratch
+    and re-ingests the raw buffer through the locked path instead.
+
+    Gauge last-write-wins resolves in commit order across shards —
+    the same inherent nondeterminism as any concurrent-UDP ordering;
+    counter/histo/set merges are associative and order-free.
+    """
+
+    def __init__(self, table: MetricTable):
+        self.table = table
+        c = table.config
+        self._c_dense = np.zeros(c.counter_rows, np.float64)
+        self._c_touch = np.zeros(c.counter_rows, np.uint8)
+        self._g_dense = np.zeros(c.gauge_rows, np.float32)
+        self._g_mask = np.zeros(c.gauge_rows, np.uint8)
+        self._g_touch = np.zeros(c.gauge_rows, np.uint8)
+        self._h_touch = np.zeros(c.histo_rows, np.uint8)
+        self._s_touch = np.zeros(c.set_rows, np.uint8)
+        self._cols: dict | None = None  # per-line columns, grow-only
+        self._meta = np.zeros(12, np.int64)
+        self._buf: bytes | None = None
+        self._epoch = -1
+        # rows commit() merged, for the off-lock zeroing in reset()
+        self._zc = self._zg = self._zh = self._zs = None
+
+    def _ensure_cols(self, n_est: int) -> dict:
+        sc = self._cols
+        if sc is None or len(sc["hr"]) < n_est:
+            cap = max(n_est, 4096)
+            sc = self._cols = {
+                "hr": np.empty(cap, np.int32),
+                "hv": np.empty(cap, np.float32),
+                "hw": np.empty(cap, np.float32),
+                "sr": np.empty(cap, np.int32),
+                "sp": np.empty(cap, np.int32),
+                "mk": np.empty(cap, np.uint64),
+                "mt": np.empty(cap, np.uint8),
+                "mv": np.empty(cap, np.float64),
+                "mm": np.empty(cap, np.uint64),
+                "mw": np.empty(cap, np.float32),
+                "mo": np.empty(cap, np.int64),
+                "ml": np.empty(cap, np.int32),
+                "oo": np.empty(cap, np.int64),
+                "ol": np.empty(cap, np.int32),
+                "ok": np.empty(cap, np.uint8),
+            }
+        return sc
+
+    def parse(self, buf) -> None:
+        """Lock-free fused parse+probe+combine into private scratch.
+        ctypes releases the GIL for the C pass, so N readers parse
+        genuinely in parallel."""
+        import ctypes as ct
+        t = self.table
+        buf_b = bytes(buf) if not isinstance(buf, bytes) else buf
+        self._buf = buf_b
+        # epoch BEFORE the probe pass: if compaction lands during the
+        # pass, commit sees the bumped epoch and discards
+        self._epoch = t._reindex_epoch
+        buf_np = np.frombuffer(buf_b, np.uint8)
+        sc = self._ensure_cols(buf_b.count(b"\n") + 1)
+        meta = self._meta
+        meta[:] = 0
+
+        def p(a, ty):
+            return a.ctypes.data_as(ct.POINTER(ty))
+
+        u8p = ct.c_uint8
+        t._lib.vtpu_parse_ingest(
+            p(buf_np, u8p), len(buf_np),
+            t.key_index.handle, hashing.HLL_P,
+            p(self._c_dense, ct.c_double), p(self._c_touch, u8p),
+            p(self._g_dense, ct.c_float), p(self._g_mask, u8p),
+            p(self._g_touch, u8p),
+            p(sc["hr"], ct.c_int32), p(sc["hv"], ct.c_float),
+            p(sc["hw"], ct.c_float), p(self._h_touch, u8p),
+            p(sc["sr"], ct.c_int32), p(sc["sp"], ct.c_int32),
+            p(self._s_touch, u8p),
+            p(sc["mk"], ct.c_uint64), p(sc["mt"], u8p),
+            p(sc["mv"], ct.c_double), p(sc["mm"], ct.c_uint64),
+            p(sc["mw"], ct.c_float),
+            p(sc["mo"], ct.c_int64), p(sc["ml"], ct.c_int32),
+            p(sc["oo"], ct.c_int64), p(sc["ol"], ct.c_int32),
+            p(sc["ok"], u8p),
+            p(meta, ct.c_int64))
+
+    def commit(self) -> tuple[int, int, list[tuple[int, int, int]]]:
+        """Locked merge half — the caller MUST hold the same lock
+        that serializes every other table mutation.  Returns
+        (processed, dropped, others) exactly like ingest_buffer."""
+        import ctypes as ct
+        t = self.table
+        if self._epoch != t._reindex_epoch:
+            # rows renumbered under us: local combines used stale row
+            # ids.  Drop them and run the raw buffer through the
+            # locked single-reader fused path.
+            buf = self._buf
+            self._discard()
+            return t.ingest_buffer(buf)
+        sc, meta = self._cols, self._meta
+
+        def p(a, ty):
+            return a.ctypes.data_as(ct.POINTER(ty))
+
+        u8p = ct.c_uint8
+        n_miss = int(meta[2])
+        if n_miss:
+            buf_np = np.frombuffer(self._buf, np.uint8)
+            shim = _MissLines(buf_np, sc["mo"], sc["ml"], sc["mt"])
+            t._resolve_misses(shim, np.arange(n_miss),
+                              sc["mk"][:n_miss])
+            # replay the compact miss columns into the SHARD's
+            # buffers (appends continue at meta's cursors), so the
+            # merge below handles hits and resolved misses uniformly
+            i64p = ct.POINTER(ct.c_int64)
+            miss2 = np.empty(n_miss, np.int64)
+            t._lib.vtpu_ingest(
+                t.key_index.handle,
+                p(sc["mk"], ct.c_uint64), p(sc["mt"], u8p),
+                p(sc["mv"], ct.c_double), p(sc["mm"], ct.c_uint64),
+                p(sc["mw"], ct.c_float), n_miss,
+                miss2.ctypes.data_as(i64p), -1,
+                hashing.HLL_P,
+                p(self._c_dense, ct.c_double),
+                p(self._c_touch, u8p),
+                p(self._g_dense, ct.c_float), p(self._g_mask, u8p),
+                p(self._g_touch, u8p),
+                p(sc["hr"], ct.c_int32), p(sc["hv"], ct.c_float),
+                p(sc["hw"], ct.c_float), p(self._h_touch, u8p),
+                p(sc["sr"], ct.c_int32), p(sc["sp"], ct.c_int32),
+                p(self._s_touch, u8p),
+                miss2.ctypes.data_as(i64p),
+                p(meta, ct.c_int64))
+
+        processed = int(meta[3])
+        dropped = int(meta[6:11].sum())
+        if dropped:
+            t.counter_idx.overflow += int(meta[6])
+            t.gauge_idx.overflow += int(meta[7])
+            t.histo_idx.overflow += int(meta[8] + meta[9])
+            t.set_idx.overflow += int(meta[10])
+
+        cr = np.nonzero(self._c_touch)[0]
+        if len(cr):
+            t._counter_dense[cr] += self._c_dense[cr]
+            t.counter_idx.touched[cr] = True
+            t._counter_dirty = True
+        gr = np.nonzero(self._g_mask)[0]
+        if len(gr):
+            t._gauge_dense[gr] = self._g_dense[gr]
+            t._gauge_mask[gr] = 1
+            t.gauge_idx.touched[gr] = True
+            t._gauge_dirty = True
+        hn = int(meta[0])
+        hr_t = None
+        if hn:
+            t._histo_stage.append(sc["hr"][:hn].copy(),
+                                  sc["hv"][:hn].copy(),
+                                  sc["hw"][:hn].copy())
+            hr_t = np.nonzero(self._h_touch)[0]
+            t.histo_idx.touched[hr_t] = True
+        sn = int(meta[1])
+        sr_t = None
+        if sn:
+            t._set_pos_rows.append(sc["sr"][:sn].copy())
+            t._set_pos.append(sc["sp"][:sn].copy())
+            sr_t = np.nonzero(self._s_touch)[0]
+            t.set_idx.touched[sr_t] = True
+        t._staged_n += processed - dropped
+        n_other = int(meta[11])
+        others = [(int(sc["oo"][i]), int(sc["ol"][i]),
+                   int(sc["ok"][i])) for i in range(n_other)]
+        self._zc, self._zg, self._zh, self._zs = cr, gr, hr_t, sr_t
+        self._buf = None
+        return processed, dropped, others
+
+    def reset(self) -> None:
+        """Zero the locally-touched rows — off the lock, so the O(R)
+        scrub never extends the critical section."""
+        if self._zc is not None and len(self._zc):
+            self._c_dense[self._zc] = 0.0
+            self._c_touch[self._zc] = 0
+        if self._zg is not None and len(self._zg):
+            self._g_dense[self._zg] = 0.0
+            self._g_mask[self._zg] = 0
+            self._g_touch[self._zg] = 0
+        if self._zh is not None and len(self._zh):
+            self._h_touch[self._zh] = 0
+        if self._zs is not None and len(self._zs):
+            self._s_touch[self._zs] = 0
+        self._zc = self._zg = self._zh = self._zs = None
+
+    def _discard(self) -> None:
+        """Full scrub for the rare epoch-mismatch path."""
+        self._c_dense.fill(0.0)
+        self._c_touch.fill(0)
+        self._g_dense.fill(0.0)
+        self._g_mask.fill(0)
+        self._g_touch.fill(0)
+        self._h_touch.fill(0)
+        self._s_touch.fill(0)
+        self._buf = None
+        self._zc = self._zg = self._zh = self._zs = None

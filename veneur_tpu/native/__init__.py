@@ -145,6 +145,8 @@ def _bind(lib: ctypes.CDLL) -> None:
                                       ctypes.c_int32]
     lib.vtpu_index_count.restype = i64
     lib.vtpu_index_count.argtypes = [vp]
+    lib.vtpu_index_readers.restype = i64
+    lib.vtpu_index_readers.argtypes = [vp]
     lib.vtpu_index_lookup.restype = None
     lib.vtpu_index_lookup.argtypes = [vp, u64p, i64, i32p]
     lib.vtpu_rank.restype = None

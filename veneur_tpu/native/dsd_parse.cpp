@@ -19,6 +19,7 @@
 //
 // Build: g++ -O3 -shared -fPIC (see veneur_tpu/protocol/columnar.py).
 
+#include <atomic>
 #include <cstdint>
 #include <cstring>
 #include <cstdlib>
@@ -717,12 +718,32 @@ void vtpu_hash_members(const uint8_t* buf, const int64_t* offs,
 // key 0 aliased so the empty-slot sentinel stays unambiguous).  Owned
 // by C++ so the per-batch lookup+combine below runs without crossing
 // back into Python per probe round.
+//
+// Concurrency contract (the multi-reader fused path): PROBES are
+// lock-free and may run from any number of reader threads with no
+// lock held; MUTATIONS (insert/clear) are serialized by the caller
+// (the Python table lock).  The slot array lives in an immutable-
+// capacity inner table published through an atomic pointer: growth
+// and clear build a fresh inner table and swap the pointer (RCU), so
+// a concurrent prober keeps walking a complete, self-consistent old
+// table and at worst misses a brand-new key — which lands it on the
+// miss path, where resolution under the lock is idempotent.  Retired
+// tables are reclaimed only at quiescent instants (the probe
+// refcount reads zero inside a mutation, which the lock serializes).
+// Slot publication orders val before key (release/acquire) so a
+// prober that sees a key always sees its row.
 
-struct VtpuIndex {
+struct VtpuTab {
   uint64_t* keys;
   int32_t* vals;
   int64_t cap;  // power of two
-  int64_t count;
+};
+
+struct VtpuIndex {
+  std::atomic<VtpuTab*> tab;
+  int64_t count;                 // writer-only (caller-serialized)
+  std::atomic<int64_t> readers;  // lock-free probe passes in flight
+  std::vector<VtpuTab*> retired;
 };
 
 static constexpr uint64_t kZeroAlias = 0x9E3779B97F4A7C15ULL;
@@ -731,93 +752,146 @@ static inline uint64_t canon_key(uint64_t k) {
   return k ? k : kZeroAlias;
 }
 
-static void index_alloc(VtpuIndex* t, int64_t cap) {
-  t->cap = cap;
-  t->keys = (uint64_t*)calloc((size_t)cap, 8);
-  t->vals = (int32_t*)malloc((size_t)cap * 4);
-  for (int64_t i = 0; i < cap; i++) t->vals[i] = -1;
-  t->count = 0;
+static VtpuTab* tab_alloc(int64_t cap) {
+  VtpuTab* tb = new VtpuTab;
+  tb->cap = cap;
+  tb->keys = (uint64_t*)calloc((size_t)cap, 8);
+  tb->vals = (int32_t*)malloc((size_t)cap * 4);
+  for (int64_t i = 0; i < cap; i++) tb->vals[i] = -1;
+  return tb;
 }
 
-static inline int32_t index_get(const VtpuIndex* t, uint64_t key) {
+static void tab_free(VtpuTab* tb) {
+  free(tb->keys);
+  free(tb->vals);
+  delete tb;
+}
+
+static inline int32_t tab_get(const VtpuTab* tb, uint64_t key) {
   key = canon_key(key);
-  uint64_t mask = (uint64_t)t->cap - 1;
+  uint64_t mask = (uint64_t)tb->cap - 1;
   uint64_t i = key & mask;
   for (;;) {
-    uint64_t k = t->keys[i];
-    if (k == key) return t->vals[i];
+    uint64_t k = __atomic_load_n(&tb->keys[i], __ATOMIC_ACQUIRE);
+    if (k == key)
+      return __atomic_load_n(&tb->vals[i], __ATOMIC_RELAXED);
     if (k == 0) return -1;
     i = (i + 1) & mask;
   }
 }
 
-static void index_put(VtpuIndex* t, uint64_t key, int32_t val);
-
-static void index_grow(VtpuIndex* t) {
-  uint64_t* ok = t->keys;
-  int32_t* ov = t->vals;
-  int64_t ocap = t->cap;
-  index_alloc(t, ocap * 2);
-  for (int64_t i = 0; i < ocap; i++) {
-    if (ok[i]) index_put(t, ok[i], ov[i]);
-  }
-  free(ok);
-  free(ov);
+// Pin the current inner table for a whole probe pass.  seq_cst pairs
+// with the seq_cst readers check in index_sweep: the refcount bump
+// can't be reordered after the pointer load, so a table this pass
+// can observe is never one a sweep may free.
+static inline const VtpuTab* index_enter(VtpuIndex* t) {
+  t->readers.fetch_add(1, std::memory_order_seq_cst);
+  return t->tab.load(std::memory_order_seq_cst);
 }
 
-static void index_put(VtpuIndex* t, uint64_t key, int32_t val) {
-  if (t->count * 5 >= t->cap * 3) index_grow(t);
+static inline void index_exit(VtpuIndex* t) {
+  t->readers.fetch_sub(1, std::memory_order_release);
+}
+
+// Free retired tables once no probe pass is in flight.  Runs only on
+// the caller-serialized mutation path, after the new table pointer is
+// published: readers == 0 here means nobody can still hold a retired
+// pointer, and later entrants load the new table.
+static void index_sweep(VtpuIndex* t) {
+  if (!t->retired.empty() &&
+      t->readers.load(std::memory_order_seq_cst) == 0) {
+    for (VtpuTab* tb : t->retired) tab_free(tb);
+    t->retired.clear();
+  }
+}
+
+static void tab_put(VtpuTab* tb, uint64_t key, int32_t val,
+                    int64_t* count) {
   key = canon_key(key);
-  uint64_t mask = (uint64_t)t->cap - 1;
+  uint64_t mask = (uint64_t)tb->cap - 1;
   uint64_t i = key & mask;
   for (;;) {
-    uint64_t k = t->keys[i];
+    uint64_t k = tb->keys[i];  // single writer: plain load is exact
     if (k == 0) {
-      t->keys[i] = key;
-      t->vals[i] = val;
-      t->count++;
+      __atomic_store_n(&tb->vals[i], val, __ATOMIC_RELAXED);
+      __atomic_store_n(&tb->keys[i], key, __ATOMIC_RELEASE);
+      if (count) (*count)++;
       return;
     }
     if (k == key) {
-      t->vals[i] = val;
+      __atomic_store_n(&tb->vals[i], val, __ATOMIC_RELEASE);
       return;
     }
     i = (i + 1) & mask;
   }
+}
+
+static void index_grow(VtpuIndex* t) {
+  VtpuTab* old = t->tab.load(std::memory_order_relaxed);
+  VtpuTab* nt = tab_alloc(old->cap * 2);
+  for (int64_t i = 0; i < old->cap; i++) {
+    if (old->keys[i]) tab_put(nt, old->keys[i], old->vals[i], nullptr);
+  }
+  t->tab.store(nt, std::memory_order_seq_cst);
+  t->retired.push_back(old);
+  index_sweep(t);
+}
+
+static void index_put(VtpuIndex* t, uint64_t key, int32_t val) {
+  VtpuTab* tb = t->tab.load(std::memory_order_relaxed);
+  if (t->count * 5 >= tb->cap * 3) {
+    index_grow(t);
+    tb = t->tab.load(std::memory_order_relaxed);
+  }
+  tab_put(tb, key, val, &t->count);
 }
 
 void* vtpu_index_new(int64_t capacity) {
   int64_t cap = 1024;
   while (cap < capacity) cap <<= 1;
   VtpuIndex* t = new VtpuIndex;
-  index_alloc(t, cap);
+  t->tab.store(tab_alloc(cap), std::memory_order_relaxed);
+  t->count = 0;
+  t->readers.store(0, std::memory_order_relaxed);
   return t;
 }
 
 void vtpu_index_free(void* p) {
   VtpuIndex* t = (VtpuIndex*)p;
-  free(t->keys);
-  free(t->vals);
+  for (VtpuTab* tb : t->retired) tab_free(tb);
+  tab_free(t->tab.load(std::memory_order_relaxed));
   delete t;
 }
 
 void vtpu_index_clear(void* p) {
   VtpuIndex* t = (VtpuIndex*)p;
-  memset(t->keys, 0, (size_t)t->cap * 8);
-  for (int64_t i = 0; i < t->cap; i++) t->vals[i] = -1;
+  VtpuTab* old = t->tab.load(std::memory_order_relaxed);
+  t->tab.store(tab_alloc(old->cap), std::memory_order_seq_cst);
+  t->retired.push_back(old);
   t->count = 0;
+  index_sweep(t);
 }
 
 void vtpu_index_insert(void* p, uint64_t key, int32_t val) {
-  index_put((VtpuIndex*)p, key, val);
+  VtpuIndex* t = (VtpuIndex*)p;
+  index_put(t, key, val);
+  index_sweep(t);  // opportunistic reclaim of retired tables
 }
 
 int64_t vtpu_index_count(void* p) { return ((VtpuIndex*)p)->count; }
 
+// Probe passes in flight right now — observability for the
+// multi-reader concurrency tests, not part of the ingest contract.
+int64_t vtpu_index_readers(void* p) {
+  return ((VtpuIndex*)p)->readers.load(std::memory_order_relaxed);
+}
+
 void vtpu_index_lookup(void* p, const uint64_t* keys, int64_t n,
                        int32_t* out) {
-  const VtpuIndex* t = (const VtpuIndex*)p;
-  for (int64_t i = 0; i < n; i++) out[i] = index_get(t, keys[i]);
+  VtpuIndex* t = (VtpuIndex*)p;
+  const VtpuTab* tb = index_enter(t);
+  for (int64_t i = 0; i < n; i++) out[i] = tab_get(tb, keys[i]);
+  index_exit(t);
 }
 
 // ---------------------------------------------------------------------
@@ -893,11 +967,16 @@ void vtpu_ingest(
     uint8_t* histo_touch,
     int32_t* set_rows, int32_t* set_pos, uint8_t* set_touch,
     int64_t* miss_idx, int64_t* meta) {
-  const VtpuIndex* t = (const VtpuIndex*)tblp;
+  VtpuIndex* t = (VtpuIndex*)tblp;
+  // one inner table pinned for the whole pass: a concurrent grow
+  // retires (never frees, while we're counted in) the old table, and
+  // any key inserted after the pin simply misses here and resolves
+  // idempotently under the caller's lock
+  const VtpuTab* tb = index_enter(t);
   int64_t hn = meta[0], sn = meta[1], mn = 0;
   int64_t processed = 0, cn = 0, gn = 0;
   const int64_t total = subset_n >= 0 ? subset_n : n;
-  const uint64_t pmask = (uint64_t)t->cap - 1;
+  const uint64_t pmask = (uint64_t)tb->cap - 1;
   for (int64_t j = 0; j < total; j++) {
     // probe prefetch ~16 lines ahead: at 100k+ cardinality the index
     // is DRAM-resident and the probe stall dominated this loop
@@ -908,14 +987,14 @@ void vtpu_ingest(
       // parser's definedness contract) — filter before reading
       if (types[ia] <= T_SET) {
         const uint64_t slot = canon_key(keys[ia]) & pmask;
-        __builtin_prefetch(&t->keys[slot]);
-        __builtin_prefetch(&t->vals[slot]);
+        __builtin_prefetch(&tb->keys[slot]);
+        __builtin_prefetch(&tb->vals[slot]);
       }
     }
     const int64_t i = subset_n >= 0 ? subset[j] : j;
     const uint8_t tc = types[i];
     if (tc > T_SET) continue;
-    const int32_t row = index_get(t, keys[i]);
+    const int32_t row = tab_get(tb, keys[i]);
     if (row == -1) {
       miss_idx[mn++] = i;
       continue;
@@ -937,6 +1016,7 @@ void vtpu_ingest(
   meta[3] += processed;
   meta[4] += cn;
   meta[5] += gn;
+  index_exit(t);
 }
 
 // Fused parse + probe + combine: one pass from raw newline-separated
@@ -961,7 +1041,8 @@ void vtpu_parse_ingest(
     int64_t* m_off, int32_t* m_len,
     int64_t* o_off, int32_t* o_len, uint8_t* o_kind,
     int64_t* meta) {
-  const VtpuIndex* t = (const VtpuIndex*)tblp;
+  VtpuIndex* t = (VtpuIndex*)tblp;
+  const VtpuTab* tb = index_enter(t);  // see vtpu_ingest's pin note
   DelimMasks dm = build_masks(buf, len);
   int64_t hn = meta[0], sn = meta[1], mn = 0, on = 0;
   int64_t processed = 0, cn = 0, gn = 0;
@@ -985,7 +1066,7 @@ void vtpu_parse_ingest(
       on++;
       continue;
     }
-    const int32_t row = index_get(t, lp.key);
+    const int32_t row = tab_get(tb, lp.key);
     if (row == -1) {
       m_keys[mn] = lp.key;
       m_types[mn] = tc;
@@ -1015,6 +1096,7 @@ void vtpu_parse_ingest(
   meta[4] += cn;
   meta[5] += gn;
   meta[11] = on;
+  index_exit(t);
 }
 
 // Within-row occurrence rank: rank[i] = number of earlier samples with

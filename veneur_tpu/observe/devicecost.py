@@ -122,10 +122,33 @@ class InstrumentedJit:
                     analysis.get("bytes accessed", 0.0))}
 
 
+class _ReaderEntry:
+    """Per-reader-thread ingest counters (multi-reader fused path):
+    how much each SO_REUSEPORT reader actually carried, and whether
+    it ran the fused shard or the split fallback."""
+
+    __slots__ = ("batches", "packets", "samples", "ingest_ns",
+                 "fused_batches")
+
+    def __init__(self):
+        self.batches = 0
+        self.packets = 0
+        self.samples = 0
+        self.ingest_ns = 0
+        self.fused_batches = 0
+
+    def snapshot(self) -> dict:
+        return {"batches": self.batches, "packets": self.packets,
+                "samples": self.samples,
+                "ingest_duration_ns": self.ingest_ns,
+                "fused_batches": self.fused_batches}
+
+
 class DeviceCostRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._entries: dict[str, _Entry] = {}
+        self._readers: dict[str, _ReaderEntry] = {}
         self._readback_bytes = 0
         # persistent compilation cache traffic (fed by the
         # jax.monitoring listener utils/compile_cache installs): a hit
@@ -163,6 +186,20 @@ class DeviceCostRegistry:
         with self._lock:
             self._cache_misses += 1
 
+    def add_reader_batch(self, reader: str, packets: int,
+                         samples: int, dt_ns: int,
+                         fused: bool = False) -> None:
+        """One ingested packet batch attributed to a reader thread
+        (keyed by thread name, e.g. ``udp-reader-2``)."""
+        with self._lock:
+            r = self._readers.setdefault(reader, _ReaderEntry())
+            r.batches += 1
+            r.packets += int(packets)
+            r.samples += int(samples)
+            r.ingest_ns += int(dt_ns)
+            if fused:
+                r.fused_batches += 1
+
     # ------------------------------------------------------------------
 
     def totals(self) -> dict:
@@ -186,6 +223,8 @@ class DeviceCostRegistry:
             return {
                 "kernels": {name: e.snapshot()
                             for name, e in self._entries.items()},
+                "readers": {name: r.snapshot()
+                            for name, r in self._readers.items()},
                 "readback_bytes_total": self._readback_bytes,
                 "compile_cache_hits": self._cache_hits,
                 "compile_cache_misses": self._cache_misses,
