@@ -238,6 +238,30 @@ def test_chain_bench_artifact_committed():
     assert "platform" in d and "gates" in d
 
 
+def test_flush_wide_cardinality_artifact_committed():
+    """bench.py config 5: the columnar flush->emit pipeline at wide
+    cardinality.  The committed artifact must cover >=100k touched
+    series, carry the legacy per-row number measured on the SAME
+    snapshot, and show the columnar path >=5x faster at host emit
+    (ISSUE acceptance bar; bit-level parity is pinned separately by
+    tests/test_columnar_emit.py)."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench_results", "flush_wide_cardinality.json")
+    with open(path) as f:
+        d = json.load(f)
+    assert d["touched_series"] >= 100_000
+    assert d["emitted_metrics"] > d["touched_series"]
+    # end-to-end wall + host_emit vs d2h split all present
+    for key in ("flush_wall_s", "host_emit_s", "d2h_s",
+                "legacy_flush_wall_s", "legacy_host_emit_s"):
+        assert d[key] > 0.0, key
+    assert d["emitted_metrics_per_sec"] >= \
+        5.0 * d["legacy_emitted_metrics_per_sec"]
+    assert d["speedup_vs_legacy"] >= 5.0
+    assert "platform" in d and "gates" in d
+
+
 def _bench_module():
     import importlib.util
     path = os.path.join(

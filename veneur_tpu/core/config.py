@@ -320,6 +320,19 @@ class Config:
     # flush merge rides ICI collectives (parallel/sharded.py).  0 =
     # single-chip table.
     tpu_mesh_shards: int = 0
+    # columnar flush->emit: assemble the flush as a MetricFrame
+    # (parallel NumPy columns over the row-metadata pool) instead of
+    # one InterMetric object per aggregate, and let frame-aware sinks
+    # encode straight off the columns.  VENEUR_TPU_COLUMNAR_EMIT=0
+    # falls back to the per-row legacy loop (kept as the parity
+    # oracle).
+    tpu_columnar_emit: bool = True
+    # per-sink flush fan-out: >0 gives every metric sink its own
+    # dedicated worker thread with a one-slot queue, per-sink timeout
+    # accounting and retry-with-backoff, so one stalled sink can't
+    # stretch the interval for the rest.  0 = legacy shared flush
+    # pool.  VENEUR_TPU_SINK_WORKERS overrides.
+    tpu_sink_workers: int = 1
 
     def resolve_aliases(self) -> None:
         """Fold the reference's deprecated alias keys into their

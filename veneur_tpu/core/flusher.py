@@ -38,12 +38,29 @@ import numpy as np
 
 from veneur_tpu import observe
 from veneur_tpu.core import metrics as im
+from veneur_tpu.core.frame import (MetricFrame, TYPE_COUNTER,
+                                   TYPE_GAUGE)
 from veneur_tpu.core.table import RowMeta, Snapshot
 from veneur_tpu.ops import hll, segment, tdigest
 from veneur_tpu.protocol import dogstatsd as dsd
 
 DEFAULT_AGGREGATES = ("min", "max", "count")
 DEFAULT_PERCENTILES = (0.5, 0.75, 0.99)
+
+# vectorized scope gates: RowMeta.scope as a small int column
+_SCOPE_DEFAULT, _SCOPE_LOCAL, _SCOPE_GLOBAL = 0, 1, 2
+_SCOPE_CODE = {dsd.SCOPE_DEFAULT: _SCOPE_DEFAULT,
+               dsd.SCOPE_LOCAL: _SCOPE_LOCAL,
+               dsd.SCOPE_GLOBAL: _SCOPE_GLOBAL}
+
+
+def _scope_codes(metas: list, rows: np.ndarray) -> np.ndarray:
+    """uint8 scope code per selected row — the one O(touched-rows)
+    Python pass the columnar path makes over metadata (vs the legacy
+    loop's per-AGGREGATE object construction per row)."""
+    code = _SCOPE_CODE
+    return np.fromiter((code[metas[r].scope] for r in rows),
+                       np.uint8, len(rows))
 
 
 def _combine_stats_fn(stats, imp):
@@ -143,6 +160,22 @@ class FlushResult:
     metrics: list[im.InterMetric] = field(default_factory=list)
     forward: list[ForwardRow] = field(default_factory=list)
     tally: dict[str, int] = field(default_factory=dict)
+    # columnar emit: when the flush ran with ``retain_frame=True`` the
+    # emitted aggregates stay in ``frame`` and ``metrics`` holds only
+    # riders appended afterwards (status checks); otherwise the frame
+    # is materialized into ``metrics`` and this is None
+    frame: MetricFrame | None = None
+
+    def metric_count(self) -> int:
+        return len(self.metrics) + (len(self.frame)
+                                    if self.frame is not None else 0)
+
+    def all_metrics(self) -> list[im.InterMetric]:
+        """Every emitted InterMetric (frame materialized + riders) —
+        the adapter consumers like plugins use."""
+        if self.frame is None:
+            return self.metrics
+        return self.frame.materialize() + self.metrics
 
 
 def _percentile_suffix(p: float, naming: str = "precise") -> str:
@@ -166,7 +199,8 @@ class Flusher:
                  aggregates: tuple[str, ...] = DEFAULT_AGGREGATES,
                  hostname: str = "", tags: tuple[str, ...] = (),
                  percentile_naming: str = "precise",
-                 quantile_interpolation: str = "interp"):
+                 quantile_interpolation: str = "interp",
+                 columnar: bool = True):
         self.is_local = is_local
         self.percentiles = tuple(percentiles)
         self.aggregates = tuple(aggregates)
@@ -174,25 +208,47 @@ class Flusher:
         self.common_tags = tuple(tags)
         self.percentile_naming = percentile_naming
         self.quantile_interpolation = quantile_interpolation
+        # VENEUR_TPU_COLUMNAR_EMIT: vectorized MetricFrame assembly
+        # (default).  False runs the per-row legacy loop — kept as the
+        # parity oracle the columnar suite asserts against.
+        self.columnar = columnar
 
     # ------------------------------------------------------------------
 
     def flush(self, snap: Snapshot, now: int | None = None,
-              cycle=None) -> FlushResult:
+              cycle=None, retain_frame: bool = False) -> FlushResult:
         """``cycle`` is an observe.FlushCycle (or the NULL_CYCLE
         default): stage spans and readback accounting for the three
         phases this method owns — device dispatch, readback sync,
-        host emit."""
+        host emit.
+
+        ``retain_frame=True`` (the server's columnar fast path) keeps
+        the emitted aggregates in ``res.frame`` for frame-native sink
+        encoding; otherwise the frame is materialized into
+        ``res.metrics`` so direct callers see the legacy shape either
+        way."""
         if cycle is None:
             cycle = observe.NULL_CYCLE
         ts = int(now if now is not None else time.time())
         res = FlushResult()
         pre = self._prefetch(snap, cycle)
         with cycle.stage("host_emit"):
-            self._flush_counters(snap, ts, res, pre)
-            self._flush_gauges(snap, ts, res, pre)
-            self._flush_histos(snap, ts, res, pre)
-            self._flush_sets(snap, ts, res, pre)
+            if self.columnar:
+                frame = MetricFrame(ts, self.hostname,
+                                    self.common_tags)
+                self._frame_counters(snap, res, pre, frame)
+                self._frame_gauges(snap, res, pre, frame)
+                self._frame_histos(snap, res, pre, frame)
+                self._frame_sets(snap, res, pre, frame)
+                if retain_frame:
+                    res.frame = frame
+                else:
+                    res.metrics.extend(frame.materialize())
+            else:
+                self._flush_counters(snap, ts, res, pre)
+                self._flush_gauges(snap, ts, res, pre)
+                self._flush_histos(snap, ts, res, pre)
+                self._flush_sets(snap, ts, res, pre)
         res.tally["overflow"] = sum(snap.overflow.values())
         return res
 
@@ -384,7 +440,11 @@ class Flusher:
             elif self._emit_local(meta):
                 res.metrics.append(
                     self._mk(meta.name, ts, v, meta, im.COUNTER))
-        res.tally["counters"] = int(snap.counter_touched.sum())
+        # slice to the meta-backed rows before summing so the tally
+        # matches emitted+forwarded rows (the full plane can carry
+        # stale touch bits past len(meta))
+        res.tally["counters"] = int(
+            snap.counter_touched[:len(snap.counter_meta)].sum())
 
     def _flush_gauges(self, snap: Snapshot, ts: int, res: FlushResult,
                       pre: dict) -> None:
@@ -400,7 +460,8 @@ class Flusher:
             elif self._emit_local(meta):
                 res.metrics.append(
                     self._mk(meta.name, ts, v, meta, im.GAUGE))
-        res.tally["gauges"] = int(snap.gauge_touched.sum())
+        res.tally["gauges"] = int(
+            snap.gauge_touched[:len(snap.gauge_meta)].sum())
 
     def _flush_histos(self, snap: Snapshot, ts: int, res: FlushResult,
                       pre: dict) -> None:
@@ -447,7 +508,8 @@ class Flusher:
                                  with_percentiles=emit_pcts or
                                  meta.scope == dsd.SCOPE_LOCAL,
                                  global_mode=global_mode)
-        res.tally["histograms"] = int(snap.histo_touched.sum())
+        res.tally["histograms"] = int(
+            snap.histo_touched[:len(snap.histo_meta)].sum())
 
     def _emit_histo_row(self, res, meta, ts, st, qvals, row,
                         all_pcts, with_percentiles, global_mode=False):
@@ -471,10 +533,14 @@ class Flusher:
                              st_min != float(segment.STAT_MIN_EMPTY)):
             out.append(self._mk(f"{meta.name}.min", ts, st_min, meta,
                                 im.GAUGE))
-        if "sum" in agg and (global_mode or st_sum != 0):
+        # sum/avg gate on SAMPLED (weight != 0), not st_sum != 0, like
+        # the reference (samplers.go:592-607 LocalWeight guards) — a
+        # locally-sampled histogram whose values sum to exactly 0 must
+        # still emit both aggregates
+        if "sum" in agg and (global_mode or sampled):
             out.append(self._mk(f"{meta.name}.sum", ts, st_sum, meta,
                                 im.GAUGE))
-        if "avg" in agg and weight != 0 and (global_mode or st_sum != 0):
+        if "avg" in agg and weight != 0:
             out.append(self._mk(
                 f"{meta.name}.avg", ts, st_sum / weight, meta, im.GAUGE))
         if "count" in agg and (global_mode or sampled):
@@ -512,4 +578,164 @@ class Flusher:
                 res.metrics.append(self._mk(
                     meta.name, ts, float(round(ests[row])), meta,
                     im.GAUGE))
-        res.tally["sets"] = int(snap.set_touched.sum())
+        res.tally["sets"] = int(
+            snap.set_touched[:len(snap.set_meta)].sum())
+
+    # ------------------------------------------------------------------
+    # columnar emit (VENEUR_TPU_COLUMNAR_EMIT, default): the same
+    # routing/gating semantics as the row loops above, evaluated as
+    # boolean arrays over whole planes.  One scope-code pass per class
+    # replaces per-aggregate object construction per row; percentile
+    # suffixes are built once per flush, not once per row.
+
+    def _frame_scalar_class(self, metas, touched, vals, kind,
+                            type_code, res, frame) -> None:
+        """Counters and gauges share one shape: forward global-scope
+        rows on a local node, emit everything else."""
+        rows = np.nonzero(touched[:len(metas)])[0]
+        if not len(rows):
+            return
+        v64 = np.asarray(vals)[rows].astype(np.float64)
+        if self.is_local:
+            sc = _scope_codes(metas, rows)
+            fwd = sc == _SCOPE_GLOBAL
+            for r, v in zip(rows[fwd], v64[fwd]):
+                res.forward.append(ForwardRow(metas[r], kind,
+                                              value=float(v)))
+            emit = ~fwd
+            frame.add_block(metas, rows[emit], v64[emit],
+                            type_code=type_code)
+        else:
+            frame.add_block(metas, rows, v64, type_code=type_code)
+
+    def _frame_counters(self, snap: Snapshot, res: FlushResult,
+                        pre: dict, frame: MetricFrame) -> None:
+        vals = pre.get("counters")
+        if vals is None:
+            return
+        self._frame_scalar_class(snap.counter_meta,
+                                 snap.counter_touched, vals,
+                                 "counter", TYPE_COUNTER, res, frame)
+        res.tally["counters"] = int(
+            snap.counter_touched[:len(snap.counter_meta)].sum())
+
+    def _frame_gauges(self, snap: Snapshot, res: FlushResult,
+                      pre: dict, frame: MetricFrame) -> None:
+        vals = pre.get("gauges")
+        if vals is None:
+            return
+        self._frame_scalar_class(snap.gauge_meta, snap.gauge_touched,
+                                 vals, "gauge", TYPE_GAUGE, res, frame)
+        res.tally["gauges"] = int(
+            snap.gauge_touched[:len(snap.gauge_meta)].sum())
+
+    def _frame_histos(self, snap: Snapshot, res: FlushResult,
+                      pre: dict, frame: MetricFrame) -> None:
+        rows = pre["histo_rows"]
+        if not len(rows):
+            return
+        metas = snap.histo_meta
+        stats = pre["stats"]
+        comb = pre["comb"]
+        qvals = pre.get("qvals")
+        all_pcts = pre["all_pcts"]
+
+        # forward rows first, in row order (same interleave-free
+        # order the legacy loop produces per class)
+        for pos, r in enumerate(pre["histo_fwd"]):
+            res.forward.append(ForwardRow(
+                metas[r], "histo", stats=stats[r].copy(),
+                means=pre["fwd_means"][pos].copy(),
+                weights=pre["fwd_weights"][pos].copy()))
+
+        sc = _scope_codes(metas, rows)
+        if self.is_local:
+            # mixed-scope histos emit local aggregates even while
+            # their digest forwards; global-only histos emit nothing
+            # locally
+            emit = sc != _SCOPE_GLOBAL
+            erows = rows[emit]
+            esc = sc[emit]
+            if not len(erows):
+                res.tally["histograms"] = int(
+                    snap.histo_touched[:len(metas)].sum())
+                return
+            gm = np.zeros(len(erows), dtype=bool)
+            with_pcts = esc == _SCOPE_LOCAL
+        else:
+            erows = rows
+            gm = sc == _SCOPE_GLOBAL
+            with_pcts = np.ones(len(erows), dtype=bool)
+
+        # aggregates for mixed-scope rows come only from the local
+        # plane; rows flushed global use the device-combined plane
+        # (see _flush_histos for the reference mapping)
+        st = np.where(gm[:, None], comb[erows], stats[erows]) \
+            .astype(np.float64)
+        weight = st[:, segment.STAT_WEIGHT]
+        st_min = st[:, segment.STAT_MIN]
+        st_max = st[:, segment.STAT_MAX]
+        st_sum = st[:, segment.STAT_SUM]
+        st_rsum = st[:, segment.STAT_RSUM]
+        sampled = weight != 0
+
+        agg = set(self.aggregates)
+
+        def block(mask, vals, suffix, type_code=TYPE_GAUGE):
+            frame.add_block(metas, erows[mask], vals, suffix,
+                            type_code)
+
+        # sparse-emission gates, identical to _emit_histo_row
+        # (including the sampled-gated sum/avg fix)
+        if "max" in agg:
+            m = gm | (st_max != float(segment.STAT_MAX_EMPTY))
+            block(m, st_max[m], ".max")
+        if "min" in agg:
+            m = gm | (st_min != float(segment.STAT_MIN_EMPTY))
+            block(m, st_min[m], ".min")
+        if "sum" in agg:
+            m = gm | sampled
+            block(m, st_sum[m], ".sum")
+        if "avg" in agg:
+            m = weight != 0
+            block(m, st_sum[m] / weight[m], ".avg")
+        if "count" in agg:
+            m = gm | sampled
+            block(m, weight[m], ".count", TYPE_COUNTER)
+        if "hmean" in agg:
+            m = (weight != 0) & (st_rsum != 0)
+            block(m, weight[m] / st_rsum[m], ".hmean")
+        if qvals is not None:
+            q64 = qvals[erows].astype(np.float64)
+            if "median" in agg:
+                m = np.ones(len(erows), dtype=bool)
+                block(m, q64[:, len(all_pcts) - 1], ".median")
+            for pi, p in enumerate(self.percentiles):
+                suffix = "." + _percentile_suffix(
+                    p, self.percentile_naming)
+                block(with_pcts, q64[with_pcts, pi], suffix)
+        res.tally["histograms"] = int(
+            snap.histo_touched[:len(metas)].sum())
+
+    def _frame_sets(self, snap: Snapshot, res: FlushResult,
+                    pre: dict, frame: MetricFrame) -> None:
+        rows = pre["set_rows"]
+        if not len(rows):
+            return
+        metas = snap.set_meta
+        ests = pre.get("ests")
+        fwd = pre.get("set_fwd", ())
+        for pos, r in enumerate(fwd):
+            res.forward.append(ForwardRow(
+                metas[r], "set", regs=pre["fwd_regs"][pos].copy()))
+        in_fwd = np.zeros(len(rows), dtype=bool)
+        if fwd:
+            in_fwd = np.isin(rows, np.asarray(fwd))
+        sc = _scope_codes(metas, rows)
+        emit = ~in_fwd & ~((sc == _SCOPE_GLOBAL) & self.is_local)
+        erows = rows[emit]
+        if len(erows) and ests is not None:
+            vals = np.round(np.asarray(ests)[erows]).astype(np.float64)
+            frame.add_block(metas, erows, vals)
+        res.tally["sets"] = int(
+            snap.set_touched[:len(metas)].sum())

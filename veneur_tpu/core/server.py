@@ -182,7 +182,8 @@ class Server:
                       else socket.gethostname()),
             tags=tuple(config.tags),
             percentile_naming=config.percentile_naming,
-            quantile_interpolation=config.quantile_interpolation)
+            quantile_interpolation=config.quantile_interpolation,
+            columnar=bool(getattr(config, "tpu_columnar_emit", True)))
 
         self.metric_sinks: list = list(extra_sinks or [])
         self.plugins: list = list(extra_plugins or [])
@@ -246,6 +247,16 @@ class Server:
         self.telemetry = Telemetry(self)
         self._sink_durations: dict[str, float] = {}
         self._flush_pending: dict[str, object] = {}
+        # per-sink flush fan-out (VENEUR_TPU_SINK_WORKERS > 0): every
+        # metric sink gets a dedicated worker + one-slot queue so a
+        # stalled sink times out alone instead of holding a shared
+        # pool slot; 0 falls back to the shared flush pool
+        self._fanout = None
+        if int(getattr(config, "tpu_sink_workers", 1)) > 0:
+            from veneur_tpu.sinks.fanout import SinkFanout
+            self._fanout = SinkFanout(
+                [s.name for s in self.metric_sinks],
+                on_error=lambda name, exc: self.bump("flush_errors"))
         self._tls_context = self._build_tls()
 
         # serializes whole flushes: the ticker thread and a manual
@@ -1186,6 +1197,12 @@ class Server:
                             "dropped": server.trace_client.dropped,
                             "errors": server.trace_client.errors,
                         },
+                        # per-sink flush duration/error counters from
+                        # the fan-out workers; {} when
+                        # tpu_sink_workers=0
+                        "sinks": (server._fanout.stats()
+                                  if server._fanout is not None
+                                  else {}),
                         "last_flush_age_s": round(
                             time.monotonic() - server.last_flush, 3),
                     })
@@ -1340,8 +1357,10 @@ class Server:
                     self.events, self.checks = [], []
                     status = self.table.take_status()
         # dispatch / device_wait / host_emit stages happen inside the
-        # flusher, against the same cycle
-        res = self.flusher.flush(snap, cycle=cyc)
+        # flusher, against the same cycle; retain_frame keeps the
+        # columnar MetricFrame alive for frame-aware sinks instead of
+        # materializing InterMetrics eagerly
+        res = self.flusher.flush(snap, cycle=cyc, retain_frame=True)
         # the interval's reads are done (forward rows hold copies);
         # recycle the host set plane into the table's reuse pool
         snap.release()
@@ -1384,15 +1403,22 @@ class Server:
                 self._forward(rows)
 
         with cyc.stage("sink_flush"):
+            fanout_tasks = []
             for sink in self.metric_sinks:
-                batch = sinks_base.route(
-                    res.metrics, sink.name, sink
-                    if isinstance(sink, sinks_base.SinkBase) else None)
-                submit(f"sink:{sink.name}", self._safe_sink_flush,
-                       sink, batch, events + checks)
+                fn = self._sink_flush_fn(sink, res, events + checks,
+                                         cyc)
+                if self._fanout is not None:
+                    task = self._fanout.dispatch(sink.name, fn)
+                    if task is not None:
+                        fanout_tasks.append(task)
+                    else:
+                        self.bump("flush_skipped_busy")
+                else:
+                    submit(f"sink:{sink.name}",
+                           self._guarded_sink_flush, fn)
             for plugin in self.plugins:
                 submit(f"plugin:{plugin.name}", plugin.flush,
-                       list(res.metrics), self.flusher.hostname)
+                       list(res.all_metrics()), self.flusher.hostname)
             if self.is_local and res.forward:
                 submit("forward", traced_forward, res.forward)
             submit("spans", self.span_worker.flush)
@@ -1402,7 +1428,15 @@ class Server:
             # wedged global can never delay the next tick.  Overrunning
             # tasks keep running on the pool and are counted, not
             # cancelled.
-            deadline = t_flush0 / 1e9 + self.interval * 0.9
+            # floored so tiny test intervals under load still give
+            # healthy sinks a moment to land — a wedged sink only ever
+            # eats one wait (its next dispatch busy-drops un-awaited)
+            deadline = t_flush0 / 1e9 + max(self.interval * 0.9, 1.0)
+            if fanout_tasks:
+                for name in self._fanout.wait(fanout_tasks, deadline):
+                    self.bump("flush_slow_tasks")
+                    log.warning("sink %s overran the interval budget;"
+                                " its worker keeps running", name)
             for f in futures:
                 try:
                     f.result(timeout=max(0.0,
@@ -1420,7 +1454,7 @@ class Server:
         with self._stats_lock:
             sink_durs = dict(self._sink_durations)
             self._sink_durations.clear()
-        cyc.record.metrics_emitted = len(res.metrics)
+        cyc.record.metrics_emitted = res.metric_count()
         cyc.record.forward_rows = len(res.forward)
         cyc.record.tally = dict(res.tally)
         try:
@@ -1429,21 +1463,60 @@ class Server:
                 record=cyc.record)
         except Exception:
             log.exception("self-telemetry emission failed")
+        # flush_once callers see the legacy FlushResult shape: fold
+        # the frame back into res.metrics (sink closures bound the
+        # frame object itself, so late workers are unaffected; the
+        # materialization is cached on the frame either way)
+        if res.frame is not None:
+            res.metrics.extend(res.frame.materialize())
+            res.frame = None
         return res
 
-    def _safe_sink_flush(self, sink, batch, other) -> None:
-        t0 = time.monotonic_ns()
+    def _sink_flush_fn(self, sink, res, other, cyc):
+        """Build the flush closure for one sink: routing (whitelists +
+        excluded tags) happens HERE on the flush thread — vectorized
+        per pool row for frames — so the worker only encodes and
+        POSTs.  Frame-aware sinks get the routed MetricFrame; everyone
+        else gets the routed legacy list (materialized once, shared).
+        The closure raises on failure so the fan-out worker can
+        retry."""
+        base = sink if isinstance(sink, sinks_base.SinkBase) else None
+        frame = res.frame
+        if frame is not None and hasattr(sink, "flush_frame"):
+            extra = sinks_base.route(res.metrics, sink.name, base)
+            payload = frame.route(sink.name, sink, extra=extra)
+
+            def call():
+                sink.flush_frame(payload)
+        else:
+            batch = sinks_base.route(res.all_metrics(), sink.name,
+                                     base)
+
+            def call():
+                sink.flush(batch)
+
+        def fn():
+            t0 = time.monotonic_ns()
+            try:
+                with cyc.stage(f"sink.{sink.name}"):
+                    call()
+                    if other:
+                        sink.flush_other_samples(other)
+            finally:
+                with self._stats_lock:
+                    self._sink_durations[sink.name] = (
+                        self._sink_durations.get(sink.name, 0) +
+                        time.monotonic_ns() - t0)
+        return fn
+
+    def _guarded_sink_flush(self, fn) -> None:
+        """Shared-pool wrapper (tpu_sink_workers=0): same
+        swallow-and-count stance the pool path always had."""
         try:
-            sink.flush(batch)
-            if other:
-                sink.flush_other_samples(other)
+            fn()
         except Exception:
             self.bump("flush_errors")
-            log.exception("sink %s flush failed", sink.name)
-        with self._stats_lock:
-            self._sink_durations[sink.name] = (
-                self._sink_durations.get(sink.name, 0) +
-                time.monotonic_ns() - t0)
+            log.exception("sink flush failed")
 
     def _maybe_fall_back_to_cpu(self) -> None:
         """Metrics must flow even when the accelerator is sick: probe
@@ -1617,6 +1690,8 @@ class Server:
                 except Exception:
                     pass
         self._pool.shutdown(wait=False)
+        if self._fanout is not None:
+            self._fanout.stop()
         # close releases the flock; the lock FILE stays (unlinking it
         # would race two starting instances onto different inodes of
         # the same path, each holding "the" lock — the reference's

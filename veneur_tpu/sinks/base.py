@@ -17,6 +17,17 @@ from veneur_tpu.core.metrics import InterMetric
 log = logging.getLogger("veneur_tpu.sinks")
 
 
+def jfloat(v: float) -> str:
+    """JSON number text for a float without a per-value json.dumps
+    call (the columnar encoders' hot path); non-finite falls back to
+    the stdlib spelling (NaN, Infinity) so wire bytes match the
+    legacy dict encoders."""
+    if v == v and abs(v) != float("inf"):
+        return repr(v)
+    import json
+    return json.dumps(v)
+
+
 @runtime_checkable
 class MetricSink(Protocol):
     name: str
@@ -85,6 +96,14 @@ class SinkBase:
 
     def start(self) -> None:
         pass
+
+    def flush_frame(self, frame) -> None:
+        """Columnar fast path (core.frame.MetricFrame).  The frame
+        handed here is already routed for this sink (whitelists +
+        excluded tags applied), so the adapter just materializes the
+        legacy list for sinks that never learned frames; concrete
+        sinks override to encode straight off the columns."""
+        self.flush(frame.materialize())
 
     def flush_other_samples(self, samples: list) -> None:
         pass

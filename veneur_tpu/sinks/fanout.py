@@ -1,0 +1,166 @@
+"""Per-sink flush workers: parallel fan-out with isolation.
+
+The shared-pool flush (server._flush_stages) already runs sinks
+concurrently, but a sink that stalls past the interval budget still
+holds its pool slot and its future is merely abandoned — repeated
+stalls pile abandoned flushes onto the shared executor that ingest
+telemetry also rides on.  Here every sink owns ONE worker thread and a
+bounded handoff queue:
+
+- a stalled sink times out (counted) without delaying the others —
+  its worker is still busy next interval, so the new flush is a
+  counted ``busy_drop`` instead of a queue pile-up (mirroring the
+  reference's drop-don't-buffer flush stance, flusher.go:536-549)
+- transient sink errors retry in-worker with exponential backoff,
+  bounded so retries can't bleed past the next interval
+- per-sink duration/error/timeout/drop counters feed ``/debug/vars``
+  and the flush-cycle trace
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+
+log = logging.getLogger("veneur_tpu.sinks.fanout")
+
+
+class FlushTask:
+    __slots__ = ("fn", "done", "error", "duration", "name")
+
+    def __init__(self, name: str, fn):
+        self.name = name
+        self.fn = fn
+        self.done = threading.Event()
+        self.error: BaseException | None = None
+        self.duration = 0.0
+
+
+class _SinkWorker:
+    def __init__(self, name: str, retries: int, backoff: float,
+                 on_error=None):
+        self.name = name
+        self.retries = max(0, int(retries))
+        self.backoff = backoff
+        self.on_error = on_error
+        # one slot: at most one flush queued behind the running one
+        self.queue: queue.Queue = queue.Queue(maxsize=1)
+        self.flushes = 0
+        self.errors = 0
+        self.retry_count = 0
+        self.timeouts = 0
+        self.busy_drops = 0
+        self.last_duration = 0.0
+        self.total_duration = 0.0
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"sink-flush-{name}")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            task = self.queue.get()
+            if task is None:
+                return
+            start = time.perf_counter()
+            try:
+                for attempt in range(self.retries + 1):
+                    try:
+                        task.fn()
+                        break
+                    except Exception as e:
+                        if attempt == self.retries:
+                            self.errors += 1
+                            task.error = e
+                            log.warning("sink %s flush failed after "
+                                        "%d attempts: %s", self.name,
+                                        attempt + 1, e)
+                            if self.on_error is not None:
+                                try:
+                                    self.on_error(self.name, e)
+                                except Exception:
+                                    pass
+                        else:
+                            self.retry_count += 1
+                            time.sleep(self.backoff * (2 ** attempt))
+            finally:
+                task.duration = time.perf_counter() - start
+                self.flushes += 1
+                self.last_duration = task.duration
+                self.total_duration += task.duration
+                task.done.set()
+
+    def stats(self) -> dict:
+        return {
+            "flushes": self.flushes,
+            "errors": self.errors,
+            "retries": self.retry_count,
+            "timeouts": self.timeouts,
+            "busy_drops": self.busy_drops,
+            "last_duration_s": round(self.last_duration, 6),
+            "total_duration_s": round(self.total_duration, 6),
+        }
+
+
+class SinkFanout:
+    """One worker per sink name; ``dispatch`` hands a flush closure to
+    the sink's worker, ``wait`` blocks until all handed-off flushes
+    finish or the interval budget runs out (timed-out flushes keep
+    running on their own worker — isolation, not cancellation)."""
+
+    def __init__(self, names, retries: int = 2, backoff: float = 0.25,
+                 on_error=None):
+        self._retries = retries
+        self._backoff = backoff
+        self._on_error = on_error
+        self._workers = {n: _SinkWorker(n, retries, backoff, on_error)
+                         for n in names}
+        self._lock = threading.Lock()
+
+    def ensure(self, name: str) -> None:
+        with self._lock:
+            if name not in self._workers:
+                self._workers[name] = _SinkWorker(
+                    name, self._retries, self._backoff, self._on_error)
+
+    def dispatch(self, name: str, fn) -> FlushTask | None:
+        """Queue a flush on the sink's worker; returns None (and
+        counts a busy_drop) when the worker is still saturated by the
+        previous interval."""
+        self.ensure(name)
+        w = self._workers[name]
+        task = FlushTask(name, fn)
+        try:
+            w.queue.put_nowait(task)
+        except queue.Full:
+            w.busy_drops += 1
+            log.warning("sink %s still flushing previous interval; "
+                        "dropping this flush", name)
+            return None
+        return task
+
+    def wait(self, tasks, deadline: float) -> list[str]:
+        """Wait until every task completes or ``deadline`` (absolute
+        monotonic time) passes; returns names of sinks that timed
+        out."""
+        late: list[str] = []
+        for task in tasks:
+            remaining = deadline - time.monotonic()
+            if not task.done.wait(max(0.0, remaining)):
+                self._workers[task.name].timeouts += 1
+                late.append(task.name)
+        return late
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {n: w.stats() for n, w in self._workers.items()}
+
+    def stop(self) -> None:
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            try:
+                w.queue.put_nowait(None)
+            except queue.Full:
+                pass

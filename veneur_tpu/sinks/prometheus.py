@@ -13,6 +13,7 @@ from __future__ import annotations
 import logging
 import socket
 
+from veneur_tpu.core.frame import TYPE_COUNTER as COUNTER_CODE
 from veneur_tpu.core.metrics import COUNTER, InterMetric
 from veneur_tpu.sinks.base import SinkBase
 
@@ -64,17 +65,63 @@ class PrometheusRepeaterSink(SinkBase):
     def flush(self, metrics: list[InterMetric]) -> None:
         if not metrics:
             return
-        payload = b"".join(self._line(m) for m in metrics)
+        self._send(self._line(m) for m in metrics)
+
+    def flush_frame(self, frame) -> None:
+        """Columnar fast path: stream statsd lines straight off the
+        frame blocks.  The joined tag string is built once per POOL
+        ROW and shared by every aggregate block over that row."""
+        self._send(self._frame_lines(frame))
+
+    def _frame_lines(self, frame):
+        fmt = self._fmt_value
+        tag_cache: dict = {}
+        for b in frame.blocks:
+            metas = b.metas
+            suffix = b.suffix
+            token = "c" if b.type_code == COUNTER_CODE else "g"
+            vals = b.values
+            for j in range(len(b.rows)):
+                r = int(b.rows[j])
+                key = (id(metas), r)
+                tagstr = tag_cache.get(key)
+                if tagstr is None:
+                    tagstr = ",".join(frame.block_tags(b, j))
+                    tag_cache[key] = tagstr
+                yield (f"{metas[r].name}{suffix}:"
+                       f"{fmt(float(vals[j]))}|{token}|#"
+                       f"{tagstr}\n").encode()
+        for m in frame.extra:
+            yield self._line(m)
+
+    _TCP_BUF = 1 << 16
+
+    def _send(self, lines) -> None:
+        """Streaming writer: UDP sends one datagram per line (stay
+        under MTU); TCP coalesces lines into ~64KB writes on one
+        connection instead of materializing the whole payload."""
         try:
             if self.network_type == "udp":
                 s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-                # stay under typical MTU per datagram
-                for m in metrics:
-                    s.sendto(self._line(m), self.addr)
+                for line in lines:
+                    s.sendto(line, self.addr)
                 s.close()
             else:
-                with socket.create_connection(self.addr,
-                                              timeout=5.0) as s:
-                    s.sendall(payload)
+                buf: list[bytes] = []
+                size = 0
+                sock = None
+                for line in lines:
+                    if sock is None:
+                        sock = socket.create_connection(self.addr,
+                                                        timeout=5.0)
+                    buf.append(line)
+                    size += len(line)
+                    if size >= self._TCP_BUF:
+                        sock.sendall(b"".join(buf))
+                        buf, size = [], 0
+                if sock is not None:
+                    if buf:
+                        sock.sendall(b"".join(buf))
+                    sock.close()
         except OSError as e:
             log.warning("prometheus repeater flush failed: %s", e)

@@ -14,8 +14,9 @@ import logging
 import threading
 import urllib.request
 
+from veneur_tpu.core.frame import TYPE_COUNTER as COUNTER_CODE
 from veneur_tpu.core.metrics import COUNTER, InterMetric
-from veneur_tpu.sinks.base import SinkBase
+from veneur_tpu.sinks.base import SinkBase, jfloat as _jfloat
 
 log = logging.getLogger("veneur_tpu.sinks")
 
@@ -97,9 +98,12 @@ class SignalFxSink(SinkBase):
                         "(keeping last good map): %s", e)
 
     def _token_for(self, m: InterMetric) -> str:
+        return self._token_for_tags(m.tags)
+
+    def _token_for_tags(self, tags) -> str:
         if self.vary_key_by:
             with self._keys_lock:
-                for t in m.tags:
+                for t in tags:
                     k, _, v = t.partition(":")
                     if (k == self.vary_key_by and
                             v in self.per_tag_api_keys):
@@ -148,15 +152,75 @@ class SignalFxSink(SinkBase):
                     chunk[kind].append(p)
                 self._post(token, chunk)
 
+    def flush_frame(self, frame) -> None:
+        """Columnar fast path: JSON datapoint fragments straight off
+        the frame columns.  Dimensions, drop decisions, and the
+        vary-by-tag token are resolved once per POOL ROW and reused by
+        every aggregate block over that row; only the suffixed name
+        and the value vary per point."""
+        if frame.extra:
+            self.flush(frame.extra)
+        by_token: dict[str, list] = {}  # token -> [(kind, frag)]
+        row_cache: dict = {}
+        ts_ms = frame.ts * 1000
+        drops = self.name_prefix_drops
+        for b in frame.blocks:
+            metas = b.metas
+            suffix = b.suffix
+            kind = "counter" if b.type_code == COUNTER_CODE else "gauge"
+            vals = b.values
+            for j in range(len(b.rows)):
+                r = int(b.rows[j])
+                name = metas[r].name + suffix
+                if drops and any(name.startswith(p) for p in drops):
+                    continue
+                key = (id(metas), r)
+                hit = row_cache.get(key)
+                if hit is None:
+                    tags = frame.block_tags(b, j)
+                    if any(t.startswith(p) for t in tags
+                           for p in self.tag_prefix_drops):
+                        hit = (None, "")  # whole-metric drop
+                    else:
+                        dims = {}
+                        for t in tags:
+                            k, _, v = t.partition(":")
+                            dims[k] = v
+                        if frame.hostname:
+                            dims.setdefault(self.hostname_tag,
+                                            frame.hostname)
+                        hit = (self._token_for_tags(tags),
+                               json.dumps(dims))
+                    row_cache[key] = hit
+                token, dims_json = hit
+                if token is None:
+                    continue
+                by_token.setdefault(token, []).append((kind, (
+                    '{"metric":%s,"value":%s,"timestamp":%d,'
+                    '"dimensions":%s}' % (json.dumps(name),
+                                          _jfloat(float(vals[j])),
+                                          ts_ms, dims_json))))
+        for token, points in by_token.items():
+            for i in range(0, len(points), self.max_per_body):
+                chunk = points[i:i + self.max_per_body]
+                raw = ('{"gauge":[%s],"counter":[%s]}' % (
+                    ",".join(f for k, f in chunk if k == "gauge"),
+                    ",".join(f for k, f in chunk
+                             if k == "counter"))).encode()
+                self._post_body(token, raw, len(chunk))
+
     def _post(self, token: str, body: dict) -> None:
+        self._post_body(token, json.dumps(body).encode(),
+                        len(body["gauge"]) + len(body["counter"]))
+
+    def _post_body(self, token: str, raw: bytes, npoints: int) -> None:
         req = urllib.request.Request(
-            f"{self.endpoint}/v2/datapoint",
-            data=json.dumps(body).encode(),
+            f"{self.endpoint}/v2/datapoint", data=raw,
             headers={"Content-Type": "application/json",
                      "X-SF-Token": token}, method="POST")
         with urllib.request.urlopen(req, timeout=10.0) as r:
             r.read()
-        self.flushed_total += len(body["gauge"]) + len(body["counter"])
+        self.flushed_total += npoints
 
     # -- events (reference FlushOtherSamples/reportEvent,
     #    signalfx.go:501-592) ------------------------------------------
