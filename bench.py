@@ -592,6 +592,22 @@ def bench_global_merge() -> dict:
     res_d["mean_items_per_sec"] = res_d.pop("mean_samples_per_sec")
     res_d["warm_mean_items_per_sec"] = res_d.pop(
         "warm_mean_samples_per_sec")
+    # headline = median of WARM intervals across every pass: each
+    # pass's first timed interval still carries residual compile /
+    # row-allocation drag on a cold cache (that skew cost the r05
+    # capture ~30% run to run); items-per-interval is constant, so
+    # the rate is that count over the median warm interval
+    warm_ivs: list = []
+    ipi = 0.0
+    for p in res_d["passes"]:
+        if p["intervals"]:
+            ipi = p["samples"] / p["intervals"]
+            warm_ivs.extend(p["interval_seconds"][1:]
+                            or p["interval_seconds"])
+    if warm_ivs and ipi:
+        med_warm = sorted(warm_ivs)[len(warm_ivs) // 2]
+        res_d["items_per_sec"] = round(ipi / med_warm, 1)
+        res_d["headline_policy"] = "median_warm_interval"
     res_d["locals"] = n_locals
     res_d["quantile_rows_read"] = int(np.isfinite(q).all(axis=1).sum())
 
@@ -638,8 +654,39 @@ def bench_global_merge() -> dict:
     for _ in range(8):
         apply_metric_list_bytes(dst, wire_lists[0])
     phases["apply_per_wire"] = round((time.perf_counter() - t0) / 8, 5)
+    # same-host oracle: the per-metric protobuf path the native
+    # columnar decode + wire-plan cache replaced (kept in
+    # grpc_forward as the fallback) — the artifact's speedup claim
+    # is this A/B, measured in the same process on the same wires
+    from veneur_tpu.forward.gen import forward_pb2 as _fpb
+    t0 = time.perf_counter()
+    for _ in range(8):
+        _gf.apply_metric_list(
+            dst, _fpb.MetricList.FromString(wire_lists[0]))
+    phases["oracle_apply_per_wire"] = round(
+        (time.perf_counter() - t0) / 8, 5)
     res_d["phases"] = phases
     return res_d
+
+
+def global_merge_import() -> dict:
+    """``--global-merge``: config 4 as a committed, platform-stamped
+    artifact (bench_results/global_merge_import.json) with the
+    per-wire decode/apply phase splits and the same-host protobuf
+    per-metric oracle A/B that tests/test_bench_gates.py gates."""
+    out: dict = {"mode": "global_merge_import", "quick": QUICK}
+    out.update(_backend_info())
+    out["captured_unix"] = round(time.time(), 1)
+    out.update(bench_global_merge())
+    ph = out.get("phases", {})
+    if ph.get("apply_per_wire") and ph.get("oracle_apply_per_wire"):
+        out["apply_speedup_vs_oracle"] = round(
+            ph["oracle_apply_per_wire"] / ph["apply_per_wire"], 2)
+    if ph.get("apply_decode_host"):
+        out["apply_decode_host_per_wire"] = round(
+            ph["apply_decode_host"] / out["locals"], 5)
+    _save_artifact("global_merge_import", out)
+    return out
 
 
 
@@ -2155,6 +2202,8 @@ if __name__ == "__main__":
         print(json.dumps(pallas_parity()))
     elif "--chain" in sys.argv:
         print(json.dumps(chain_bench()))
+    elif "--global-merge" in sys.argv:
+        print(json.dumps(global_merge_import()))
     elif "--config" in sys.argv:
         _run_one_config(sys.argv[sys.argv.index("--config") + 1])
     else:

@@ -262,6 +262,37 @@ def test_flush_wide_cardinality_artifact_committed():
     assert "platform" in d and "gates" in d
 
 
+def test_global_merge_artifact_committed():
+    """bench.py --global-merge: config 4 (device-resident global
+    import) as a committed artifact.  The headline is the median of
+    WARM intervals, and the per-wire claims are a same-host A/B
+    against the per-metric protobuf oracle the native columnar decode
+    replaced — platform-relative, so the gate holds on the CPU
+    capture too; the absolute BENCH_r05 2x bar (>=46k items/s)
+    applies when the artifact was captured on the device."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench_results", "global_merge_import.json")
+    with open(path) as f:
+        d = json.load(f)
+    assert d["mode"] == "global_merge_import" and d["quick"] is False
+    assert d["headline_policy"] == "median_warm_interval"
+    assert d["items_per_sec"] > 0
+    assert d["locals"] == 64
+    # native columnar decode + wire-plan cache vs protobuf per-metric
+    # oracle, same process, same wires: the ISSUE's 2x floor with
+    # margin
+    assert d["apply_speedup_vs_oracle"] >= 2.0
+    ph = d["phases"]
+    assert ph["decode_only_per_wire"] <= 0.002
+    # host decode+apply per forwarded wire (256 digests + 64 sets)
+    assert d["apply_decode_host_per_wire"] <= 0.005
+    assert "platform" in d and "gates" in d
+    if d["platform"] == "tpu":
+        assert d["items_per_sec"] >= 46_000
+        assert d["apply_decode_host_per_wire"] <= 0.002
+
+
 def _bench_module():
     import importlib.util
     path = os.path.join(

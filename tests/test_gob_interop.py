@@ -185,3 +185,243 @@ def test_old_gob_digest_backwards_compat():
     god.main_total = float(w.sum())
     god.min, god.max = d["min"], d["max"]
     assert abs(god.quantile(0.5) - 500.0) / 500.0 <= 0.02
+
+
+# ----------------------------------------------------------------------
+# round-trip fuzz + native batch-decoder parity (the vtpu_gob_decode
+# column path must agree byte-for-byte with the Python codec on every
+# stream the codec itself can produce, plus the fail-open truncations)
+
+
+def _native_cols(payloads):
+    cols = gob_codec.decode_batch(
+        payloads, [gob_codec.KIND_DIGEST] * len(payloads))
+    if cols is None:
+        pytest.skip("native library unavailable")
+    return cols
+
+
+def _assert_native_matches(payloads, decoded):
+    """decode_batch columns == per-item decode_digest results, bit
+    for bit (NaN-aware on the stats)."""
+    cols = _native_cols(payloads)
+    for i, d in enumerate(decoded):
+        s, c = int(cols["cent_start"][i]), int(cols["cent_cnt"][i])
+        assert cols["err"][i] == 0
+        np.testing.assert_array_equal(cols["means"][s:s + c],
+                                      d["means"])
+        np.testing.assert_array_equal(cols["weights"][s:s + c],
+                                      d["weights"])
+        got = cols["dstats"][i]  # min, max, rsum, compression
+        for gv, ev in zip(got, (d["min"], d["max"], d["rsum"],
+                                d["compression"])):
+            assert (gv == ev) or (np.isnan(gv) and np.isnan(ev))
+
+
+def test_gob_roundtrip_fuzz_vs_go_model():
+    """encode -> decode -> re-encode is a byte fixed point on digests
+    the Go model built (realistic centroid structure after k-scale
+    merges), with zero-weight centroids interleaved in the input —
+    dropped on the wire exactly like the reference encoder's w>0
+    filter — and the native batch decoder agreeing on every stream."""
+    from tests.go_digest_model import GoMergingDigest
+    rng = np.random.default_rng(23)
+    payloads, decoded = [], []
+    for trial in range(6):
+        god = GoMergingDigest(100.0)
+        god.add_many(rng.gamma(2.0, 30.0, 3000 + 500 * trial))
+        god._merge_all_temps()
+        means = np.asarray(god.main_mean, np.float32)
+        weights = np.asarray(god.main_weight, np.float32)
+        live = weights > 0
+        means, weights = means[live], weights[live]
+        # zero-weight slots the encoder must drop
+        means_in = np.concatenate([means, [5.5, 0.0]])
+        weights_in = np.concatenate([weights, [0.0, 0.0]])
+        enc = gob_codec.encode_digest(
+            means_in, weights_in, god.compression, god.min, god.max,
+            god.reciprocal_sum)
+        d = gob_codec.decode_digest(enc)
+        np.testing.assert_array_equal(d["means"], means)
+        np.testing.assert_array_equal(d["weights"], weights)
+        assert d["min"] == god.min and d["max"] == god.max
+        assert d["rsum"] == god.reciprocal_sum
+        enc2 = gob_codec.encode_digest(
+            d["means"], d["weights"], d["compression"], d["min"],
+            d["max"], d["rsum"])
+        assert enc2 == enc
+        payloads.append(enc)
+        decoded.append(d)
+    _assert_native_matches(payloads, decoded)
+
+
+def test_gob_nonfinite_minmax_roundtrip():
+    """An EMPTY digest carries min=+inf / max=-inf (the reference's
+    zero state) and a NaN sneaks through unharmed: the codec must
+    transport the bits faithfully — rejecting nonfinite state is the
+    import layer's job, not the wire's."""
+    cases = [([], [], float("inf"), float("-inf")),
+             ([2.5], [1.0], float("nan"), float("nan")),
+             ([2.5], [1.0], float("-inf"), float("inf"))]
+    payloads, decoded = [], []
+    for means, wts, vmin, vmax in cases:
+        enc = gob_codec.encode_digest(means, wts, 100.0, vmin, vmax,
+                                      0.0)
+        d = gob_codec.decode_digest(enc)
+        assert (d["min"] == vmin) or (np.isnan(d["min"])
+                                      and np.isnan(vmin))
+        assert (d["max"] == vmax) or (np.isnan(d["max"])
+                                      and np.isnan(vmax))
+        enc2 = gob_codec.encode_digest(
+            d["means"], d["weights"], d["compression"], d["min"],
+            d["max"], d["rsum"])
+        assert enc2 == enc
+        payloads.append(enc)
+        decoded.append(d)
+    _assert_native_matches(payloads, decoded)
+
+
+def test_gob_truncation_fails_open_like_reference():
+    """Cutting the stream after the centroid slice (an old-generation
+    Go digest predates reciprocalSum; older still lack min/max) must
+    fail OPEN with the reference decoder's defaults — and the native
+    decoder must produce the identical fail-open values."""
+    enc = gob_codec.encode_digest([1.0, 9.0], [2.0, 1.0], 50.0,
+                                  1.0, 9.0, 0.75)
+    # message boundaries: typedefs, slice, comp, min, max, rsum
+    bounds, pos = [], 0
+    while pos < len(enc):
+        n, p = gob_codec._read_uint(enc, pos)
+        pos = p + n
+        bounds.append(pos)
+    expect = [(3, (50.0, 1.0, 9.0, 0.0)),     # rsum missing
+              (2, (50.0, 1.0, float("-inf"), 0.0)),
+              (1, (50.0, float("inf"), float("-inf"), 0.0)),
+              (0, (100.0, float("inf"), float("-inf"), 0.0))]
+    payloads, decoded = [], []
+    for n_floats, (comp, vmin, vmax, rsum) in expect:
+        cut = enc[:bounds[-(5 - n_floats)]]
+        d = gob_codec.decode_digest(cut)
+        assert (d["compression"], d["min"], d["max"],
+                d["rsum"]) == (comp, vmin, vmax, rsum)
+        assert list(d["weights"]) == [2.0, 1.0]
+        payloads.append(cut)
+        decoded.append(d)
+    _assert_native_matches(payloads, decoded)
+
+
+def test_gob_multibyte_message_length():
+    """A centroid slice past 64KiB forces >2-byte gob uint lengths on
+    the message frame (the reference hits this on debug-mode digests
+    with Samples attached); both decoders must walk it."""
+    n = 12_000
+    means = (np.arange(n, dtype=np.float32) + 0.5) * 3.0
+    wts = np.ones(n, np.float32)
+    enc = gob_codec.encode_digest(means, wts, 100.0, float(means[0]),
+                                  float(means[-1]), 0.0)
+    assert len(enc) > (1 << 16)  # 3-byte length actually exercised
+    d = gob_codec.decode_digest(enc)
+    np.testing.assert_array_equal(d["means"], means)
+    assert float(d["weights"].sum()) == float(n)
+    _assert_native_matches([enc], [d])
+
+
+def test_native_batch_isolates_malformed_items():
+    """One malformed payload in a batch must flag err=1 for that item
+    only; well-formed siblings still decode (the per-item codec's
+    exception isolation, column-shaped)."""
+    good = gob_codec.encode_digest([1.0], [1.0], 100.0, 1.0, 1.0, 0.0)
+    cols = _native_cols([good, b"\xff\xff\xff", good, b""])
+    assert list(cols["err"]) == [0, 1, 0, 1]
+    for i in (0, 2):
+        s, c = int(cols["cent_start"][i]), int(cols["cent_cnt"][i])
+        assert list(cols["means"][s:s + c]) == [1.0]
+
+
+# ----------------------------------------------------------------------
+# batched columnar /import apply vs the per-item oracle
+
+
+def _mixed_reference_body():
+    """A real flush's reference-schema wire plus deliberately
+    malformed riders: bad base64, truncated gob, unknown type, NaN
+    gauge, non-finite digest stats."""
+    rng = np.random.default_rng(11)
+    src = MetricTable(TableConfig())
+    vals = rng.gamma(2.0, 30.0, 2000).astype(np.float32)
+    for v in vals:
+        src.ingest(dsd.Sample(name="lat", type=dsd.TIMER,
+                              value=float(v)))
+    for v in vals[:500]:
+        src.ingest(dsd.Sample(name="lat2", type=dsd.HISTOGRAM,
+                              value=float(v), tags=("env:prod",)))
+    for i in range(600):
+        src.ingest(dsd.Sample(name="uniq", type=dsd.SET,
+                              value=f"u{i}".encode()))
+    for i in range(10):
+        src.ingest(dsd.Sample(name=f"tot.{i}", type=dsd.COUNTER,
+                              value=float(i + 1),
+                              scope=dsd.SCOPE_GLOBAL))
+        src.ingest(dsd.Sample(name=f"depth.{i}", type=dsd.GAUGE,
+                              value=2.5 * i, scope=dsd.SCOPE_GLOBAL))
+    res = Flusher(is_local=True).flush(src.swap())
+    body, headers = http_import.encode_rows_reference(res.forward)
+    items = http_import.decode_body(
+        body, headers.get("Content-Encoding", ""))
+    good = gob_codec.encode_digest([1.0, 2.0], [1.0, 1.0], 100.0,
+                                   1.0, 2.0, 1.5)
+    items += [
+        {"name": "bad.b64", "type": "counter", "tags": [],
+         "value": "!!!not-b64!!!"},
+        {"name": "bad.gob", "type": "histogram", "tags": [],
+         "value": base64.b64encode(good[:7]).decode()},
+        {"name": "bad.type", "type": "mystery", "tags": [],
+         "value": base64.b64encode(b"x").decode()},
+        {"name": "bad.nan", "type": "gauge", "tags": [],
+         "value": base64.b64encode(
+             gob_codec.encode_gauge(float("nan"))).decode()},
+        {"name": "bad.inf", "type": "histogram", "tags": [],
+         "value": base64.b64encode(gob_codec.encode_digest(
+             [1.0], [1.0], 100.0, float("inf"), 1.0, 0.0)).decode()},
+    ]
+    return items
+
+
+def test_reference_batch_apply_matches_per_item_oracle(monkeypatch):
+    """VENEUR_GOB_BATCH_DECODE=0's per-item loop is the oracle for
+    the native columnar batch apply: identical accept/drop accounting
+    (including all five malformed riders), bit-exact counter/gauge
+    planes, set registers and centroid planes; the histo stats matrix
+    agrees within accumulation tolerance (the per-item path sums
+    weight/mean-weight in f32, the batch path in f64 — msum near zero
+    cancels, so atol, not rtol alone)."""
+    if gob_codec.decode_batch([b"x"], [gob_codec.KIND_DIGEST]) is None:
+        pytest.skip("native library unavailable")
+    items = _mixed_reference_body()
+
+    def run(enabled):
+        monkeypatch.setenv("VENEUR_GOB_BATCH_DECODE",
+                           "1" if enabled else "0")
+        t = MetricTable(TableConfig())
+        acc, drop = http_import.apply_import(t, items)
+        # repeat wire: the second apply rides the cached row plan and
+        # must account identically
+        acc2, drop2 = http_import.apply_import(t, items)
+        assert (acc2, drop2) == (acc, drop)
+        t.device_step(final=True)
+        return acc, drop, t.swap()
+
+    acc_b, drop_b, snap_b = run(True)
+    acc_f, drop_f, snap_f = run(False)
+    assert (acc_b, drop_b) == (acc_f, drop_f)
+    assert drop_b == 5
+    for attr in ("counters", "gauges", "histo_means", "histo_weights",
+                 "hll_regs"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(snap_b, attr)),
+            np.asarray(getattr(snap_f, attr)), err_msg=attr)
+    sb = np.asarray(snap_b.histo_import_stats, np.float64)
+    sf = np.asarray(snap_f.histo_import_stats, np.float64)
+    np.testing.assert_array_equal(sb[:, 1], sf[:, 1])  # min exact
+    np.testing.assert_array_equal(sb[:, 2], sf[:, 2])  # max exact
+    np.testing.assert_allclose(sb, sf, rtol=1e-5, atol=1e-2)

@@ -423,3 +423,84 @@ def test_bytes_path_malformed_wire_falls_back():
         apply_metric_list_bytes(dst, b"\xff\xff\xff\x01garbage")
     # table still usable
     assert dst.import_counter("c", (), 1.0)
+
+
+def test_decode_scratch_cap_and_shrink(monkeypatch):
+    """The per-thread decode scratch must (a) surface in the
+    decode_scratch_bytes gauge, (b) refuse to retain buffers above
+    _SCRATCH_MAX_BYTES, and (c) release high-water buffers after
+    _SCRATCH_SHRINK_AFTER consecutive small decodes — one giant wire
+    must not pin its columns for the life of the handler thread."""
+    import threading
+
+    from veneur_tpu import native
+    from veneur_tpu.forward import grpc_forward as gf
+
+    lib = native.load()
+    if lib is None:
+        pytest.skip("native library unavailable")
+
+    def wire(n_rows):
+        rows = [ForwardRow(_meta(f"scratch.cnt.{i:07d}", dsd.COUNTER,
+                                 (), dsd.SCOPE_GLOBAL),
+                           "counter", value=float(i))
+                for i in range(n_rows)]
+        return rows_to_metric_list(rows).SerializeToString()
+
+    small, big = wire(2), wire(2600)
+    # big's buffer heuristic must exceed 4x small's, else the
+    # oversized-streak branch under test never arms
+    assert len(big) // 48 > 4 * max(256, len(small) // 48)
+
+    tid = threading.get_ident()
+
+    def mine():
+        with gf._scratch_lock:
+            return gf._scratch_bytes.get(tid, 0)
+
+    saved_cols = getattr(gf._decode_scratch, "cols", None)
+    saved_streak = getattr(gf._decode_scratch, "oversized_streak", 0)
+    with gf._scratch_lock:
+        saved_bytes = gf._scratch_bytes.pop(tid, None)
+    gf._decode_scratch.cols = None
+    gf._decode_scratch.oversized_streak = 0
+    try:
+        # (b) over-cap scratch is dropped, not retained
+        monkeypatch.setattr(gf, "_SCRATCH_MAX_BYTES", 1024)
+        assert gf._decode_native(lib, small)["n"] == 2
+        assert gf._decode_scratch.cols is None
+        assert mine() == 0
+
+        # (a) under the real cap the retained bytes hit the gauge
+        monkeypatch.setattr(gf, "_SCRATCH_MAX_BYTES", 32 << 20)
+        assert gf._decode_native(lib, small)["n"] == 2
+        small_bytes = mine()
+        assert small_bytes > 0
+        assert small_bytes == gf._cols_nbytes(gf._decode_scratch.cols)
+
+        assert gf._decode_native(lib, big)["n"] == 2600
+        big_bytes = mine()
+        assert big_bytes > small_bytes
+
+        # (c) high-water scratch survives SHRINK_AFTER-1 small
+        # decodes...
+        for _ in range(gf._SCRATCH_SHRINK_AFTER - 1):
+            assert gf._decode_native(lib, small)["n"] == 2
+        assert mine() == big_bytes
+        # ...and the next one releases it back to the small shape
+        assert gf._decode_native(lib, small)["n"] == 2
+        assert mine() == small_bytes
+
+        # /debug/vars reads this exact gauge
+        from veneur_tpu.core import server as server_mod
+        assert server_mod._decode_scratch_bytes() == \
+            gf.decode_scratch_bytes()
+        assert gf.decode_scratch_bytes() >= mine()
+    finally:
+        gf._decode_scratch.cols = saved_cols
+        gf._decode_scratch.oversized_streak = saved_streak
+        with gf._scratch_lock:
+            if saved_bytes is None:
+                gf._scratch_bytes.pop(tid, None)
+            else:
+                gf._scratch_bytes[tid] = saved_bytes

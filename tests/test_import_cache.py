@@ -168,6 +168,11 @@ def test_name_length_mismatch_reresolves():
     # poison: same hash, absurd name length — as a colliding series
     # would have left it
     t.import_row_cache[h] = (999 << 32) | row
+    # drop the wire-level plan so the per-item row cache is actually
+    # consulted again (an identical wire replays its cached row plan
+    # and never touches per-item entries; the guard matters when the
+    # identity arrives in a DIFFERENT wire)
+    t._wire_plan_cache.clear()
     acc, drop = apply_metric_list_bytes(t, wire)
     assert (acc, drop) == (1, 0)
     # the slow path repaired the entry and kept the same row

@@ -42,8 +42,12 @@ def test_stale_so_rebuilds_and_loads(tmp_path):
         native._tried = False
         lib = native.load()
         assert lib is not None
-        # the newest symbol must be bound (argtypes set by _bind)
+        # the newest symbols must be bound (argtypes set by _bind) —
+        # vtpu_gob_decode is the latest addition, so a stale image
+        # that predates it is exactly what this would catch
         assert lib.vtpu_hll_plane_stats.argtypes is not None
+        assert lib.vtpu_gob_decode.argtypes is not None
+        assert lib.vtpu_gob_decode.restype is not None
         # and the fresh image came in under a unique retry name
         retries = [f for f in os.listdir(build_dir)
                    if f.startswith("dsd_parse.so.r")]

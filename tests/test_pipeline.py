@@ -93,6 +93,10 @@ def _import_wires(table, mode, rng_seed=7, n_wires=6, n_series=5):
     """Stage n_wires forwarded digest lists onto ``table`` using the
     given fused-import mode, then run the final device step."""
     table.fused_import_mode = mode
+    # the collective fold is a separate gate with its own parity suite
+    # (test_collective_import.py); pin it off so this test isolates
+    # stack-vs-perwire fusion under the 8-device conftest platform
+    table.collective_import_mode = "off"
     rng = np.random.default_rng(rng_seed)
     for w in range(n_wires):
         rows, means, weights = [], [], []

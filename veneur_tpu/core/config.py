@@ -320,6 +320,15 @@ class Config:
     # flush merge rides ICI collectives (parallel/sharded.py).  0 =
     # single-chip table.
     tpu_mesh_shards: int = 0
+    # mesh-sharded collective import fold: partition each import
+    # cycle's wire stack over the device mesh's shard axis and union
+    # the per-device partials with one all_gather + k-scale
+    # re-cluster (parallel/sharded.py CollectiveWireFold).  "auto"
+    # (default) engages iff more than one device is visible; "on" /
+    # "off" force.  VENEUR_TPU_COLLECTIVE_IMPORT overrides; the
+    # serial per-wire scan stays available under "off" as the parity
+    # oracle.
+    tpu_collective_import: str = "auto"
     # columnar flush->emit: assemble the flush as a MetricFrame
     # (parallel NumPy columns over the row-metadata pool) instead of
     # one InterMetric object per aggregate, and let frame-aware sinks
@@ -417,6 +426,11 @@ class Config:
                   "reader_batch_packets", "tpu_stage_flush_samples"):
             if getattr(self, n) <= 0:
                 problems.append(f"{n} must be positive")
+        if str(self.tpu_collective_import).lower() not in (
+                "auto", "on", "off", "1", "0", "true", "false",
+                "yes", "no"):
+            problems.append(
+                "tpu_collective_import must be auto, on or off")
         if self.kafka_span_serialization_format not in ("protobuf",
                                                         "json"):
             problems.append(

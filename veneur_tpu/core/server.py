@@ -59,6 +59,17 @@ def _is_oom_error(e: BaseException) -> bool:
     return any(m in msg for m in _OOM_MARKERS)
 
 
+def _decode_scratch_bytes() -> int:
+    """Sum of native-decode scratch retained across gRPC import
+    reader threads (forward.grpc_forward registry); 0 when the
+    forward path never loaded."""
+    try:
+        from veneur_tpu.forward import grpc_forward
+        return grpc_forward.decode_scratch_bytes()
+    except Exception:
+        return 0
+
+
 def _is_inline_pem(value: str) -> bool:
     """TLS config values are either PEM material inline (the
     reference's example.yaml style) or file paths."""
@@ -115,7 +126,9 @@ class Server:
             histo_rows=config.tpu_histo_rows,
             set_rows=config.tpu_set_rows,
             compression=config.tpu_compression,
-            histo_slots=config.tpu_histo_slots)
+            histo_slots=config.tpu_histo_slots,
+            collective_import=str(getattr(
+                config, "tpu_collective_import", "auto")))
         if config.tpu_mesh_shards:
             # multi-chip global node: SPMD sharded planes over the
             # full device mesh; flush merge = ICI collectives
@@ -170,8 +183,19 @@ class Server:
         # kernels dispatch outside it, so ingest never stalls behind
         # XLA.  ShardedTable has its own step machinery, hence the
         # capability probe rather than a bare config check.
-        self.pipeline = (bool(getattr(config, "tpu_pipeline", True))
+        want_pipeline = bool(getattr(config, "tpu_pipeline", True))
+        self.pipeline = (want_pipeline
                          and hasattr(self.table, "take_staged"))
+        if want_pipeline and not self.pipeline:
+            # make the silent capability downgrade visible: operators
+            # tuning tpu_pipeline with tpu_mesh_shards set would
+            # otherwise chase a knob that does nothing
+            # (docs/performance.md "pipelined flush")
+            log.warning(
+                "tpu_pipeline is ignored with the mesh-sharded table "
+                "(tpu_mesh_shards=%s): ShardedTable runs its own SPMD "
+                "step machinery and flushes synchronously",
+                getattr(config, "tpu_mesh_shards", 0))
         self.sentry = None  # set by _build_sinks when sentry_dsn is
         self.flusher = Flusher(
             is_local=self.is_local,
@@ -1205,6 +1229,13 @@ class Server:
                                   else {}),
                         "last_flush_age_s": round(
                             time.monotonic() - server.last_flush, 3),
+                        # retained native-decode scratch across the
+                        # gRPC import readers (forward.grpc_forward;
+                        # bounded by the oversized-streak release)
+                        "forward": {
+                            "decode_scratch_bytes":
+                                _decode_scratch_bytes(),
+                        },
                     })
                 elif (self.path == "/quitquitquit" and
                       server.config.http_quit):

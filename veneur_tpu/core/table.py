@@ -150,6 +150,25 @@ def _fused_import_mode() -> str:
     return "stack"
 
 
+def _collective_import_mode(cfg_default: str = "auto") -> str:
+    """VENEUR_TPU_COLLECTIVE_IMPORT: gate for the mesh-sharded
+    collective import fold (parallel.sharded.CollectiveWireFold).
+    Unset defers to TableConfig.collective_import.  "auto" (default)
+    resolves at first apply to ON iff more than one device is visible
+    — on a single device the all-gather is a copy and the serial scan
+    is strictly cheaper; "on"/"off" force.  The serial per-wire scan
+    stays available under "off" as the bit-parity oracle
+    (tests/test_collective_import.py)."""
+    raw = os.environ.get("VENEUR_TPU_COLLECTIVE_IMPORT", "").lower()
+    if raw == "":
+        raw = str(cfg_default).lower()
+    if raw in ("0", "false", "off", "no"):
+        return "off"
+    if raw in ("1", "true", "on", "yes"):
+        return "on"
+    return "auto"
+
+
 def _state_property(name: str) -> property:
     def _get(self):
         return getattr(self._state, name)
@@ -220,6 +239,10 @@ class TableConfig:
     # same digests, and whole-interval set batches dedup into a
     # register plane (one h2d plane beats 8 bytes/member)
     histo_merge_samples: int = 4 << 20
+    # mesh-sharded collective import fold ("auto" = on iff >1 device
+    # at first apply; "on"/"off" force; VENEUR_TPU_COLLECTIVE_IMPORT
+    # overrides — see _collective_import_mode)
+    collective_import: str = "auto"
     # raw set samples fold into a HOST register plane (16 KiB/row)
     # when the plane fits this bound; past it (very high set-row
     # configs) they scatter to the device as before.  The host plane
@@ -520,6 +543,13 @@ class MetricTable:
         # compaction (rows renumber) and cleared when it reaches
         # import_row_cache_limit (churning identities rebuild it).
         self.import_row_cache: dict[int, int] = {}
+        # wire-level row-plan cache: a whole MetricList's khash vector
+        # (as bytes) -> (epoch, row vector, per-class overflow counts).
+        # A steady-state peer re-forwarding the same series set every
+        # interval resolves ALL rows in one dict get
+        # (grpc_forward._resolve_rows); epoch-stamped entries
+        # self-invalidate on compaction.
+        self._wire_plan_cache: dict[bytes, tuple] = {}
         # Effective digest chunk width: on TPU backends, cap merge
         # chunks so state capacity + chunk stays inside the fused
         # Pallas kernel's bound — a wider chunk silently drops to the
@@ -554,6 +584,13 @@ class MetricTable:
         # widest ladder bucket the stacked merge may use per wire;
         # rows deeper than this in one wire spill to the ranked path
         self._wire_stack_kmax = _ladder_floor(self._eff_histo_slots)
+        self.collective_import_mode = _collective_import_mode(
+            c.collective_import)
+        # lazily resolved parallel.sharded.CollectiveWireFold:
+        # "unset" until the gate first resolves at apply time (device
+        # topology is only trustworthy then), None when it resolves
+        # off, else the fold object (holds the jitted collective)
+        self._collective_fold: object = "unset"
 
         # pipelined apply machinery: device dispatch serializes on
         # _device_lock so staged work applies outside the ingest lock;
@@ -1907,6 +1944,25 @@ class MetricTable:
                     slots=eff, n_chunks=nc,
                     compression=c.compression)
 
+    def _collective_wire_fold(self):
+        """Resolve the collective-import gate once and cache the
+        result: a parallel.sharded.CollectiveWireFold when the fold
+        should run collectively (mode "on", or "auto" with more than
+        one visible device), else None — the serial scan path.  The
+        import stays self-contained so single-device deployments never
+        touch the mesh machinery."""
+        if self._collective_fold == "unset":
+            fold = None
+            mode = self.collective_import_mode
+            if mode != "off" and (
+                    mode == "on" or len(jax.devices()) > 1):
+                from veneur_tpu.parallel import sharded
+                fold = sharded.CollectiveWireFold(
+                    sharded.make_import_mesh(),
+                    compression=self.config.compression)
+            self._collective_fold = fold
+        return self._collective_fold
+
     def _wire_digest_step(self, st: _IntervalState,
                           parts: list[tuple]) -> None:
         """Fused global merge: a cycle's decoded wire digests — one
@@ -1978,7 +2034,13 @@ class MetricTable:
             uniq.astype(np.int32), mb, c.histo_rows))
         self._ensure_fresh(st, "histo")
         if mode == "stack":
+            fold = self._collective_wire_fold()
             wb = _bucket_len(len(built), wide=True)
+            if fold is not None:
+                # the mesh fold scans equal per-device wire slices:
+                # pad the wire axis to a multiple of the shard count
+                # (padding wires stay live=False -> identity steps)
+                wb = fold.pad_wires(wb)
             stack_m = np.zeros((wb, mb, K), np.float32)
             stack_w = np.zeros((wb, mb, K), np.float32)
             live = np.zeros(wb, bool)
@@ -1986,11 +2048,16 @@ class MetricTable:
                 stack_m[i, local, rank] = means
                 stack_w[i, local, rank] = wts
                 live[i] = True
-            st.histo_means, st.histo_weights = \
-                tdigest.merge_wire_stack_rows(
+            if fold is not None:
+                st.histo_means, st.histo_weights = fold(
                     st.histo_means, st.histo_weights, idx_dev,
-                    jnp.asarray(stack_m), jnp.asarray(stack_w),
-                    jnp.asarray(live), compression=c.compression)
+                    stack_m, stack_w, live)
+            else:
+                st.histo_means, st.histo_weights = \
+                    tdigest.merge_wire_stack_rows(
+                        st.histo_means, st.histo_weights, idx_dev,
+                        jnp.asarray(stack_m), jnp.asarray(stack_w),
+                        jnp.asarray(live), compression=c.compression)
         else:
             # per-wire reference mode (VENEUR_TPU_FUSED_IMPORT=0):
             # same kernel, same union rows and width, one wire per
@@ -2113,6 +2180,10 @@ class MetricTable:
             # the same renumbered rows — drop it; the next wire list
             # re-resolves through the slow path
             self.import_row_cache.clear()
+            # wire-level plans are epoch-stamped (self-invalidating),
+            # but dropping them now frees the stale row vectors
+            self._wire_plan_cache.clear()
+            getattr(self, "_http_plan_cache", {}).clear()
             # invalidate reader shards' lock-free probes: any fused
             # pass that began against pre-compaction row numbering
             # must discard and re-ingest (ReaderShard.commit)

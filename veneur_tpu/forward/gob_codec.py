@@ -31,6 +31,7 @@ the reference's checked-in ``testdata/import.uncompressed``).
 
 from __future__ import annotations
 
+import ctypes
 import struct
 
 import numpy as np
@@ -194,6 +195,73 @@ def encode_digest(means, weights, compression: float, vmin: float,
         _write_uint(out, len(fb))
         out += fb
     return bytes(out)
+
+
+KIND_COUNTER, KIND_GAUGE, KIND_DIGEST = 1, 2, 3
+
+
+def decode_batch(payloads, kinds, lib=None):
+    """Batch-decode a whole import cycle's opaque wire values into
+    flat columns with one ``vtpu_gob_decode`` call.
+
+    ``payloads`` is a list of bytes, ``kinds`` a parallel sequence of
+    KIND_* codes.  Returns None when the native library is
+    unavailable (callers fall back to the per-item codec), else a
+    dict of columns:
+
+    - ``scalar``      float64[n]  counter/gauge value
+    - ``dstats``      float64[n,4]  digest min, max, rsum, compression
+    - ``cent_start``  int64[n], ``cent_cnt`` int32[n]  slices into
+    - ``means``/``weights``  float32[total_centroids]
+    - ``err``         uint8[n]  1 where the item was malformed (the
+      caller drops-and-counts it, like the per-item codec's exception
+      path; well-formed siblings in the same batch still decode)
+    """
+    if lib is None:
+        from veneur_tpu import native
+        lib = native.load()
+    if lib is None:
+        return None
+    n = len(payloads)
+    lens = np.fromiter((len(p) for p in payloads), np.int64, n)
+    off = np.zeros(n, np.int64)
+    np.cumsum(lens[:-1], out=off[1:])
+    buf = np.frombuffer(b"".join(payloads), np.uint8)
+    if buf.size == 0:
+        buf = np.zeros(1, np.uint8)
+    kind = np.ascontiguousarray(kinds, np.uint8)
+    scalar = np.zeros(n, np.float64)
+    dstats = np.zeros((n, 4), np.float64)
+    cent_start = np.zeros(n, np.int64)
+    cent_cnt = np.zeros(n, np.int32)
+    err = np.zeros(n, np.uint8)
+    needed = np.zeros(1, np.int64)
+    cap = max(1024, 4 * n)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    for _ in range(2):  # -2 reports the exact need: one retry fits
+        means = np.empty(cap, np.float32)
+        weights = np.empty(cap, np.float32)
+        rc = lib.vtpu_gob_decode(
+            buf.ctypes.data_as(u8p), buf.size, n,
+            off.ctypes.data_as(i64p), lens.ctypes.data_as(i64p),
+            kind.ctypes.data_as(u8p), cap,
+            scalar.ctypes.data_as(f64p), dstats.ctypes.data_as(f64p),
+            cent_start.ctypes.data_as(i64p),
+            cent_cnt.ctypes.data_as(i32p),
+            means.ctypes.data_as(f32p), weights.ctypes.data_as(f32p),
+            err.ctypes.data_as(u8p), needed.ctypes.data_as(i64p))
+        if rc != -2:
+            break
+        cap = int(needed[0])
+    total = int(rc) if rc >= 0 else 0
+    return {"scalar": scalar, "dstats": dstats,
+            "cent_start": cent_start, "cent_cnt": cent_cnt,
+            "means": means[:total], "weights": weights[:total],
+            "err": err}
 
 
 def decode_counter(data: bytes) -> float:

@@ -207,6 +207,20 @@ def _decode_call(lib, buf, n, cap_m, cap_c, cap_t, cols,
 
 
 _SCRATCH_MAX_BYTES = 32 << 20
+# consecutive decodes needing <1/4 of the retained scratch before the
+# high-water buffers are released (one giant wire must not pin its
+# scratch for the life of the thread once traffic shrinks back)
+_SCRATCH_SHRINK_AFTER = 8
+
+_scratch_lock = _threading.Lock()
+_scratch_bytes: dict[int, int] = {}  # thread ident -> retained bytes
+
+
+def decode_scratch_bytes() -> int:
+    """Total decode scratch retained across handler threads — the
+    ``forward.decode_scratch_bytes`` gauge in /debug/vars."""
+    with _scratch_lock:
+        return sum(_scratch_bytes.values())
 
 
 def _cols_nbytes(cols: dict) -> int:
@@ -215,10 +229,23 @@ def _cols_nbytes(cols: dict) -> int:
 
 
 def _keep_scratch(cols: dict) -> None:
-    if _cols_nbytes(cols) <= _SCRATCH_MAX_BYTES:
+    nb = _cols_nbytes(cols)
+    if nb <= _SCRATCH_MAX_BYTES:
         _decode_scratch.cols = cols
     else:
         _decode_scratch.cols = None
+        nb = 0
+    tid = _threading.get_ident()
+    with _scratch_lock:
+        if nb:
+            _scratch_bytes[tid] = nb
+        else:
+            _scratch_bytes.pop(tid, None)
+        if len(_scratch_bytes) > 32:
+            # registry entries outlive their (dead) handler threads
+            live = {t.ident for t in _threading.enumerate()}
+            for t in [t for t in _scratch_bytes if t not in live]:
+                del _scratch_bytes[t]
 
 
 def _alloc_cols(cap_m: int, cap_c: int, cap_t: int) -> dict:
@@ -264,6 +291,18 @@ def _decode_native(lib, data: bytes):
     cap_t = cap_m * 4
     needed = np.zeros(3, np.int64)
     cols = getattr(_decode_scratch, "cols", None)
+    if cols is not None:
+        oversized = (len(cols["name_off"]) > 4 * cap_m or
+                     len(cols["means"]) > 4 * cap_c or
+                     len(cols["tag_off"]) > 4 * cap_t)
+        if oversized:
+            streak = getattr(_decode_scratch, "oversized_streak", 0) + 1
+            _decode_scratch.oversized_streak = streak
+            if streak >= _SCRATCH_SHRINK_AFTER:
+                cols = None  # release high-water scratch on shrink
+                _decode_scratch.oversized_streak = 0
+        else:
+            _decode_scratch.oversized_streak = 0
     if (cols is None or len(cols["name_off"]) < cap_m or
             len(cols["means"]) < cap_c or
             len(cols["tag_off"]) < cap_t):
@@ -290,65 +329,76 @@ def _decode_native(lib, data: bytes):
     return None  # still over after the exact-size retry: give up
 
 
-def apply_metric_list_bytes(table: MetricTable,
-                            data: bytes) -> tuple[int, int]:
-    """apply_metric_list from the RAW wire: columnar native decode +
-    hash-cached row resolution + batched staging.
-
-    One upb Metric object per item with per-centroid Python traversal
-    was ~60% of the global tier's import cost; the first columnar
-    rewrite left a per-item Python loop (name/tag decode, tuple key,
-    dict lookup) that profiled at ~700ms of the c4 interval.  Now the
-    native decoder also emits an import-identity hash per item
-    (vtpu_metriclist_keyhash) and ``table.import_row_cache`` maps it
-    straight to a row: steady-state imports (a fleet forwards the
-    same series every interval) never decode a single string — Python
-    touches one dict get per item and a handful of vectorized passes
-    per wire list.  Novel series resolve through the same per-item
-    slow path as before and populate the cache; the cache is
-    invalidated on compaction (rows renumber).  Value-level validity
-    (finiteness, HLL codec) is re-checked per wire — only series
-    IDENTITY is cached, so a gauge that is NaN this interval and
-    finite the next is not penalized.
-
-    Falls back to the protobuf path when the native library is
-    unavailable or the wire is malformed (per-item isolation matters
-    more than speed there)."""
+def decode_metric_list(data: bytes):
+    """The LOCK-FREE half of apply_metric_list_bytes: native columnar
+    wire decode + per-item identity keyhash, touching no table state.
+    Handler threads run this OUTSIDE the server ingest lock, so the
+    decode of cycle N+1's wires overlaps the device fold of cycle N
+    (import pipelining — the _IntervalState double-buffer's host-side
+    counterpart).  Returns the column dict or None (native library
+    unavailable or malformed wire: caller takes the per-item protobuf
+    fallback under the lock)."""
     from veneur_tpu import native
     lib = native.load()
     cols = _decode_native(lib, data) if lib is not None else None
     if cols is None:
-        return apply_metric_list(table,
-                                 forward_pb2.MetricList.FromString(data))
+        return None
     nm = cols["n"]
-    if nm == 0:
-        return 0, 0
-    import ctypes
+    if nm:
+        import ctypes
 
-    def p(a, ct):
-        return a.ctypes.data_as(ctypes.POINTER(ct))
+        def p(a, ct):
+            return a.ctypes.data_as(ctypes.POINTER(ct))
 
-    buf = np.frombuffer(data, np.uint8)
-    khash = np.empty(nm, np.uint64)
-    lib.vtpu_metriclist_keyhash(
-        p(buf, ctypes.c_uint8), nm,
-        p(cols["name_off"], ctypes.c_int64),
-        p(cols["name_len"], ctypes.c_int32),
-        p(cols["kind"], ctypes.c_uint8),
-        p(cols["mtype"], ctypes.c_int32),
-        p(cols["scope"], ctypes.c_int32),
-        p(cols["tag_start"], ctypes.c_int64),
-        p(cols["tag_cnt"], ctypes.c_int32),
-        p(cols["tag_off"], ctypes.c_int64),
-        p(cols["tag_len"], ctypes.c_int32),
-        p(khash, ctypes.c_uint64))
+        buf = np.frombuffer(data, np.uint8)
+        khash = np.empty(nm, np.uint64)
+        lib.vtpu_metriclist_keyhash(
+            p(buf, ctypes.c_uint8), nm,
+            p(cols["name_off"], ctypes.c_int64),
+            p(cols["name_len"], ctypes.c_int32),
+            p(cols["kind"], ctypes.c_uint8),
+            p(cols["mtype"], ctypes.c_int32),
+            p(cols["scope"], ctypes.c_int32),
+            p(cols["tag_start"], ctypes.c_int64),
+            p(cols["tag_cnt"], ctypes.c_int32),
+            p(cols["tag_off"], ctypes.c_int64),
+            p(cols["tag_len"], ctypes.c_int32),
+            p(khash, ctypes.c_uint64))
+        cols["khash"] = khash
+    return cols
 
+
+_WIRE_PLAN_CACHE_MAX = 256
+
+
+def _resolve_rows(table: MetricTable, data: bytes, cols: dict,
+                  khash: np.ndarray) -> np.ndarray:
+    """Map every item to its table row (or -1 overflow / -2 malformed).
+
+    Steady-state fast path: a whole wire's khash vector keys a
+    (wire-schema)->rows plan on the table, so a peer re-forwarding the
+    same series set every interval resolves all rows with ONE dict get
+    — no per-item Python at all.  Plans invalidate on compaction
+    (``_reindex_epoch``); overflow drops recorded in a plan keep
+    counting per sample on every replay, matching the uncached path."""
+    nm = cols["n"]
     kind = cols["kind"][:nm]
+    class_idx = {1: table.counter_idx, 2: table.gauge_idx,
+                 3: table.histo_idx, 4: table.set_idx}
+    epoch = getattr(table, "_reindex_epoch", 0)
+    plan_cache = getattr(table, "_wire_plan_cache", None)
+    pkey = khash.tobytes()
+    if plan_cache is not None:
+        hit = plan_cache.get(pkey)
+        if hit is not None and hit[0] == epoch:
+            rows, over_counts = hit[1], hit[2]
+            for k, c in over_counts.items():
+                class_idx[k].overflow += c
+            return rows
     cache = table.import_row_cache
     khl = khash.tolist()
     rows = np.full(nm, -1, np.int64)
-    dropped = 0
-    accepted = 0
+    over_counts: dict[int, int] = {}
 
     def _ident(i: int) -> tuple[str, tuple[str, ...]]:
         no, nl = int(cols["name_off"][i]), int(cols["name_len"][i])
@@ -364,8 +414,6 @@ def apply_metric_list_bytes(table: MetricTable,
     if len(cache) >= getattr(table, "import_row_cache_limit",
                              1 << 20):
         cache.clear()  # churning identities: rebound, self-rebuilds
-    class_idx = {1: table.counter_idx, 2: table.gauge_idx,
-                 3: table.histo_idx, 4: table.set_idx}
     name_len = cols["name_len"]
     for i, h in enumerate(khl):
         ent = cache.get(h)
@@ -437,6 +485,35 @@ def apply_metric_list_bytes(table: MetricTable,
         else:
             cache[h] = (int(name_len[i]) << 32) | int(row)
             rows[i] = int(row)
+
+    if plan_cache is not None:
+        # overflow (-1) rows were counted during this build (by
+        # lookup or the ent==-1 branch above); plan replays repeat
+        # those per-class counts so the operator counter keeps pace
+        for k in (1, 2, 3, 4):
+            c = int(((rows == -1) & (kind == k)).sum())
+            if c:
+                over_counts[k] = c
+        if len(plan_cache) >= _WIRE_PLAN_CACHE_MAX:
+            plan_cache.clear()
+        plan_cache[pkey] = (epoch, rows, over_counts)
+    return rows
+
+
+def apply_decoded(table: MetricTable, data: bytes,
+                  cols: dict) -> tuple[int, int]:
+    """The LOCKED half: resolve rows through the plan/row caches and
+    stage every value with vectorized batch appliers.  Value-level
+    validity (finiteness, HLL codec) is re-checked per wire — only
+    series IDENTITY is cached, so a gauge that is NaN this interval
+    and finite the next is not penalized."""
+    nm = cols["n"]
+    if nm == 0:
+        return 0, 0
+    kind = cols["kind"][:nm]
+    rows = _resolve_rows(table, data, cols, cols["khash"])
+    dropped = 0
+    accepted = 0
 
     valid = rows >= 0
     dropped += int((~valid).sum())
@@ -552,6 +629,33 @@ def apply_metric_list_bytes(table: MetricTable,
     return accepted, dropped
 
 
+def apply_metric_list_bytes(table: MetricTable,
+                            data: bytes) -> tuple[int, int]:
+    """apply_metric_list from the RAW wire: columnar native decode +
+    hash-cached row resolution + batched staging.
+
+    One upb Metric object per item with per-centroid Python traversal
+    was ~60% of the global tier's import cost; the first columnar
+    rewrite left a per-item Python loop (name/tag decode, tuple key,
+    dict lookup) that profiled at ~700ms of the c4 interval.  The
+    native decoder emits an import-identity hash per item
+    (vtpu_metriclist_keyhash); ``table.import_row_cache`` maps one
+    hash to a row and the wire-level plan cache (_resolve_rows) maps
+    a whole repeated wire to its row vector in one dict get.
+
+    This serial form runs decode and apply back to back; the
+    ImportServer splits them (decode_metric_list outside the ingest
+    lock, apply_decoded inside) so wire decode pipelines against the
+    device fold.  Falls back to the protobuf path when the native
+    library is unavailable or the wire is malformed (per-item
+    isolation matters more than speed there)."""
+    cols = decode_metric_list(data)
+    if cols is None:
+        return apply_metric_list(table,
+                                 forward_pb2.MetricList.FromString(data))
+    return apply_decoded(table, data, cols)
+
+
 # ----------------------------------------------------------------------
 # server (importsrv equivalent)
 
@@ -624,8 +728,18 @@ class ImportServer:
 
     def _send_metrics(self, request, context):
         core = self._core
+        # decode outside the ingest lock: while another handler's
+        # interval fold holds it (or _apply_staged runs the device
+        # merge), this thread's wire decode proceeds in parallel —
+        # cycle N+1 decode overlaps cycle N fold
+        cols = decode_metric_list(request)
         with core.lock:
-            acc, dropped = apply_metric_list_bytes(core.table, request)
+            if cols is None:
+                acc, dropped = apply_metric_list(
+                    core.table,
+                    forward_pb2.MetricList.FromString(request))
+            else:
+                acc, dropped = apply_decoded(core.table, request, cols)
             work = core._maybe_device_step_locked()
         core._apply_staged(work)
         core.bump("imports_received", acc)

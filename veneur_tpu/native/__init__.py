@@ -191,6 +191,16 @@ def _bind(lib: ctypes.CDLL) -> None:
         i64p, i32p,
         i64p, i32p,
         i64p]
+    lib.vtpu_gob_decode.restype = i64
+    lib.vtpu_gob_decode.argtypes = [
+        u8p, i64, i64,
+        i64p, i64p, u8p,
+        i64,
+        f64p, f64p,
+        i64p, i32p,
+        f32p, f32p,
+        u8p,
+        i64p]
     lib.vtpu_metriclist_keyhash.restype = None
     lib.vtpu_metriclist_keyhash.argtypes = [
         u8p, i64,

@@ -330,6 +330,97 @@ def readout(merged: dict, qs: np.ndarray) -> dict:
     return {"quantiles": quant, "hll_estimate": est}
 
 
+def make_import_mesh(devices=None) -> Mesh:
+    """1D all-``shard`` mesh for the collective import fold: every
+    device folds wires, the series axis stays size 1 because the
+    import table's planes live replicated (one host-side table)."""
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    return Mesh(devs.reshape(devs.size, 1), (SHARD, SERIES))
+
+
+class CollectiveWireFold:
+    """Mesh-sharded fold of one import cycle's wire stack.
+
+    The serial fused path (table._wire_digest_step ->
+    tdigest.merge_wire_stack_rows) scans a cycle's W wire planes one
+    after another on a single device.  Here the wire axis is
+    partitioned over the ``shard`` axis: each device folds its W/S
+    slice with the same lax.scan/lax.cond body into ZERO-initialized
+    partial planes, then the partials are unioned with one all_gather
+    along the centroid-slot axis and a single k-scale re-cluster into
+    the gathered table rows — the make_merge_step digest-union idiom
+    applied to import folding, so fold wall-time scales with W/S
+    instead of W.
+
+    Within a shard the merge order is wire arrival order, and the
+    final union is one re-cluster over (table content ++ all shards'
+    partials).  When centroid spacing keeps the k-scale cluster pass
+    from combining anything — under-capacity digests with >1 k-width
+    between centroids — the result is bit-identical to the serial
+    scan (tests pin this); in general it is an equally valid t-digest
+    union of the same mass, which is why the serial path stays
+    available as the oracle (VENEUR_TPU_COLLECTIVE_IMPORT=off).
+    """
+
+    def __init__(self, mesh: Mesh,
+                 compression: float = tdigest.DEFAULT_COMPRESSION):
+        self.mesh = mesh
+        self.n_shard = int(mesh.shape[SHARD])
+        self.compression = comp = compression
+
+        def fold(sub_m, sub_w, stack_m, stack_w, live):
+            def step(carry, wire):
+                m, w = carry
+                wm, ww, alive = wire
+
+                def do_merge(ops):
+                    m, w, wm, ww = ops
+                    return tdigest._merge_impl(m, w, wm, ww,
+                                               compression=comp)
+
+                def skip(ops):
+                    m, w, _, _ = ops
+                    return m, w
+
+                return jax.lax.cond(alive, do_merge, skip,
+                                    (m, w, wm, ww)), None
+
+            part = (jnp.zeros_like(sub_m), jnp.zeros_like(sub_w))
+            (pm, pw), _ = jax.lax.scan(step, part,
+                                       (stack_m, stack_w, live))
+            gm = jax.lax.all_gather(pm, SHARD, axis=1, tiled=True)
+            gw = jax.lax.all_gather(pw, SHARD, axis=1, tiled=True)
+            return tdigest._merge_impl(sub_m, sub_w, gm, gw,
+                                       compression=comp)
+
+        mapped = shard_map(
+            fold, mesh=mesh,
+            in_specs=(P(), P(), P(SHARD), P(SHARD), P(SHARD)),
+            out_specs=(P(), P()), check_rep=False)
+
+        @partial(jax.jit, donate_argnums=jitopts.donate(0, 1))
+        def run(means, weights, row_idx, stack_m, stack_w, live):
+            sub_m = tdigest._take_rows(means, row_idx)
+            sub_w = tdigest._take_rows(weights, row_idx)
+            sub_m, sub_w = mapped(sub_m, sub_w, stack_m, stack_w, live)
+            return (means.at[row_idx].set(sub_m, mode="drop"),
+                    weights.at[row_idx].set(sub_w, mode="drop"))
+
+        self._run = run
+
+    def pad_wires(self, n: int) -> int:
+        """Wire-axis length the stack must pad to: a multiple of the
+        shard count, so every device scans an equal slice."""
+        s = self.n_shard
+        return ((max(n, 1) + s - 1) // s) * s
+
+    def __call__(self, means, weights, row_idx, stack_m, stack_w,
+                 live):
+        return self._run(means, weights, row_idx,
+                         jnp.asarray(stack_m), jnp.asarray(stack_w),
+                         jnp.asarray(live))
+
+
 class ShardedAggregator:
     """Host-side wrapper: per-shard columnar staging + one SPMD step.
 
