@@ -1638,7 +1638,12 @@ def soak_bench() -> dict:
                  # cycles (observe ring): attributes an interval-time
                  # regression to a STAGE, plus steady-state compile
                  # count (nonzero after warmup = shape drift)
-                 "flush_stages": srv.flush_ring.stage_summary()}
+                 "flush_stages": srv.flush_ring.stage_summary(),
+                 # conservation ledger over the whole run: every
+                 # ingested sample must be accounted staged/dropped
+                 # and every staged row emitted/forwarded/retained
+                 # (tests/test_bench_gates.py asserts balance)
+                 "ledger": srv.ledger.summary()}
     if len(samples) >= 4:
         half = samples[len(samples) // 2:]
         ts = np.asarray([s["t"] for s in half])
@@ -1934,6 +1939,11 @@ def chain_bench() -> dict:
     # per-stage timings from the local's flush ring — the traced half
     # of the chain; readback + forward dominate here by design
     out["flush_stages"] = local.flush_ring.stage_summary()
+    # both ends of the chain must conserve samples independently —
+    # the local's forwarded rows and the global's imported items are
+    # each balanced against their own tables
+    out["ledger"] = {"local": local.ledger.summary(),
+                     "global": g.ledger.summary()}
     out.update(_backend_info())
     out["captured_unix"] = round(time.time(), 1)
     _save_artifact("chain_bench", out)

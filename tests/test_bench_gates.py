@@ -356,6 +356,43 @@ def test_median_pass_result_headline_is_median():
     assert one["samples_per_sec"] == 50.0 and one["pass_rates"] == [50.0]
 
 
+def _ledger_summaries(block: dict) -> list[dict]:
+    """A soak artifact stamps one Ledger.summary(); chain stamps one
+    per tier ({"local": ..., "global": ...})."""
+    if "intervals" in block:
+        return [block]
+    return list(block.values())
+
+
+def test_soak_chain_artifacts_ledger_balanced():
+    """Soak/chain artifacts must carry a balanced conservation-ledger
+    block: a perf capture that lost samples is not a valid capture.
+    Pre-ledger captures (no block yet) pass until re-captured — the
+    stamping itself is pinned by test_bench_source_stamps_ledger."""
+    import pathlib
+    results = pathlib.Path(__file__).parent.parent / "bench_results"
+    for stem in ("soak_bench", "chain_bench"):
+        d = json.loads((results / f"{stem}.json").read_text())
+        block = d.get("ledger")
+        if block is None:
+            continue
+        for s in _ledger_summaries(block):
+            assert s["imbalanced"] == 0, (stem, s)
+            assert s["owed_total"] == 0, (stem, s)
+            assert s["balanced"] == s["intervals"], (stem, s)
+
+
+def test_bench_source_stamps_ledger():
+    """bench.py must keep stamping ledger summaries into BOTH
+    artifacts (the conditional gate above can't notice the block
+    silently disappearing from future captures)."""
+    import pathlib
+    src = (pathlib.Path(__file__).parent.parent / "bench.py").read_text()
+    assert '"ledger": srv.ledger.summary()' in src
+    assert '"local": local.ledger.summary()' in src
+    assert '"global": g.ledger.summary()' in src
+
+
 def test_soak_artifact_committed_and_stable():
     """The committed 20-minute soak artifact must carry passing
     stability verdicts (RSS slope, thread flatness, flush cadence) —

@@ -1,0 +1,77 @@
+"""Docs drift gate: every self-telemetry series name the code can
+emit must appear in docs/observability.md.
+
+An operator alarms on names; a counter that ships without docs is a
+dashboard nobody builds.  The scan is source-literal based (regex
+over the emitting modules), so adding a metric without documenting
+it fails here with the missing name in the assertion message.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+
+ROOT = pathlib.Path(__file__).parent.parent
+DOCS = (ROOT / "docs" / "observability.md").read_text()
+
+# modules whose veneur.* literals are operator-facing series names
+SCANNED = (
+    "veneur_tpu/core/telemetry.py",
+    "veneur_tpu/observe/ledger.py",
+    "veneur_tpu/core/proxy.py",
+)
+
+_NAME = re.compile(r"veneur(?:\.[a-z0-9_]+)+")
+
+
+def _names(path: str) -> set[str]:
+    return set(_NAME.findall((ROOT / path).read_text()))
+
+
+def test_every_emitted_metric_name_is_documented():
+    missing = {}
+    for mod in SCANNED:
+        for name in sorted(_names(mod)):
+            if name not in DOCS:
+                missing.setdefault(mod, []).append(name)
+    assert not missing, (
+        f"metric names missing from docs/observability.md: {missing}")
+
+
+def test_ledger_and_sink_counters_present():
+    """The names this PR introduced, pinned explicitly (the scan
+    above would pass vacuously if the emitting code were deleted)."""
+    for name in (
+            "veneur.ledger.received_total",
+            "veneur.ledger.staged_total",
+            "veneur.ledger.dropped_total",
+            "veneur.ledger.parse_errors_total",
+            "veneur.ledger.emitted_rows_total",
+            "veneur.ledger.forwarded_rows_total",
+            "veneur.ledger.owed_total",
+            "veneur.ledger.imbalance_total",
+            "veneur.sink.flush_busy_drops_total",
+            "veneur.sink.flush_retries_total",
+            "veneur.sink.flush_timeouts_total",
+            "veneur.sink.flush_errors_total",
+            "veneur.proxy.untraced_spans_total",
+    ):
+        assert name in DOCS, name
+        # and the emitting source actually still carries it
+        assert any(name in (ROOT / m).read_text() for m in SCANNED), \
+            name
+
+
+def test_debug_endpoints_documented():
+    for route in ("/debug/ledger", "/debug/trace/<trace_id>",
+                  "/debug/flushes", "/debug/vars"):
+        assert route in DOCS, route
+
+
+def test_env_vars_documented_in_readme():
+    readme = (ROOT / "README.md").read_text()
+    for var in ("VENEUR_TPU_LEDGER_STRICT",
+                "VENEUR_TPU_TRACE_PROPAGATION"):
+        assert var in readme, var
+        assert var in DOCS, var

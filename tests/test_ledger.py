@@ -1,0 +1,349 @@
+"""End-to-end sample-conservation ledger.
+
+The PR's acceptance contract: every interval balances exactly —
+``received == staged + status + overflow + invalid`` on the ingest
+side, ``staged_rows == emitted + forwarded - overlap + retained`` on
+the flush side — under every ingest path including concurrent
+multi-reader fused shards; strict mode turns an injected loss into a
+reported imbalance with the owed count; and reader-shard ``parse``
+stays ledger-free (credits land at commit, under the ingest lock).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from veneur_tpu import native
+from veneur_tpu.core.config import read_config
+from veneur_tpu.core.server import Server
+from veneur_tpu.observe.ledger import ClassDropTally, Ledger
+from veneur_tpu.sinks.simple import CaptureSink
+
+
+# ----------------------------------------------------------------------
+# unit: the ledger's own math
+
+
+def test_balanced_interval_unit():
+    led = Ledger(node="test")
+    led.ingest("dogstatsd", processed=100, staged=90, overflow=6,
+               invalid=0, status=4)
+    led.ingest("http-import", processed=10, staged=9, invalid=1)
+    rec = led.close_interval(seq=1, trace_id=7, table_staged=99,
+                             table_overflow={"counter": 6})
+    led.credit_rows(rec, {"staged_rows": 40, "emitted_rows": 25,
+                          "forwarded_rows": 20, "overlap_rows": 10,
+                          "retained_rows": 5})
+    led.seal(rec)
+    assert rec.sealed and rec.balanced
+    assert rec.owed == 0 and rec.rows_owed == 0
+    assert rec.staged_drift == 0 and rec.overflow_drift == 0
+    assert rec.received_total() == 110
+    assert rec.received == {"dogstatsd": 100, "http-import": 10}
+    assert rec.dropped_total() == 7
+    s = led.summary()
+    assert s["intervals"] == 1 and s["balanced"] == 1
+    assert s["imbalanced"] == 0 and s["owed_total"] == 0
+    assert s["received_total"] == 110 and s["staged_total"] == 99
+
+
+def test_injected_loss_reports_owed_count():
+    """Samples received but never accounted anywhere = the owed
+    count, and strict mode escalates through on_imbalance."""
+    hits = []
+    led = Ledger(strict=True, node="test", on_imbalance=hits.append)
+    led.ingest("dogstatsd", processed=50, staged=45)  # 5 vanish
+    rec = led.seal(led.close_interval(seq=3))
+    assert not rec.balanced
+    assert rec.owed == 5
+    assert hits == [rec]
+    assert led.imbalanced_total == 1
+    assert led.summary()["owed_total"] == 5
+
+
+def test_drift_checks_are_independent():
+    """Site credits can balance by construction; the table's own
+    counters are the independent witness.  A path that staged into
+    the table without crediting shows as staged_drift."""
+    led = Ledger(node="test")
+    led.ingest("dogstatsd", processed=10, staged=10)
+    rec = led.seal(led.close_interval(
+        seq=1, table_staged=13, table_overflow={"counter": 2}))
+    assert not rec.balanced
+    assert rec.owed == 0            # primary equation still holds
+    assert rec.staged_drift == -3   # table saw 3 uncredited samples
+    assert rec.overflow_drift == -2
+
+
+def test_rows_owed_from_routing():
+    led = Ledger(node="test")
+    rec = led.close_interval(seq=1)
+    led.credit_rows(rec, {"staged_rows": 10, "emitted_rows": 4,
+                          "forwarded_rows": 3})
+    led.seal(rec)
+    assert rec.rows_owed == 3 and not rec.balanced
+
+
+def test_ring_bounded_and_wire_credits_informational():
+    led = Ledger(capacity=4, node="test")
+    for i in range(6):
+        rec = led.close_interval(seq=i)
+        led.credit_forward_wire(rec, rows=5, nbytes=100)
+        led.credit_fanout(rec, busy_drops=1)
+        led.credit_sink(rec, "cap", 3)
+        led.seal(rec)
+    recs = led.records()
+    assert len(recs) == 4
+    assert [r.seq for r in recs] == [2, 3, 4, 5]
+    # wire/fanout/sink outcomes recorded but never balance inputs
+    assert all(r.balanced for r in recs)
+    assert recs[-1].forward_wire_rows == 5
+    assert recs[-1].fanout_busy_drops == 1
+    assert recs[-1].emitted_per_sink == {"cap": 3}
+    d = recs[-1].to_dict()
+    assert d["forward_wire"]["bytes"] == 100
+    assert d["balanced"] is True
+
+
+def test_class_drop_tally():
+    t = ClassDropTally()
+    t.add()
+    t.add(4)
+    assert t.count == 5
+    assert t.take() == 5
+    assert t.count == 0
+
+
+# ----------------------------------------------------------------------
+# server integration
+
+
+@pytest.fixture
+def make_server():
+    servers = []
+
+    def _make(**overrides):
+        data = {"statsd_listen_addresses": [],
+                "interval": "10s", "hostname": "ledger-test",
+                **overrides}
+        cap = CaptureSink()
+        s = Server(read_config(data=data), extra_sinks=[cap])
+        s.start()
+        servers.append(s)
+        return s, cap
+
+    yield _make
+    for s in servers:
+        s.shutdown()
+
+
+def _last_sealed(srv):
+    rec = srv.ledger.last()
+    assert rec is not None and rec.sealed
+    return rec
+
+
+def test_packet_paths_balance_exactly(make_server):
+    """handle_packet: good lines, overflow-free staging, a parse
+    error, and a service-check STATUS sample all land in one balanced
+    record."""
+    srv, _ = make_server()
+    srv.handle_packet(b"a:1|c\nb:2.5|g\nc:3|ms")
+    srv.handle_packet(b"garbage-line")
+    srv.handle_packet(b"_sc|db.up|1|m:ok\nd:1|c")
+    srv.flush_once()
+    rec = _last_sealed(srv)
+    assert rec.balanced, rec.to_dict()
+    assert rec.received == {"dogstatsd": 5}
+    assert rec.staged == 4 and rec.status == 1
+    assert rec.parse_errors == 1
+    assert rec.table_staged == 4
+    # flush routing accounted every staged row
+    assert rec.rows_owed == 0
+    assert rec.staged_rows >= 4
+
+
+def test_overflow_drops_balance(make_server):
+    """Row-table overflow: dropped samples credit as overflow, and
+    the per-class tally cross-check agrees (overflow_drift == 0)."""
+    srv, _ = make_server(tpu_counter_rows=4)
+    lines = "\n".join(f"ovf.{i}:1|c" for i in range(32)).encode()
+    srv.handle_packet(lines)
+    srv.flush_once()
+    rec = _last_sealed(srv)
+    assert rec.balanced, rec.to_dict()
+    assert rec.received == {"dogstatsd": 32}
+    assert rec.overflow > 0
+    assert rec.staged + rec.overflow == 32
+    assert rec.overflow_drift == 0 and rec.staged_drift == 0
+
+
+def test_intervals_are_disjoint(make_server):
+    """Credits after a close land in the NEXT record — no straddle."""
+    srv, _ = make_server()
+    srv.handle_packet(b"one:1|c")
+    srv.flush_once()
+    assert _last_sealed(srv).received == {"dogstatsd": 1}
+    srv.handle_packet(b"two:1|c\ntwo:2|c")
+    srv.flush_once()
+    recs = srv.ledger.records()
+    # interval 1's flush_tick loop-backed self-telemetry samples into
+    # interval 2 — credited under their own protocol, still balanced
+    assert recs[-1].received["dogstatsd"] == 2
+    assert recs[-1].received.get("self-telemetry", 0) > 0
+    assert all(r.balanced for r in recs)
+
+
+def test_strict_injected_drop_bumps_counter(make_server):
+    """Acceptance: with strict mode on, an injected drop (table
+    mutation that bypasses ledger crediting — a simulated lossy fast
+    path) is reported as an imbalance carrying the owed count."""
+    from veneur_tpu.protocol import dogstatsd as dsd
+    srv, _ = make_server(tpu_ledger_strict=True)
+    assert srv.ledger.strict
+    srv.handle_packet(b"good:1|c")
+    with srv.lock:  # bypass: stage 3 samples with no ledger credit
+        for i in range(3):
+            srv.table.ingest(dsd.parse_metric(f"lost.{i}:1|c".encode()))
+    srv.flush_once()
+    rec = _last_sealed(srv)
+    assert not rec.balanced
+    assert rec.staged_drift == -3  # the table owns 3 uncredited
+    assert srv.stats.get("ledger_imbalance", 0) == 1
+    assert srv.ledger.summary()["imbalanced"] == 1
+
+
+def test_http_import_balances(make_server):
+    """/import credits as http-import with the overflow/invalid
+    split from the table's own tally delta."""
+    import base64
+    import json
+    import urllib.request
+    srv, _ = make_server(http_address="127.0.0.1:0")
+    items = [
+        {"kind": "counter", "name": "imp.a", "tags": [], "value": 2.0},
+        {"kind": "gauge", "name": "imp.b", "tags": [], "value": 7.0},
+        # malformed (wrong stats width): dropped as invalid, NOT
+        # overflow — the table tally delta disambiguates
+        {"kind": "histo", "name": "imp.bad", "tags": [], "scope": "",
+         "type": "timer", "stats": [1, 2, 3],
+         "means": base64.b64encode(b"\x00" * 8).decode(),
+         "weights": base64.b64encode(b"\x00" * 8).decode()},
+    ]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.http_port}/import",
+        data=json.dumps(items).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    resp = json.loads(urllib.request.urlopen(req).read())
+    assert resp["accepted"] == 2
+    srv.flush_once()
+    rec = _last_sealed(srv)
+    assert rec.balanced, rec.to_dict()
+    assert rec.received == {"http-import": 3}
+    assert rec.staged == 2 and rec.invalid == 1 and rec.overflow == 0
+
+
+@pytest.mark.skipif(native.load() is None,
+                    reason="native library unavailable")
+def test_concurrent_multireader_balances_exactly(make_server):
+    """4 reader shards hammering handle_packet_batch on real threads
+    (the server's exact locking discipline, tests/test_multireader.py
+    machinery): the interval record balances to the sample."""
+    srv, _ = make_server()
+    n_readers, per, chunk = 4, 12_000, 250
+    streams = []
+    for r in range(n_readers):
+        lines = [f"mrl.c.{(r * per + i) % 900}:2|c".encode()
+                 for i in range(per)]
+        streams.append([lines[j:j + chunk]
+                        for j in range(0, len(lines), chunk)])
+    barrier = threading.Barrier(n_readers)
+    errs = []
+
+    def reader(bufs):
+        try:
+            shard = srv.table.make_reader_shard()
+            assert shard is not None
+            barrier.wait()
+            for pkts in bufs:
+                srv.handle_packet_batch(pkts, None, shard=shard)
+        except Exception as e:  # pragma: no cover - failure detail
+            errs.append(e)
+
+    threads = [threading.Thread(target=reader, args=(s,))
+               for s in streams]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    srv.flush_once()
+    rec = _last_sealed(srv)
+    total = n_readers * per
+    assert rec.balanced, rec.to_dict()
+    assert rec.received == {"dogstatsd": total}
+    assert rec.staged == total and rec.table_staged == total
+    assert rec.overflow == 0 and rec.rows_owed == 0
+
+
+@pytest.mark.skipif(native.load() is None,
+                    reason="native library unavailable")
+def test_shard_parse_does_no_ledger_work(make_server):
+    """Acceptance: ledger accounting adds NO work inside the reader
+    shard's lock-free parse — a parse with no commit leaves the
+    current interval untouched."""
+    srv, _ = make_server()
+    shard = srv.table.make_reader_shard()
+    assert shard is not None
+    shard.parse(b"\n".join(b"np.%d:1|c" % i for i in range(500)))
+    with srv.ledger._lock:
+        cur = srv.ledger._cur
+        assert cur.received == {} and cur.staged == 0
+    with srv.lock:
+        p, d, _ = shard.commit()
+        srv.ledger.ingest("dogstatsd", processed=p,
+                          staged=p - d, overflow=d)
+    shard.reset()
+    srv.flush_once()
+    rec = _last_sealed(srv)
+    assert rec.balanced and rec.received == {"dogstatsd": 500}
+
+
+def test_nonpipeline_mode_balances(make_server):
+    """tpu_pipeline defaults on (every other test here closes the
+    interval in begin_swap's lock round); the legacy single-buffer
+    swap() path must balance identically."""
+    srv, _ = make_server(tpu_pipeline=False)
+    for i in range(40):
+        srv.handle_packet(f"pl.{i % 7}:1|c".encode())
+    srv.flush_once()
+    srv.handle_packet("pl.后:1|c".encode())  # utf-8 name parses too
+    srv.flush_once()
+    recs = srv.ledger.records()
+    assert len(recs) >= 2
+    assert all(r.balanced for r in recs), \
+        [r.to_dict() for r in recs if not r.balanced]
+    assert recs[0].received == {"dogstatsd": 40}
+
+
+def test_debug_ledger_endpoint(make_server):
+    import json
+    import urllib.request
+    srv, _ = make_server(http_address="127.0.0.1:0")
+    srv.handle_packet(b"dbg:1|c")
+    srv.flush_once()
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{srv.http_port}/debug/ledger",
+        timeout=5).read()
+    d = json.loads(body)
+    assert d["intervals"] >= 1
+    assert d["imbalanced"] == []
+    assert d["records"][-1]["balanced"] is True
+    assert d["records"][-1]["received"] == {"dogstatsd": 1}
+    # summary also rides /debug/vars
+    v = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{srv.http_port}/debug/vars",
+        timeout=5).read())
+    assert v["ledger"]["imbalanced"] == 0

@@ -1,0 +1,267 @@
+"""Cross-tier flush trace propagation.
+
+One flush interval = one distributed trace: the local's forward
+stage span stamps its (trace_id, span_id) onto the wire (HTTP
+``X-Veneur-Trace`` header / gRPC ``veneur-trace-*`` metadata) and the
+receiving tier parents its import span under it, so the global's
+work renders inside the local's trace at ``/debug/trace/<id>``.
+Propagation must be fail-open: wires without context still parse.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+import urllib.request
+
+import pytest
+
+from veneur_tpu.core.config import read_config
+from veneur_tpu.core.server import Server
+from veneur_tpu.forward import http_import
+from veneur_tpu.sinks.simple import CaptureSink
+
+
+@pytest.fixture
+def make_server():
+    servers = []
+
+    def _make(**overrides):
+        data = {"statsd_listen_addresses": ["udp://127.0.0.1:0"],
+                "interval": "10s", "hostname": "trace-test",
+                **overrides}
+        cap = CaptureSink()
+        s = Server(read_config(data=data), extra_sinks=[cap])
+        s.start()
+        servers.append(s)
+        return s, cap
+
+    yield _make
+    for s in servers:
+        s.shutdown()
+
+
+def _send_udp(server, *lines: bytes):
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.sendto(b"\n".join(lines),
+                ("127.0.0.1", server.statsd_ports[0]))
+    sock.close()
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def _last_flush_trace(server) -> int:
+    recs = server.flush_ring.records()
+    assert recs
+    return int(recs[-1].trace_id)
+
+
+def _forward_span(server, tid):
+    spans = server.trace_index.get(tid)
+    fwd = [s for s in spans if s["name"] == "flush.forward"]
+    assert fwd, [s["name"] for s in spans]
+    return fwd[-1]
+
+
+def test_header_codec_roundtrip_and_fail_open():
+    hdr = http_import.encode_trace_header(123, 456)
+    assert hdr == "123:456"
+    assert http_import.decode_trace_header(hdr) == (123, 456)
+    for bad in (None, "", "junk", "1:2:3", "x:y", "-5:8", "0:0"):
+        assert http_import.decode_trace_header(bad) == (0, 0)
+
+
+def test_http_chain_single_stitched_trace(make_server):
+    """Acceptance: a two-process local->global run produces ONE
+    stitched trace — the global's import span parented under the
+    local's forward span, same trace id on both ends."""
+    glob, _ = make_server(http_address="127.0.0.1:0")
+    local, _ = make_server(
+        forward_address=f"http://127.0.0.1:{glob.http_port}",
+        http_address="127.0.0.1:0")
+    for v in range(50):
+        _send_udp(local, f"tp.lat:{v}|ms".encode())
+    assert _wait(lambda: local.stats.get("metrics_processed", 0) >= 50)
+    local.flush_once()
+    assert _wait(lambda: glob.stats.get("imports_received", 0) >= 1)
+
+    tid = _last_flush_trace(local)
+    assert tid
+    fwd = _forward_span(local, tid)
+    assert fwd["trace_id"] == str(tid)
+
+    # the global indexed its import span under the SAME trace id,
+    # parented under the local's forward span
+    assert _wait(lambda: glob.trace_index.get(tid))
+    imp = [s for s in glob.trace_index.get(tid) if s["name"] == "import"]
+    assert imp, glob.trace_index.get(tid)
+    sp = imp[-1]
+    assert sp["trace_id"] == str(tid)
+    assert sp["parent_id"] == fwd["span_id"]
+    assert sp["service"] == "veneur"
+    assert sp["tags"]["protocol"] == "http"
+    assert int(sp["tags"]["accepted"]) >= 1
+    assert int(sp["tags"]["bytes"]) > 0
+
+    # both ends serve the fragment over /debug/trace/<id>
+    for srv, names in ((local, {"flush.forward"}), (glob, {"import"})):
+        d = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.http_port}/debug/trace/{tid}",
+            timeout=5).read())
+        assert d["trace_id"] == str(tid)
+        assert names <= {s["name"] for s in d["spans"]}
+    # the id listing is the index into recent traces
+    d = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{local.http_port}/debug/trace",
+        timeout=5).read())
+    assert str(tid) in d["trace_ids"]
+    # /debug/flushes links the ring entry to the trace
+    d = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{local.http_port}/debug/flushes",
+        timeout=5).read())
+    assert any(r.get("trace_id") == str(tid) for r in d)
+
+
+def test_grpc_chain_single_stitched_trace(make_server):
+    pytest.importorskip("grpc")
+    glob, _ = make_server(
+        grpc_listen_addresses=["tcp://127.0.0.1:0"],
+        statsd_listen_addresses=[])
+    local, _ = make_server(
+        forward_address=f"127.0.0.1:{glob.grpc_ports[0]}",
+        forward_use_grpc=True)
+    for v in range(50):
+        _send_udp(local, f"tg.lat:{v}|ms".encode())
+    assert _wait(lambda: local.stats.get("metrics_processed", 0) >= 50)
+    local.flush_once()
+    assert _wait(lambda: glob.stats.get("imports_received", 0) >= 1)
+
+    tid = _last_flush_trace(local)
+    fwd = _forward_span(local, tid)
+    assert _wait(lambda: glob.trace_index.get(tid))
+    imp = [s for s in glob.trace_index.get(tid) if s["name"] == "import"]
+    assert imp
+    assert imp[-1]["parent_id"] == fwd["span_id"]
+    assert imp[-1]["tags"]["protocol"] == "grpc"
+
+
+def test_proxy_hop_parents_both_sides(make_server):
+    """local -> proxy (gRPC) -> global: the proxy's route span
+    parents under the local's forward span, and the global's import
+    span parents under the proxy hop — one three-process tree."""
+    pytest.importorskip("grpc")
+    from veneur_tpu.core.config import ProxyConfig
+    from veneur_tpu.core.proxy import ProxyServer
+
+    glob, _ = make_server(
+        grpc_listen_addresses=["tcp://127.0.0.1:0"],
+        statsd_listen_addresses=[])
+    proxy = ProxyServer(ProxyConfig(
+        forward_address=f"127.0.0.1:{glob.grpc_ports[0]}",
+        grpc_address="127.0.0.1:0", http_address="127.0.0.1:0"))
+    proxy.start()
+    try:
+        local, _ = make_server(
+            forward_address=f"127.0.0.1:{proxy.grpc_port}",
+            forward_use_grpc=True)
+        for v in range(30):
+            _send_udp(local, f"pxt.lat:{v}|ms".encode())
+        assert _wait(
+            lambda: local.stats.get("metrics_processed", 0) >= 30)
+        local.flush_once()
+        assert _wait(lambda: glob.stats.get("imports_received", 0) >= 1)
+
+        tid = _last_flush_trace(local)
+        fwd = _forward_span(local, tid)
+        assert _wait(lambda: proxy.trace_index.get(tid))
+        route = [s for s in proxy.trace_index.get(tid)
+                 if s["name"] == "proxy.route"]
+        assert route, proxy.trace_index.get(tid)
+        rsp = route[-1]
+        assert rsp["parent_id"] == fwd["span_id"]
+        assert rsp["service"] == "veneur-proxy"
+
+        assert _wait(lambda: glob.trace_index.get(tid))
+        imp = [s for s in glob.trace_index.get(tid)
+               if s["name"] == "import"]
+        assert imp
+        # the global hangs under the PROXY hop, not the local directly
+        assert imp[-1]["parent_id"] == rsp["span_id"]
+
+        # the proxy serves its fragment at /debug/trace/<id> too
+        d = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{proxy.http_port}/debug/trace/{tid}",
+            timeout=5).read())
+        assert any(s["name"] == "proxy.route" for s in d["spans"])
+    finally:
+        proxy.shutdown()
+
+
+def test_old_peer_wire_without_header_fail_open(make_server):
+    """An /import POST with no X-Veneur-Trace (or a garbage one)
+    parses exactly as before: accepted, no import span recorded."""
+    glob, _ = make_server(http_address="127.0.0.1:0")
+    items = [{"kind": "counter", "name": "old.peer", "tags": [],
+              "value": 3.0}]
+    for hdr in (None, "garbage", "1:2:3"):
+        headers = {"Content-Type": "application/json"}
+        if hdr is not None:
+            headers[http_import.TRACE_HEADER] = hdr
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{glob.http_port}/import",
+            data=json.dumps(items).encode(), headers=headers,
+            method="POST")
+        resp = json.loads(urllib.request.urlopen(req, timeout=5).read())
+        assert resp["accepted"] == 1
+    assert glob.trace_index.trace_ids() == []
+
+
+def test_propagation_gate_disables_stamping(make_server):
+    glob, _ = make_server(http_address="127.0.0.1:0")
+    local, _ = make_server(
+        forward_address=f"http://127.0.0.1:{glob.http_port}",
+        tpu_trace_propagation=False)
+    _send_udp(local, b"gate.lat:5|ms")
+    assert _wait(lambda: local.stats.get("metrics_processed", 0) >= 1)
+    local.flush_once()
+    assert _wait(lambda: glob.stats.get("imports_received", 0) >= 1)
+    tid = _last_flush_trace(local)
+    # wire carried no context: the global never saw this trace
+    time.sleep(0.2)
+    assert glob.trace_index.get(tid) == []
+
+
+def test_import_span_records_drops(make_server):
+    """The import span's tags carry the accept/drop split — the
+    trace view shows WHERE an interval lost samples."""
+    import base64
+    glob, _ = make_server(http_address="127.0.0.1:0")
+    items = [
+        {"kind": "counter", "name": "ok", "tags": [], "value": 1.0},
+        {"kind": "histo", "name": "bad", "tags": [], "scope": "",
+         "type": "timer", "stats": [1, 2, 3],
+         "means": base64.b64encode(b"\x00" * 8).decode(),
+         "weights": base64.b64encode(b"\x00" * 8).decode()},
+    ]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{glob.http_port}/import",
+        data=json.dumps(items).encode(),
+        headers={"Content-Type": "application/json",
+                 http_import.TRACE_HEADER: "777000111:555000999"},
+        method="POST")
+    resp = json.loads(urllib.request.urlopen(req, timeout=5).read())
+    assert resp["accepted"] == 1
+    spans = glob.trace_index.get(777000111)
+    assert len(spans) == 1
+    sp = spans[0]
+    assert sp["parent_id"] == "555000999"
+    assert sp["tags"]["accepted"] == "1"
+    assert sp["tags"]["dropped"] == "1"

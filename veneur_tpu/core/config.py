@@ -342,6 +342,19 @@ class Config:
     # stretch the interval for the rest.  0 = legacy shared flush
     # pool.  VENEUR_TPU_SINK_WORKERS overrides.
     tpu_sink_workers: int = 1
+    # conservation-ledger strict mode: any interval whose sample
+    # accounting doesn't balance (received != staged + status +
+    # dropped, or drift against the table's own counters) logs an
+    # ERROR and bumps veneur.ledger.imbalance_total instead of a
+    # warning.  VENEUR_TPU_LEDGER_STRICT=1 overrides.
+    tpu_ledger_strict: bool = False
+    # cross-tier flush trace propagation: stamp the flush cycle's
+    # (trace_id, span_id) onto forward wires (X-Veneur-Trace header /
+    # veneur-trace-* gRPC metadata) and parent import spans under the
+    # remote forward span.  Fail-open both ways: old peers ignore the
+    # header, missing headers just start no span.
+    # VENEUR_TPU_TRACE_PROPAGATION=0 disables.
+    tpu_trace_propagation: bool = True
 
     def resolve_aliases(self) -> None:
         """Fold the reference's deprecated alias keys into their

@@ -16,6 +16,10 @@ identity endpoints), so the Python equivalents live here once:
   response lists the artifact files to load into tensorboard/xprof
 - ``/debug/vars``: expvar-style JSON dump (stats dict + device-cost
   registry), via ``vars_dump``
+- ``/debug/ledger``: the sample-conservation ledger ring (last 128
+  intervals, imbalances listed up front), via ``ledger_dump``
+- ``/debug/trace/<trace_id>``: this process's fragment of a
+  distributed flush trace, via ``trace_dump``
 
 Handlers are BaseHTTPRequestHandler methods; callers pass the request
 handler plus a per-process lock serializing the profiler (only one
@@ -47,6 +51,37 @@ def vars_dump(handler, sources: dict) -> None:
     respond_ok(handler,
                json.dumps(sources, indent=1, default=str).encode(),
                "application/json")
+
+
+def ledger_dump(handler, ledger) -> None:
+    """Serve the conservation-ledger ring as JSON (last 128 sealed
+    intervals; ``imbalanced`` lists the seqs an operator should look
+    at first)."""
+    if ledger is None:
+        handler.send_error(404, "no ledger on this node")
+        return
+    respond_ok(handler, ledger.to_json(), "application/json")
+
+
+def trace_dump(handler, index, path: str) -> None:
+    """Serve one trace's local span fragment:
+    ``/debug/trace/<trace_id>``.  With no id, lists the retained
+    trace ids (oldest -> newest)."""
+    if index is None:
+        handler.send_error(404, "no trace index on this node")
+        return
+    tail = path.partition("/debug/trace")[2].strip("/")
+    if not tail:
+        respond_ok(handler, json.dumps(
+            {"trace_ids": [str(t) for t in index.trace_ids()]},
+            indent=1).encode(), "application/json")
+        return
+    try:
+        tid = int(tail)
+    except ValueError:
+        handler.send_error(400, f"bad trace id {tail!r}")
+        return
+    respond_ok(handler, index.to_json(tid), "application/json")
 
 
 def _query_seconds(query: str, default: float) -> float:

@@ -165,6 +165,25 @@ class FlushResult:
     # riders appended afterwards (status checks); otherwise the frame
     # is materialized into ``metrics`` and this is None
     frame: MetricFrame | None = None
+    # row-granularity routing counts for the conservation ledger:
+    # every touched row is emitted, forwarded, both (overlap —
+    # default-scope histos on a local node), or retained (neither).
+    # Counted from the actual routing decisions, NOT derived as a
+    # residual, so `staged == emitted + forwarded - overlap +
+    # retained` is a real check on the routing paths
+    row_accounting: dict = field(default_factory=lambda: {
+        "staged_rows": 0, "emitted_rows": 0, "forwarded_rows": 0,
+        "overlap_rows": 0, "retained_rows": 0})
+
+    def account_rows(self, staged: int = 0, emitted: int = 0,
+                     forwarded: int = 0, overlap: int = 0,
+                     retained: int = 0) -> None:
+        acct = self.row_accounting
+        acct["staged_rows"] += int(staged)
+        acct["emitted_rows"] += int(emitted)
+        acct["forwarded_rows"] += int(forwarded)
+        acct["overlap_rows"] += int(overlap)
+        acct["retained_rows"] += int(retained)
 
     def metric_count(self) -> int:
         return len(self.metrics) + (len(self.frame)
@@ -431,15 +450,23 @@ class Flusher:
         vals = pre.get("counters")
         if vals is None:
             return
+        n_fwd = n_emit = n_ret = 0
         for row in np.nonzero(
                 snap.counter_touched[:len(snap.counter_meta)])[0]:
             meta = snap.counter_meta[row]
             v = float(vals[row])
             if self._forwardable(meta, always=False):
                 res.forward.append(ForwardRow(meta, "counter", value=v))
+                n_fwd += 1
             elif self._emit_local(meta):
                 res.metrics.append(
                     self._mk(meta.name, ts, v, meta, im.COUNTER))
+                n_emit += 1
+            else:
+                n_ret += 1
+        res.account_rows(staged=n_fwd + n_emit + n_ret,
+                         emitted=n_emit, forwarded=n_fwd,
+                         retained=n_ret)
         # slice to the meta-backed rows before summing so the tally
         # matches emitted+forwarded rows (the full plane can carry
         # stale touch bits past len(meta))
@@ -451,15 +478,23 @@ class Flusher:
         vals = pre.get("gauges")
         if vals is None:
             return
+        n_fwd = n_emit = n_ret = 0
         for row in np.nonzero(
                 snap.gauge_touched[:len(snap.gauge_meta)])[0]:
             meta = snap.gauge_meta[row]
             v = float(vals[row])
             if self._forwardable(meta, always=False):
                 res.forward.append(ForwardRow(meta, "gauge", value=v))
+                n_fwd += 1
             elif self._emit_local(meta):
                 res.metrics.append(
                     self._mk(meta.name, ts, v, meta, im.GAUGE))
+                n_emit += 1
+            else:
+                n_ret += 1
+        res.account_rows(staged=n_fwd + n_emit + n_ret,
+                         emitted=n_emit, forwarded=n_fwd,
+                         retained=n_ret)
         res.tally["gauges"] = int(
             snap.gauge_touched[:len(snap.gauge_meta)].sum())
 
@@ -485,6 +520,7 @@ class Flusher:
         emit_pcts = not self.is_local
         fwd_pos = {r: i for i, r in enumerate(pre["histo_fwd"])}
 
+        n_fwd = n_emit = n_both = n_ret = 0
         for row in rows:
             meta = snap.histo_meta[row]
             st = stats[row]
@@ -494,10 +530,16 @@ class Flusher:
                     meta, "histo", stats=st.copy(),
                     means=pre["fwd_means"][pos].copy(),
                     weights=pre["fwd_weights"][pos].copy()))
+                n_fwd += 1
             # mixed-scope histos emit local aggregates even while their
             # digest forwards; global-only histos emit nothing locally
             if meta.scope == dsd.SCOPE_GLOBAL and self.is_local:
+                if pos is None:
+                    n_ret += 1
                 continue
+            n_emit += 1
+            if pos is not None:
+                n_both += 1
             # the reference's ``global`` flag (samplers.go:511 Flush):
             # true only for global-scope rows flushed on a global node
             global_mode = (meta.scope == dsd.SCOPE_GLOBAL and
@@ -508,6 +550,9 @@ class Flusher:
                                  with_percentiles=emit_pcts or
                                  meta.scope == dsd.SCOPE_LOCAL,
                                  global_mode=global_mode)
+        res.account_rows(staged=len(rows), emitted=n_emit,
+                         forwarded=n_fwd, overlap=n_both,
+                         retained=n_ret)
         res.tally["histograms"] = int(
             snap.histo_touched[:len(snap.histo_meta)].sum())
 
@@ -568,16 +613,23 @@ class Flusher:
             return
         ests = pre.get("ests")
         fwd_pos = {r: i for i, r in enumerate(pre.get("set_fwd", ()))}
+        n_fwd = n_emit = n_ret = 0
         for row in rows:
             meta = snap.set_meta[row]
             pos = fwd_pos.get(int(row))
             if pos is not None:
                 res.forward.append(ForwardRow(
                     meta, "set", regs=pre["fwd_regs"][pos].copy()))
+                n_fwd += 1
             elif self._emit_local(meta):
                 res.metrics.append(self._mk(
                     meta.name, ts, float(round(ests[row])), meta,
                     im.GAUGE))
+                n_emit += 1
+            else:
+                n_ret += 1
+        res.account_rows(staged=len(rows), emitted=n_emit,
+                         forwarded=n_fwd, retained=n_ret)
         res.tally["sets"] = int(
             snap.set_touched[:len(snap.set_meta)].sum())
 
@@ -605,8 +657,12 @@ class Flusher:
             emit = ~fwd
             frame.add_block(metas, rows[emit], v64[emit],
                             type_code=type_code)
+            res.account_rows(staged=len(rows),
+                             emitted=int(emit.sum()),
+                             forwarded=int(fwd.sum()))
         else:
             frame.add_block(metas, rows, v64, type_code=type_code)
+            res.account_rows(staged=len(rows), emitted=len(rows))
 
     def _frame_counters(self, snap: Snapshot, res: FlushResult,
                         pre: dict, frame: MetricFrame) -> None:
@@ -649,6 +705,20 @@ class Flusher:
                 weights=pre["fwd_weights"][pos].copy()))
 
         sc = _scope_codes(metas, rows)
+        # routing counts mirror the legacy loop: on a local node every
+        # non-local-scope row forwards and every non-global-scope row
+        # emits (default scope does both); a global node emits all
+        if self.is_local:
+            fwd_mask = sc != _SCOPE_LOCAL
+            emit_mask = sc != _SCOPE_GLOBAL
+        else:
+            fwd_mask = np.zeros(len(rows), dtype=bool)
+            emit_mask = np.ones(len(rows), dtype=bool)
+        res.account_rows(
+            staged=len(rows), emitted=int(emit_mask.sum()),
+            forwarded=len(pre["histo_fwd"]),
+            overlap=int((emit_mask & fwd_mask).sum()),
+            retained=int((~emit_mask & ~fwd_mask).sum()))
         if self.is_local:
             # mixed-scope histos emit local aggregates even while
             # their digest forwards; global-only histos emit nothing
@@ -733,6 +803,9 @@ class Flusher:
             in_fwd = np.isin(rows, np.asarray(fwd))
         sc = _scope_codes(metas, rows)
         emit = ~in_fwd & ~((sc == _SCOPE_GLOBAL) & self.is_local)
+        res.account_rows(staged=len(rows), emitted=int(emit.sum()),
+                         forwarded=len(fwd),
+                         retained=int((~emit & ~in_fwd).sum()))
         erows = rows[emit]
         if len(erows) and ests is not None:
             vals = np.round(np.asarray(ests)[erows]).astype(np.float64)

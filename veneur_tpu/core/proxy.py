@@ -18,6 +18,7 @@ import logging
 import socket
 import threading
 import time
+import zlib
 from collections import defaultdict
 from concurrent.futures import ThreadPoolExecutor
 
@@ -25,6 +26,9 @@ from veneur_tpu.forward import http_import
 from veneur_tpu.forward.discovery import (ConsulDiscoverer,
                                           DestinationRing,
                                           StaticDiscoverer)
+# direct module import (not the observe package facade): a pure-proxy
+# process must not pull the jax-backed devicecost module at startup
+from veneur_tpu.observe.traceindex import TraceIndex
 
 log = logging.getLogger("veneur_tpu.proxy")
 
@@ -39,6 +43,10 @@ class ProxyServer:
         self._pool = ThreadPoolExecutor(max_workers=16)
         self._clients: dict[str, object] = {}
         self._clients_lock = threading.Lock()
+        # the proxy's fragment of cross-tier flush traces: route spans
+        # parented under the local tier's forward span, served at
+        # /debug/trace/<trace_id>
+        self.trace_index = TraceIndex()
 
         problems = config.validate()
         if problems:
@@ -143,7 +151,12 @@ class ProxyServer:
                       64 * 1024 * 1024)])
 
         def send_metrics(request, context):
-            self.route_pb_metrics(list(request.metrics))
+            from veneur_tpu.forward.grpc_forward import \
+                decode_trace_metadata
+            self.route_pb_metrics(
+                list(request.metrics),
+                trace_ctx=decode_trace_metadata(
+                    context.invocation_metadata()))
             return empty_pb2.Empty()
 
         handler = grpc.method_handlers_generic_handler(
@@ -180,6 +193,9 @@ class ProxyServer:
                     debughttp.respond_ok(self, b"dev")
                 elif self.path.startswith("/debug/pprof"):
                     debughttp.pprof(self, proxy._pprof_lock)
+                elif self.path.startswith("/debug/trace"):
+                    debughttp.trace_dump(self, proxy.trace_index,
+                                         self.path)
                 elif self.path.startswith("/debug/vars"):
                     # same expvar surface as the server's listener;
                     # the proxy has no flush ring, but its routing
@@ -235,7 +251,10 @@ class ProxyServer:
                     proxy.bump("import_errors")
                     self.send_error(400, str(e))
                     return
-                proxy.route_json_items(items)
+                proxy.route_json_items(
+                    items,
+                    trace_ctx=http_import.decode_trace_header(
+                        self.headers.get(http_import.TRACE_HEADER)))
                 out = json.dumps({"accepted": len(items)}).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
@@ -276,11 +295,36 @@ class ProxyServer:
         joined = ",".join(tags) if tags else item.get("tagstring", "")
         return f"{item.get('name')}|{item.get('type')}|{joined}"
 
-    def route_pb_metrics(self, metrics: list) -> None:
+    def _route_span(self, protocol: str, trace_ctx, n: int):
+        """The proxy's fragment of a cross-tier flush trace: a route
+        span parented under the sending tier's forward span.  Returns
+        None when no (or zero) context arrived or propagation is off —
+        routing itself is unconditional (fail-open)."""
+        if (not trace_ctx or not trace_ctx[0] or
+                not getattr(self.config, "tpu_trace_propagation",
+                            True)):
+            return None
+        from veneur_tpu.trace.spans import Span
+        return Span("proxy.route", service="veneur-proxy",
+                    trace_id=trace_ctx[0], parent_id=trace_ctx[1],
+                    tags={"protocol": protocol, "metrics": str(n)})
+
+    def _finish_route_span(self, sp) -> tuple[int, int] | None:
+        """Finish + index the route span; returns the (trace_id,
+        span_id) the batched re-forwards stamp onto their wires so the
+        receiving global parents under the PROXY hop."""
+        if sp is None:
+            return None
+        sp.finish(self.trace_client)
+        self.trace_index.add(sp.proto)
+        return (sp.trace_id, sp.span_id)
+
+    def route_pb_metrics(self, metrics: list, trace_ctx=None) -> None:
         """Group by destination and forward over gRPC, one task per
         destination (proxysrv/server.go:286 per-dest goroutines).
         Routes on the dedicated gRPC destination set when configured
         (grpc_forward_address), else the main ring."""
+        span = self._route_span("grpc", trace_ctx, len(metrics))
         ring = self.grpc_ring or self.ring
         groups: dict[str, list] = defaultdict(list)
         routed = dropped = 0
@@ -293,8 +337,9 @@ class ProxyServer:
         self.bump("metrics_routed", routed)
         if dropped:
             self.bump("metrics_dropped", dropped)
+        wire_ctx = self._finish_route_span(span)
         for dest, batch in groups.items():
-            self._pool.submit(self._send_grpc, dest, batch)
+            self._pool.submit(self._send_grpc, dest, batch, wire_ctx)
 
     def _grpc_channel_credentials(self):
         c = self.config
@@ -308,10 +353,17 @@ class ProxyServer:
                 if c.forward_grpc_tls_ca else None)
         return grpc.ssl_channel_credentials(root_certificates=root)
 
-    def _send_grpc(self, dest: str, batch: list) -> None:
+    def _send_grpc(self, dest: str, batch: list,
+                   trace_ctx=None) -> None:
         from veneur_tpu.forward.gen import forward_pb2
-        from veneur_tpu.forward.grpc_forward import ForwardClient
+        from veneur_tpu.forward.grpc_forward import (ForwardClient,
+                                                     SPAN_ID_KEY,
+                                                     TRACE_ID_KEY)
         import grpc
+        metadata = None
+        if trace_ctx and trace_ctx[0]:
+            metadata = [(TRACE_ID_KEY, str(trace_ctx[0])),
+                        (SPAN_ID_KEY, str(trace_ctx[1]))]
         try:
             with self._clients_lock:
                 client = self._clients.get(dest)
@@ -322,7 +374,8 @@ class ProxyServer:
                             self._grpc_channel_credentials()))
                     self._clients[dest] = client
             client._call(forward_pb2.MetricList(metrics=batch),
-                         timeout=self.config.forward_timeout)
+                         timeout=self.config.forward_timeout,
+                         metadata=metadata)
             self.bump("forwards_sent")
         except (grpc.RpcError, OSError) as e:
             # dropped-and-counted, never retried within a flush
@@ -330,9 +383,11 @@ class ProxyServer:
             self.bump("forward_errors")
             log.warning("proxy forward to %s failed: %s", dest, e)
 
-    def route_json_items(self, items: list[dict]) -> None:
+    def route_json_items(self, items: list[dict],
+                         trace_ctx=None) -> None:
         """HTTP /import half: route decoded JSON items and re-POST per
         destination (proxy.go:587 ProxyMetrics)."""
+        span = self._route_span("http", trace_ctx, len(items))
         groups: dict[str, list] = defaultdict(list)
         dropped = 0
         for item in items:
@@ -343,18 +398,23 @@ class ProxyServer:
         self.bump("metrics_routed", len(items) - dropped)
         if dropped:
             self.bump("metrics_dropped", dropped)
+        wire_ctx = self._finish_route_span(span)
         for dest, batch in groups.items():
-            self._pool.submit(self._send_http, dest, batch)
+            self._pool.submit(self._send_http, dest, batch, wire_ctx)
 
-    def _send_http(self, dest: str, batch: list[dict]) -> None:
+    def _send_http(self, dest: str, batch: list[dict],
+                   trace_ctx=None) -> None:
         import urllib.request
-        import zlib
         body = zlib.compress(json.dumps(batch).encode())
         url = dest if dest.startswith("http") else f"http://{dest}"
+        headers = {"Content-Type": "application/json",
+                   "Content-Encoding": "deflate"}
+        if trace_ctx and trace_ctx[0]:
+            headers[http_import.TRACE_HEADER] = \
+                http_import.encode_trace_header(*trace_ctx)
         req = urllib.request.Request(
             url.rstrip("/") + "/import", data=body,
-            headers={"Content-Type": "application/json",
-                     "Content-Encoding": "deflate"}, method="POST")
+            headers=headers, method="POST")
         try:
             with urllib.request.urlopen(
                     req, timeout=self.config.forward_timeout) as r:
@@ -372,20 +432,34 @@ class ProxyServer:
         []DatadogTraceSpan and no deflate).  Nested span lists are
         flattened for callers that batch per trace."""
         groups: dict[str, list] = defaultdict(list)
-        routed = dropped = 0
+        routed = dropped = untraced = 0
         for t in traces:
             spans = t if isinstance(t, list) else [t]
             for sp in spans:
                 if not isinstance(sp, dict):
                     dropped += 1
                     continue
-                tid = str(sp.get("trace_id", 0))
+                raw_tid = sp.get("trace_id")
+                if not raw_tid:
+                    # missing/zero trace id: hashing the literal "0"
+                    # would pin every untraced span onto ONE
+                    # destination (a silent hot spot).  Derive a
+                    # deterministic id from the span's own content —
+                    # the same span always routes the same way — and
+                    # count it so operators see the bad emitters
+                    # (veneur.proxy.untraced_spans_total)
+                    untraced += 1
+                    raw_tid = zlib.crc32(json.dumps(
+                        sp, sort_keys=True, default=str).encode())
+                tid = str(raw_tid)
                 try:
                     groups[self.trace_ring.get(tid)].append(sp)
                     routed += 1
                 except LookupError:
                     dropped += 1
         self.bump("traces_routed", routed)
+        if untraced:
+            self.bump("untraced_spans_total", untraced)
         if dropped:
             self.bump("traces_dropped", dropped)
         for dest, batch in groups.items():
@@ -475,7 +549,7 @@ class ProxyServer:
             snap = dict(self.stats)
         for key in ("metrics_routed", "metrics_dropped",
                     "forwards_sent", "forward_errors",
-                    "import_errors"):
+                    "import_errors", "untraced_spans_total"):
             d = snap.get(key, 0) - self._stats_last.get(key, 0)
             self._stats_last[key] = snap.get(key, 0)
             if d:

@@ -250,9 +250,23 @@ class Server:
         from veneur_tpu import observe
         self.device_costs = observe.REGISTRY
         self.flush_ring = observe.FlushRing()
+        # cross-tier trace stitching: this process's fragment of every
+        # recent flush trace (the cycle's span tree plus any import
+        # spans parented under a remote tier's forward span) lives in
+        # a bounded index served at /debug/trace/<trace_id>
+        self.trace_index = observe.TraceIndex()
         self.flush_tracer = observe.FlushTracer(
             self.trace_client, self.flush_ring,
-            registry=self.device_costs)
+            registry=self.device_costs, index=self.trace_index)
+        # end-to-end sample-conservation ledger: ingest paths credit
+        # under self.lock (same critical section as the table
+        # counters), the interval closes inside begin_swap's lock
+        # round, and the sealed record lands at /debug/ledger
+        self.ledger = observe.Ledger(
+            strict=bool(getattr(config, "tpu_ledger_strict", False)),
+            node="local" if self.is_local else "global",
+            on_imbalance=lambda rec: self.bump("ledger_imbalance"))
+        self._ledger_fanout_last = (0, 0, 0)
 
         self.events: list[dsd.Event] = []
         self.checks: list[dsd.ServiceCheck] = []
@@ -553,14 +567,19 @@ class Server:
                 # count as dropped (matching ingest_parsed)
                 checks.append(parsed)
         work = None
+        n_status = 0
         if samples or events or checks:
             with self.lock:
                 for s in samples:
                     processed += 1
-                    if not self.table.ingest(s):
+                    if s.type == dsd.STATUS:
+                        n_status += 1
+                        self.table.ingest(s)
+                    elif not self.table.ingest(s):
                         dropped += 1
                 for chk in checks:
                     processed += 1
+                    n_status += 1
                     self.table.ingest(dsd.Sample(
                         name=chk.name, type=dsd.STATUS,
                         value=float(chk.status), tags=chk.tags,
@@ -569,7 +588,18 @@ class Server:
                     self.events.extend(events)
                 if checks:
                     self.checks.extend(checks)
+                # ledger credit in the same critical section as the
+                # table counters, so an interval close (begin_swap)
+                # can never split a packet's table bumps from its
+                # ledger entry
+                self.ledger.ingest(
+                    "dogstatsd", processed=processed,
+                    staged=processed - dropped - n_status,
+                    overflow=dropped, status=n_status,
+                    parse_errors=errors)
                 work = self._maybe_device_step_locked()
+        elif errors:
+            self.ledger.ingest("dogstatsd", parse_errors=errors)
         self._apply_staged(work)
         # one stats-lock round per packet, not per line
         if errors:
@@ -585,7 +615,17 @@ class Server:
         processed = dropped = 0
         if isinstance(parsed, dsd.Sample):
             with self.lock:
-                ok = self.table.ingest(parsed)
+                if parsed.type == dsd.STATUS:
+                    ok = True
+                    self.table.ingest(parsed)
+                    self.ledger.ingest("dogstatsd", processed=1,
+                                       status=1)
+                else:
+                    ok = self.table.ingest(parsed)
+                    self.ledger.ingest(
+                        "dogstatsd", processed=1,
+                        staged=1 if ok else 0,
+                        overflow=0 if ok else 1)
                 work = self._maybe_device_step_locked()
             self._apply_staged(work)
             processed = 1
@@ -601,6 +641,7 @@ class Server:
             with self.lock:
                 self.table.ingest(sample)
                 self.checks.append(parsed)
+                self.ledger.ingest("dogstatsd", processed=1, status=1)
             processed = 1
         if bump:
             if processed:
@@ -608,6 +649,28 @@ class Server:
             if dropped:
                 self.bump("metrics_dropped", dropped)
         return processed, dropped
+
+    def note_import_span(self, protocol: str, accepted: int,
+                         dropped: int, trace_id: int, span_id: int,
+                         nbytes: int = 0) -> None:
+        """Record this tier's half of a cross-process flush trace: the
+        sending tier stamped its cycle's (trace_id, span_id) onto the
+        wire (X-Veneur-Trace header / veneur-trace-* gRPC metadata),
+        so the import span recorded here parents under the remote
+        forward span and the whole interval stitches into one tree at
+        /debug/trace/<trace_id> on either end."""
+        if not trace_id or not getattr(self.config,
+                                       "tpu_trace_propagation", True):
+            return
+        from veneur_tpu.trace.spans import Span
+        sp = Span("import", service="veneur", trace_id=trace_id,
+                  parent_id=span_id,
+                  tags={"protocol": protocol,
+                        "accepted": str(accepted),
+                        "dropped": str(dropped),
+                        "bytes": str(nbytes)})
+        sp.finish(self.trace_client)
+        self.trace_index.add(sp.proto)
 
     def _maybe_device_step_locked(self):
         """Mid-interval device step once enough samples are staged
@@ -1050,9 +1113,13 @@ class Server:
             good.append(drained)
         if shard is not None:
             buf = b"\n".join(good)
-            shard.parse(buf)  # lock-free fused pass
+            shard.parse(buf)  # lock-free fused pass (NO ledger work)
             with self.lock:
                 processed, dropped, others = shard.commit()
+                self.ledger.ingest("dogstatsd",
+                                   processed=processed,
+                                   staged=processed - dropped,
+                                   overflow=dropped)
                 work = self._maybe_device_step_locked()
             self._apply_staged(work)
             shard.reset()  # scrub local scratch off the lock
@@ -1076,6 +1143,10 @@ class Server:
             with self.lock:
                 processed, dropped, others = \
                     self.table.ingest_buffer(buf)
+                self.ledger.ingest("dogstatsd",
+                                   processed=processed,
+                                   staged=processed - dropped,
+                                   overflow=dropped)
                 work = self._maybe_device_step_locked()
             self._apply_staged(work)
             for off, ln, _kind in others:
@@ -1094,6 +1165,10 @@ class Server:
             pb = parser.parse(b"\n".join(good), copy=False)
             with self.lock:
                 processed, dropped = self.table.ingest_columns(pb)
+                self.ledger.ingest("dogstatsd",
+                                   processed=processed,
+                                   staged=processed - dropped,
+                                   overflow=dropped)
                 work = self._maybe_device_step_locked()
             self._apply_staged(work)
             # events / service checks / malformed lines: per-line
@@ -1111,6 +1186,10 @@ class Server:
                 dropped += d
         if errors:
             self.bump("packet_errors", errors)
+            # informational (not a balance input), so out-of-lock is
+            # fine — slow-path sample credits happened in
+            # ingest_parsed above
+            self.ledger.ingest("dogstatsd", parse_errors=errors)
         if processed:
             self.bump("metrics_processed", processed)
         if dropped:
@@ -1208,6 +1287,13 @@ class Server:
                     debughttp.respond_ok(
                         self, server.flush_ring.to_json(),
                         "application/json")
+                elif self.path.startswith("/debug/ledger"):
+                    from veneur_tpu.core import debughttp
+                    debughttp.ledger_dump(self, server.ledger)
+                elif self.path.startswith("/debug/trace"):
+                    from veneur_tpu.core import debughttp
+                    debughttp.trace_dump(self, server.trace_index,
+                                         self.path)
                 elif self.path.startswith("/debug/vars"):
                     from veneur_tpu.core import debughttp
                     with server._stats_lock:
@@ -1236,6 +1322,9 @@ class Server:
                             "decode_scratch_bytes":
                                 _decode_scratch_bytes(),
                         },
+                        # conservation at a glance; full per-interval
+                        # records live at /debug/ledger
+                        "ledger": server.ledger.summary(),
                     })
                 elif (self.path == "/quitquitquit" and
                       server.config.http_quit):
@@ -1260,11 +1349,26 @@ class Server:
                         items = http_import.decode_body(
                             body,
                             self.headers.get("Content-Encoding", ""))
+                        tid, sid = http_import.decode_trace_header(
+                            self.headers.get(http_import.TRACE_HEADER))
                         with server.lock:
+                            # split dropped into overflow vs invalid
+                            # exactly: every overflow bump happens
+                            # under this same lock, so the tally delta
+                            # across apply_import is this request's
+                            ov0 = server.table.overflow_total()
                             acc, dropped = http_import.apply_import(
                                 server.table, items)
+                            ov = server.table.overflow_total() - ov0
+                            server.ledger.ingest(
+                                "http-import",
+                                processed=acc + dropped, staged=acc,
+                                overflow=ov, invalid=dropped - ov)
                             work = server._maybe_device_step_locked()
                         server._apply_staged(work)
+                        server.note_import_span(
+                            "http", acc, dropped, tid, sid,
+                            nbytes=len(body))
                         server.bump("imports_received", acc)
                         server.bump("metrics_dropped", dropped)
                         server.bump("import_response_ns",
@@ -1377,6 +1481,15 @@ class Server:
                     checks = self.checks
                     self.events, self.checks = [], []
                     status = self.table.take_status()
+                    # interval close in the SAME lock round as
+                    # begin_swap: in-flight batches can't straddle the
+                    # boundary, so site credits and the table's own
+                    # counters describe the same sample population
+                    led = self.ledger.close_interval(
+                        seq=cyc.record.seq,
+                        trace_id=cyc.record.trace_id,
+                        table_staged=pend.ingested,
+                        table_overflow=pend.overflow)
             with cyc.stage("swap_apply"):
                 snap = self.table.complete_swap(pend)
         else:
@@ -1387,11 +1500,22 @@ class Server:
                     checks = self.checks
                     self.events, self.checks = [], []
                     status = self.table.take_status()
+                    led = self.ledger.close_interval(
+                        seq=cyc.record.seq,
+                        trace_id=cyc.record.trace_id,
+                        table_staged=snap.ingested,
+                        table_overflow=snap.overflow)
         # dispatch / device_wait / host_emit stages happen inside the
         # flusher, against the same cycle; retain_frame keeps the
         # columnar MetricFrame alive for frame-aware sinks instead of
         # materializing InterMetrics eagerly
         res = self.flusher.flush(snap, cycle=cyc, retain_frame=True)
+        # row-granularity flush balance: the flusher's routing counts
+        # are synchronous, so they are balance inputs (wire outcomes
+        # below are async and informational only)
+        acct = getattr(res, "row_accounting", None)
+        if acct:
+            self.ledger.credit_rows(led, acct)
         # the interval's reads are done (forward rows hold copies);
         # recycle the host set plane into the table's reuse pool
         snap.release()
@@ -1428,16 +1552,19 @@ class Server:
 
         def traced_forward(rows):
             # runs on the pool; the forward stage span hangs off the
-            # same cycle root (stage timing is lock-guarded)
+            # same cycle root (stage timing is lock-guarded).  The
+            # forward span's (trace_id, span_id) ride the wire so the
+            # receiving tier parents its import span under it.
             with cyc.stage("forward") as sp:
                 sp.add_tag("rows", str(len(rows)))
-                self._forward(rows)
+                self._forward(rows, trace_ctx=cyc.wire_context(sp),
+                              led=led)
 
         with cyc.stage("sink_flush"):
             fanout_tasks = []
             for sink in self.metric_sinks:
                 fn = self._sink_flush_fn(sink, res, events + checks,
-                                         cyc)
+                                         cyc, led)
                 if self._fanout is not None:
                     task = self._fanout.dispatch(sink.name, fn)
                     if task is not None:
@@ -1488,6 +1615,21 @@ class Server:
         cyc.record.metrics_emitted = res.metric_count()
         cyc.record.forward_rows = len(res.forward)
         cyc.record.tally = dict(res.tally)
+        # fan-out worker deltas (busy-drops / retries / timeouts) for
+        # this interval, then seal: the balance checks run and the
+        # record joins the /debug/ledger ring before self-telemetry
+        # reads it
+        if self._fanout is not None:
+            fstats = self._fanout.stats()
+            busy = sum(v.get("busy_drops", 0) for v in fstats.values())
+            rets = sum(v.get("retries", 0) for v in fstats.values())
+            touts = sum(v.get("timeouts", 0) for v in fstats.values())
+            last = self._ledger_fanout_last
+            self.ledger.credit_fanout(
+                led, busy_drops=busy - last[0],
+                retries=rets - last[1], timeouts=touts - last[2])
+            self._ledger_fanout_last = (busy, rets, touts)
+        self.ledger.seal(led)
         try:
             self.telemetry.flush_tick(
                 res.tally, time.monotonic_ns() - t_flush0, sink_durs,
@@ -1503,7 +1645,7 @@ class Server:
             res.frame = None
         return res
 
-    def _sink_flush_fn(self, sink, res, other, cyc):
+    def _sink_flush_fn(self, sink, res, other, cyc, led=None):
         """Build the flush closure for one sink: routing (whitelists +
         excluded tags) happens HERE on the flush thread — vectorized
         per pool row for frames — so the worker only encodes and
@@ -1516,12 +1658,14 @@ class Server:
         if frame is not None and hasattr(sink, "flush_frame"):
             extra = sinks_base.route(res.metrics, sink.name, base)
             payload = frame.route(sink.name, sink, extra=extra)
+            n_routed = payload.total_len()
 
             def call():
                 sink.flush_frame(payload)
         else:
             batch = sinks_base.route(res.all_metrics(), sink.name,
                                      base)
+            n_routed = len(batch)
 
             def call():
                 sink.flush(batch)
@@ -1533,6 +1677,10 @@ class Server:
                     call()
                     if other:
                         sink.flush_other_samples(other)
+                if led is not None:
+                    # post-success: what actually left through this
+                    # sink (async; may land after seal)
+                    self.ledger.credit_sink(led, sink.name, n_routed)
             finally:
                 with self._stats_lock:
                     self._sink_durations[sink.name] = (
@@ -1574,33 +1722,47 @@ class Server:
                     "the CPU backend so metrics keep flowing", why)
         jax.config.update("jax_platforms", "cpu")
 
-    def _forward(self, rows) -> None:
+    def _forward(self, rows, trace_ctx=None, led=None) -> None:
         """Ship mergeable state upstream over gRPC or HTTP (reference
         flusher.go:82-99: forwardGRPC when configured, else
-        flushForward; errors dropped-and-counted, never retried)."""
+        flushForward; errors dropped-and-counted, never retried).
+        ``trace_ctx`` is the flush cycle's (trace_id, span_id) stamped
+        onto the wire for cross-tier stitching; ``led`` is the closed
+        interval's ledger record (wire outcomes credit it
+        asynchronously, possibly after seal)."""
         t0 = time.monotonic_ns()
+        if not getattr(self.config, "tpu_trace_propagation", True):
+            trace_ctx = None
         try:
             if self.config.forward_use_grpc:
-                self._forward_grpc(rows)
+                self._forward_grpc(rows, trace_ctx, led)
                 return
-            self._forward_http(rows)
+            self._forward_http(rows, trace_ctx, led)
         except Exception as e:
             # encoding bugs / missing grpcio / anything: forwarding
             # must never abort the flush pipeline
             self.bump("metrics_dropped", len(rows))
             self.bump("forward_errors")
+            if led is not None:
+                self.ledger.credit_forward_wire(led, errors=1)
             log.exception("forward failed: %s", e)
         finally:
             self.bump("forward_duration_ns",
                       time.monotonic_ns() - t0)
             self.bump("forward_post_metrics", len(rows))
 
-    def _forward_http(self, rows) -> None:
+    def _forward_http(self, rows, trace_ctx=None, led=None) -> None:
         if self.config.forward_json_schema == "reference":
             body, headers = http_import.encode_rows_reference(
                 rows, compression=float(self.config.tpu_compression))
         else:
             body, headers = http_import.encode_rows(rows)
+        if trace_ctx and trace_ctx[0]:
+            # header-only: an old peer that predates tracing ignores
+            # it and parses the body unchanged (fail-open)
+            headers = dict(headers)
+            headers[http_import.TRACE_HEADER] = \
+                http_import.encode_trace_header(*trace_ctx)
         url = self.config.forward_address.rstrip("/") + "/import"
         if not url.startswith("http"):
             url = "http://" + url
@@ -1612,9 +1774,15 @@ class Server:
         except OSError as e:
             self.bump("metrics_dropped", len(rows))
             self.bump("forward_errors")
+            if led is not None:
+                self.ledger.credit_forward_wire(led, errors=1)
             log.warning("forward failed: %s", e)
+        else:
+            if led is not None:
+                self.ledger.credit_forward_wire(
+                    led, rows=len(rows), nbytes=len(body))
 
-    def _forward_grpc(self, rows) -> None:
+    def _forward_grpc(self, rows, trace_ctx=None, led=None) -> None:
         from veneur_tpu.forward.grpc_forward import ForwardClient
         import grpc as _grpc
         if self._grpc_client is None:
@@ -1623,11 +1791,19 @@ class Server:
                 compression=float(self.config.tpu_compression),
                 credentials=self._forward_grpc_credentials())
         try:
-            self._grpc_client.send(rows)
+            nbytes = self._grpc_client.send(
+                rows, trace_context=trace_ctx)
         except _grpc.RpcError as e:
             self.bump("metrics_dropped", len(rows))
             self.bump("forward_errors")
+            if led is not None:
+                self.ledger.credit_forward_wire(led, errors=1)
             log.warning("grpc forward failed: %s", e)
+        else:
+            if led is not None:
+                self.ledger.credit_forward_wire(
+                    led, rows=len(rows),
+                    nbytes=int(nbytes) if nbytes else 0)
 
     # ------------------------------------------------------------------
 

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from veneur_tpu import native, observe
+from veneur_tpu.observe.ledger import ClassDropTally
 from veneur_tpu.ops import hll, segment, tdigest
 from veneur_tpu.protocol import columnar, dogstatsd as dsd
 from veneur_tpu.utils import hashing, intern, jitopts
@@ -221,7 +222,7 @@ class _PendingSwap:
     __slots__ = ("work", "state", "counter_meta", "counter_touched",
                  "gauge_meta", "gauge_touched", "histo_meta",
                  "histo_touched", "set_meta", "set_touched",
-                 "overflow")
+                 "overflow", "ingested")
 
 
 @dataclass
@@ -273,7 +274,16 @@ class _ClassIndex:
         self.meta: list[RowMeta] = []
         self.touched = np.zeros(capacity, dtype=bool)
         self.last_gen = np.zeros(capacity, dtype=np.int64)
-        self.overflow = 0
+        # centralized drop tally: every fast-path drop site goes
+        # through drops.add, so /debug/vars, interval snapshots, and
+        # the conservation ledger all read ONE number
+        self.drops = ClassDropTally()
+
+    @property
+    def overflow(self) -> int:
+        """Interval overflow-drop count (SAMPLES, not keys).  Mutate
+        via ``drops.add``/``drops.take`` only."""
+        return self.drops.count
 
     def lookup(self, sample_key: tuple, name: str,
                tags: tuple[str, ...], scope: str, mtype: str,
@@ -282,10 +292,8 @@ class _ClassIndex:
         row = self.rows.get(sample_key)
         if row is None:
             if len(self.meta) >= self.capacity:
-                # fast-path callers tally dropped samples themselves
-                # (overflow counts SAMPLES, not keys)
                 if count_overflow:
-                    self.overflow += 1
+                    self.drops.add(1)
                 return None
             row = len(self.meta)
             self.rows[sample_key] = row
@@ -402,6 +410,9 @@ class Snapshot:
     hll_host_ez: np.ndarray | None = None
     hll_host_inv: np.ndarray | None = None
     overflow: dict[str, int] = field(default_factory=dict)
+    # samples staged into this interval (the table's own count — the
+    # conservation ledger cross-checks it against site-credited totals)
+    ingested: int = 0
     # set by swap(): hands the host set plane back to the table's
     # reuse pool (see Snapshot.release)
     recycle: Any = None
@@ -572,8 +583,11 @@ class MetricTable:
             c.set_rows) + 1024
         # O(1) staged-sample counter (``staged()`` must be callable per
         # sample to drive threshold-triggered device steps without
-        # walking the staging lists)
+        # walking the staging lists); _interval_ingested is the
+        # whole-interval total, reset only at begin_swap, that the
+        # conservation ledger cross-checks against site-credited sums
         self._staged_n = 0
+        self._interval_ingested = 0
 
         # fused global merge staging: one part per decoded wire list
         # (rows, means, weights), stacked at apply time into one
@@ -674,7 +688,7 @@ class MetricTable:
                 return False
             self._counter_dense[row] += s.value * weight
             self._counter_dirty = True
-            self._staged_n += 1
+            self._note_staged(1)
         elif s.type == dsd.GAUGE:
             row = self.gauge_idx.lookup(key, s.name, s.tags, s.scope,
                                         s.type, self.gen)
@@ -683,14 +697,14 @@ class MetricTable:
             self._gauge_dense[row] = s.value
             self._gauge_mask[row] = 1
             self._gauge_dirty = True
-            self._staged_n += 1
+            self._note_staged(1)
         elif s.type in (dsd.TIMER, dsd.HISTOGRAM):
             row = self.histo_idx.lookup(key, s.name, s.tags, s.scope,
                                         s.type, self.gen)
             if row is None:
                 return False
             self._histo_stage.append([row], [s.value], [weight])
-            self._staged_n += 1
+            self._note_staged(1)
         elif s.type == dsd.SET:
             row = self.set_idx.lookup(key, s.name, s.tags, s.scope,
                                       s.type, self.gen)
@@ -700,7 +714,7 @@ class MetricTable:
             member = s.value if isinstance(s.value, bytes) else str(
                 s.value).encode()
             self._set_members.append(member)
-            self._staged_n += 1
+            self._note_staged(1)
         elif s.type == dsd.STATUS:
             self.status[key] = (float(s.value), s.message, s.tags)
         else:
@@ -776,8 +790,8 @@ class MetricTable:
         if dropped:
             # count overflow per class (reference drops-and-counts)
             for code in np.unique(tc[sel][~live]):
-                self._class_for_code(int(code)).overflow += int(
-                    ((tc[sel] == code) & ~live).sum())
+                self._class_for_code(int(code)).drops.add(int(
+                    ((tc[sel] == code) & ~live).sum()))
 
         codes = tc[sel]
         vals = pb.value[sel]
@@ -817,7 +831,7 @@ class MetricTable:
             self.set_idx.touch_rows(r, self.gen)
 
         processed = len(sel)
-        self._staged_n += processed - dropped
+        self._note_staged(processed - dropped)
         return processed, dropped
 
     def ingest_buffer(self, buf
@@ -930,10 +944,10 @@ class MetricTable:
         processed = int(meta[3])
         dropped = int(meta[6:11].sum())
         if dropped:
-            self.counter_idx.overflow += int(meta[6])
-            self.gauge_idx.overflow += int(meta[7])
-            self.histo_idx.overflow += int(meta[8] + meta[9])
-            self.set_idx.overflow += int(meta[10])
+            self.counter_idx.drops.add(int(meta[6]))
+            self.gauge_idx.drops.add(int(meta[7]))
+            self.histo_idx.drops.add(int(meta[8] + meta[9]))
+            self.set_idx.drops.add(int(meta[10]))
         if meta[4]:
             self._counter_dirty = True
         if meta[5]:
@@ -947,7 +961,7 @@ class MetricTable:
         if sn:
             self._set_pos_rows.append(sc["sr"][:sn].copy())
             self._set_pos.append(sc["sp"][:sn].copy())
-        self._staged_n += processed - dropped
+        self._note_staged(processed - dropped)
         n_other = int(meta[11])
         others = [(int(sc["oo"][i]), int(sc["ol"][i]),
                    int(sc["ok"][i])) for i in range(n_other)]
@@ -1023,10 +1037,10 @@ class MetricTable:
         processed = int(meta[3])
         dropped = int(meta[6:11].sum())
         if dropped:
-            self.counter_idx.overflow += int(meta[6])
-            self.gauge_idx.overflow += int(meta[7])
-            self.histo_idx.overflow += int(meta[8] + meta[9])
-            self.set_idx.overflow += int(meta[10])
+            self.counter_idx.drops.add(int(meta[6]))
+            self.gauge_idx.drops.add(int(meta[7]))
+            self.histo_idx.drops.add(int(meta[8] + meta[9]))
+            self.set_idx.drops.add(int(meta[10]))
         if meta[4]:
             self._counter_dirty = True
         if meta[5]:
@@ -1044,11 +1058,29 @@ class MetricTable:
             # now holds entries until the swap (see _histo_stage note)
             self._set_pos_rows.append(sr[:sn].copy())
             self._set_pos.append(sp[:sn].copy())
-        self._staged_n += processed - dropped
+        self._note_staged(processed - dropped)
         return processed, dropped
 
     def staged(self) -> int:
         return self._staged_n
+
+    def overflow_total(self) -> int:
+        """Interval overflow drops summed over classes.  Import call
+        sites delta this around an apply (under the ingest lock) to
+        split their dropped counts into overflow vs invalid for the
+        conservation ledger."""
+        return (self.counter_idx.overflow + self.gauge_idx.overflow +
+                self.histo_idx.overflow + self.set_idx.overflow)
+
+    def _note_staged(self, n: int) -> None:
+        """Staged-sample bookkeeping shared by every DSD ingest path:
+        the device-step trigger counter and the interval conservation
+        count move together so they can't diverge.  Import paths bump
+        ``_interval_ingested`` at ITEM granularity instead (their
+        staging parts — centroids, register planes — don't map 1:1 to
+        wire items)."""
+        self._staged_n += n
+        self._interval_ingested += n
 
     # ------------------------------------------------------------------
     # global-tier import (merge of forwarded mergeable state)
@@ -1089,6 +1121,7 @@ class MetricTable:
         self.counter_idx.touch_rows(rows, self.gen)
         self._counter_dirty = True
         self._staged_n += len(rows)
+        self._interval_ingested += len(rows)
 
     def import_gauge_batch(self, rows: np.ndarray,
                            values: np.ndarray) -> None:
@@ -1104,6 +1137,7 @@ class MetricTable:
         self.gauge_idx.touch_rows(rows, self.gen)
         self._gauge_dirty = True
         self._staged_n += len(rows)
+        self._interval_ingested += len(rows)
 
     def import_set_at(self, row: int, regs: np.ndarray) -> None:
         """import_set's staging half for a pre-resolved row: one
@@ -1123,6 +1157,7 @@ class MetricTable:
         self.set_idx.touched[row] = True
         self.set_idx.last_gen[row] = self.gen
         self._staged_n += 1
+        self._interval_ingested += 1
 
     def import_counter(self, name: str, tags: tuple[str, ...],
                        value: float) -> bool:
@@ -1137,6 +1172,7 @@ class MetricTable:
         self._counter_dense[row] += value
         self._counter_dirty = True
         self._staged_n += 1
+        self._interval_ingested += 1
         return True
 
     def import_gauge(self, name: str, tags: tuple[str, ...],
@@ -1150,6 +1186,7 @@ class MetricTable:
         self._gauge_mask[row] = 1
         self._gauge_dirty = True
         self._staged_n += 1
+        self._interval_ingested += 1
         return True
 
     def import_histo(self, name: str, mtype: str, tags: tuple[str, ...],
@@ -1180,6 +1217,7 @@ class MetricTable:
         self._stats_import_parts.append(
             (np.asarray([row], np.int32), stats[None, :]))
         self._staged_n += 1
+        self._interval_ingested += 1
         live = weights > 0
         if live.any():
             n_live = int(live.sum())
@@ -1220,6 +1258,7 @@ class MetricTable:
             self.histo_idx.touch_rows(np.asarray(rows, np.int64),
                                       self.gen)
             self._staged_n += len(rows)
+            self._interval_ingested += len(rows)
         if len(cent_rows):
             part = (np.ascontiguousarray(cent_rows, np.int32),
                     np.ascontiguousarray(cent_means, np.float32),
@@ -2124,6 +2163,11 @@ class MetricTable:
             "histo": self.histo_idx.overflow,
             "set": self.set_idx.overflow,
         }
+        # interval staged-sample count, captured with the overflow
+        # tallies inside the same critical section so the conservation
+        # ledger's cross-check sees a consistent boundary
+        pend.ingested = self._interval_ingested
+        self._interval_ingested = 0
         # the old planes belong to the outgoing state (and, soon, its
         # snapshot); the new interval ADOPTS the array references with
         # every kind marked fresh — new zeroed planes are allocated
@@ -2145,7 +2189,7 @@ class MetricTable:
         compacted = False
         for idx in (self.counter_idx, self.gauge_idx, self.histo_idx,
                     self.set_idx):
-            idx.overflow = 0
+            idx.drops.take()
             occ = idx.occupancy()
             if occ > idx.capacity * self.config.compact_threshold:
                 # compaction only pays when it frees meaningful
@@ -2228,6 +2272,7 @@ class MetricTable:
             hll_host_inv=st.hll_host_inv,
             recycle=self._recycle_plane,
             overflow=pend.overflow,
+            ingested=pend.ingested,
         )
 
     def take_status(self):
@@ -2403,10 +2448,10 @@ class ReaderShard:
         processed = int(meta[3])
         dropped = int(meta[6:11].sum())
         if dropped:
-            t.counter_idx.overflow += int(meta[6])
-            t.gauge_idx.overflow += int(meta[7])
-            t.histo_idx.overflow += int(meta[8] + meta[9])
-            t.set_idx.overflow += int(meta[10])
+            t.counter_idx.drops.add(int(meta[6]))
+            t.gauge_idx.drops.add(int(meta[7]))
+            t.histo_idx.drops.add(int(meta[8] + meta[9]))
+            t.set_idx.drops.add(int(meta[10]))
 
         cr = np.nonzero(self._c_touch)[0]
         if len(cr):
@@ -2434,7 +2479,7 @@ class ReaderShard:
             t._set_pos.append(sc["sp"][:sn].copy())
             sr_t = np.nonzero(self._s_touch)[0]
             t.set_idx.touched[sr_t] = True
-        t._staged_n += processed - dropped
+        t._note_staged(processed - dropped)
         n_other = int(meta[11])
         others = [(int(sc["oo"][i]), int(sc["ol"][i]),
                    int(sc["ok"][i])) for i in range(n_other)]

@@ -253,6 +253,47 @@ class Telemetry:
                 key = f"span_sink_{sname}_{attr}"
                 self.server.stats[key] = int(cur)
                 count(metric, self._delta(key), (f"sink:{sname}",))
+        # per-sink fan-out worker counters (sinks/fanout.py): a busy
+        # drop means this interval skipped a sink whose previous flush
+        # was still running; retries/timeouts price its flakiness
+        fanout = getattr(self.server, "_fanout", None)
+        if fanout is not None:
+            for sname, fs in fanout.stats().items():
+                for attr, metric in (
+                        ("busy_drops",
+                         "veneur.sink.flush_busy_drops_total"),
+                        ("retries",
+                         "veneur.sink.flush_retries_total"),
+                        ("timeouts",
+                         "veneur.sink.flush_timeouts_total"),
+                        ("errors",
+                         "veneur.sink.flush_errors_total")):
+                    key = f"fanout_{sname}_{attr}"
+                    self.server.stats[key] = int(fs.get(attr, 0))
+                    count(metric, self._delta(key),
+                          (f"sink:{sname}",))
+        # conservation-ledger verdict for the interval just sealed
+        # (the seal runs before this tick): per-reason drop counts and
+        # any imbalance, under the names documented in
+        # docs/observability.md
+        ledger = getattr(self.server, "ledger", None)
+        rec = ledger.last() if ledger is not None else None
+        if rec is not None:
+            count("veneur.ledger.received_total", rec.received_total())
+            count("veneur.ledger.staged_total", rec.staged)
+            count("veneur.ledger.dropped_total", rec.overflow,
+                  ("reason:overflow",))
+            count("veneur.ledger.dropped_total", rec.invalid,
+                  ("reason:invalid",))
+            count("veneur.ledger.parse_errors_total", rec.parse_errors)
+            count("veneur.ledger.emitted_rows_total", rec.emitted_rows)
+            count("veneur.ledger.forwarded_rows_total",
+                  rec.forwarded_rows)
+            count("veneur.ledger.owed_total",
+                  abs(rec.owed) + abs(rec.staged_drift)
+                  + abs(rec.overflow_drift) + abs(rec.rows_owed))
+            count("veneur.ledger.imbalance_total",
+                  self._delta("ledger_imbalance"))
 
         # import response timing (reference README:
         # veneur.import.response_duration_ns)
@@ -296,8 +337,17 @@ class Telemetry:
                                 self._addr, e)
             return
         # loopback: inject into our own table (next interval's flush
-        # carries them, like the reference's async statsd client)
+        # carries them, like the reference's async statsd client).
+        # These are table samples like any other, so they credit the
+        # conservation ledger — uncredited they'd show as staged_drift
         srv = self.server
         with srv.lock:
+            staged = dropped = 0
             for s in samples:
-                srv.table.ingest(s)
+                if srv.table.ingest(s):
+                    staged += 1
+                else:
+                    dropped += 1
+            srv.ledger.ingest("self-telemetry",
+                              processed=staged + dropped,
+                              staged=staged, overflow=dropped)

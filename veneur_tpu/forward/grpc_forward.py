@@ -41,6 +41,27 @@ log = logging.getLogger("veneur_tpu.grpc")
 
 _METHOD = "/forwardrpc.Forward/SendMetrics"
 
+# cross-tier flush trace propagation: the same (trace_id, span_id)
+# pair the HTTP wire carries in http_import.TRACE_HEADER rides gRPC
+# as invocation metadata (keys must be lowercase ASCII).  Old peers
+# ignore unknown metadata — fail-open by construction.
+TRACE_ID_KEY = "veneur-trace-id"
+SPAN_ID_KEY = "veneur-span-id"
+
+
+def decode_trace_metadata(metadata) -> tuple[int, int]:
+    """(trace_id, span_id) from invocation metadata; (0, 0) when
+    absent/malformed — a bad trace context never rejects an import."""
+    try:
+        md = {k: v for k, v in (metadata or ())}
+        tid = int(md.get(TRACE_ID_KEY, 0))
+        sid = int(md.get(SPAN_ID_KEY, 0))
+    except (TypeError, ValueError):
+        return 0, 0
+    if tid <= 0 or sid <= 0:
+        return 0, 0
+    return tid, sid
+
 _TYPE_TO_PB = {dsd.COUNTER: metric_pb2.Counter,
                dsd.GAUGE: metric_pb2.Gauge,
                dsd.HISTOGRAM: metric_pb2.Histogram,
@@ -393,7 +414,7 @@ def _resolve_rows(table: MetricTable, data: bytes, cols: dict,
         if hit is not None and hit[0] == epoch:
             rows, over_counts = hit[1], hit[2]
             for k, c in over_counts.items():
-                class_idx[k].overflow += c
+                class_idx[k].drops.add(c)
             return rows
     cache = table.import_row_cache
     khl = khash.tolist()
@@ -437,7 +458,7 @@ def _resolve_rows(table: MetricTable, data: bytes, cols: dict,
                     # uncached path (every overflowing import counts)
                     idx = class_idx.get(int(kind[i]))
                     if idx is not None:
-                        idx.overflow += 1
+                        idx.drops.add(1)
                 continue
         k = int(kind[i])
         row = None
@@ -728,24 +749,39 @@ class ImportServer:
 
     def _send_metrics(self, request, context):
         core = self._core
+        tid, sid = decode_trace_metadata(context.invocation_metadata())
+        ledger = getattr(core, "ledger", None)
         # decode outside the ingest lock: while another handler's
         # interval fold holds it (or _apply_staged runs the device
         # merge), this thread's wire decode proceeds in parallel —
         # cycle N+1 decode overlaps cycle N fold
         cols = decode_metric_list(request)
         with core.lock:
+            ov0 = core.table.overflow_total() if ledger else 0
             if cols is None:
                 acc, dropped = apply_metric_list(
                     core.table,
                     forward_pb2.MetricList.FromString(request))
             else:
                 acc, dropped = apply_decoded(core.table, request, cols)
+            if ledger is not None:
+                # the overflow delta splits this wire's drops into
+                # overflow (the table counted them) vs invalid
+                # (malformed/non-finite, dropped before the table)
+                ov = core.table.overflow_total() - ov0
+                ledger.ingest("grpc-import", processed=acc + dropped,
+                              staged=acc, overflow=ov,
+                              invalid=dropped - ov)
             work = core._maybe_device_step_locked()
         core._apply_staged(work)
         core.bump("imports_received", acc)
         core.bump("received_grpc", acc + dropped)
         if dropped:
             core.bump("metrics_dropped", dropped)
+        note = getattr(core, "note_import_span", None)
+        if note is not None and tid:
+            note("grpc", acc, dropped, tid, sid,
+                 nbytes=len(request))
         return empty_pb2.Empty()
 
     def _send_span(self, request, context):
@@ -803,10 +839,17 @@ class ForwardClient:
             request_serializer=forward_pb2.MetricList.SerializeToString,
             response_deserializer=empty_pb2.Empty.FromString)
 
-    def send(self, rows: list[ForwardRow]) -> None:
-        """Raises grpc.RpcError on failure (caller drops-and-counts)."""
+    def send(self, rows: list[ForwardRow],
+             trace_context: tuple[int, int] | None = None) -> None:
+        """Raises grpc.RpcError on failure (caller drops-and-counts).
+        ``trace_context`` = (trace_id, span_id) of the sending flush
+        cycle, stamped as invocation metadata when set."""
+        metadata = None
+        if trace_context and trace_context[0] and trace_context[1]:
+            metadata = [(TRACE_ID_KEY, str(trace_context[0])),
+                        (SPAN_ID_KEY, str(trace_context[1]))]
         self._call(rows_to_metric_list(rows, self._compression),
-                   timeout=self._timeout)
+                   timeout=self._timeout, metadata=metadata)
 
     def close(self) -> None:
         self._channel.close()

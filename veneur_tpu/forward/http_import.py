@@ -38,6 +38,38 @@ from veneur_tpu.core.table import MetricTable
 from veneur_tpu.protocol import dogstatsd as dsd
 
 
+# Cross-tier flush trace propagation: the local stamps its flush
+# cycle's (trace_id, span_id) onto the forward wire so the receiving
+# tier can parent its import span under the sender's forward span.
+# HTTP carries it as ONE header on the /import request (the body is
+# untouched, so old peers that don't know the header still parse —
+# fail-open); gRPC carries the same two values as invocation
+# metadata (grpc_forward.TRACE_METADATA_KEYS).
+TRACE_HEADER = "X-Veneur-Trace"
+
+
+def encode_trace_header(trace_id: int, span_id: int) -> str:
+    """``<trace_id>:<span_id>`` — both positive 63-bit decimal ints."""
+    return f"{int(trace_id)}:{int(span_id)}"
+
+
+def decode_trace_header(value: str | None) -> tuple[int, int]:
+    """Parse a trace header; (0, 0) on absent/malformed (fail-open:
+    a bad or missing header never rejects the import)."""
+    if not value:
+        return 0, 0
+    tid_s, sep, sid_s = value.partition(":")
+    if not sep:
+        return 0, 0
+    try:
+        tid, sid = int(tid_s), int(sid_s)
+    except ValueError:
+        return 0, 0
+    if tid <= 0 or sid <= 0:
+        return 0, 0
+    return tid, sid
+
+
 def _b64(arr: np.ndarray) -> str:
     return base64.b64encode(arr.tobytes()).decode()
 

@@ -19,15 +19,27 @@ The reference veneur traces its own flushes (flusher.go:29
     so flush spans flow to span sinks like any user trace.
 ``profiler``   — on-demand ``jax.profiler`` captures for
     ``/debug/pprof/device?seconds=N``.
+``ledger``     — per-interval sample-conservation ledger: every hot
+    path credits received/staged/dropped/emitted/forwarded counts and
+    the interval closes with balance checks, served at
+    ``/debug/ledger`` (strict mode: ``VENEUR_TPU_LEDGER_STRICT``).
+``traceindex`` — bounded per-process index of recent internal spans
+    keyed by trace id, served at ``/debug/trace/<trace_id>`` so one
+    interval's cross-tier span tree is queryable on every node.
 """
 
 from veneur_tpu.observe.devicecost import (DeviceCostRegistry, REGISTRY,
                                            instrument)
 from veneur_tpu.observe.flushring import FlushRecord, FlushRing
+from veneur_tpu.observe.ledger import (ClassDropTally, Ledger,
+                                       LedgerRecord)
 from veneur_tpu.observe.tracer import (FlushCycle, FlushTracer,
                                        NULL_CYCLE, NullCycle)
+from veneur_tpu.observe.traceindex import TraceIndex, span_to_dict
 from veneur_tpu.observe.profiler import capture_device_profile
 
 __all__ = ["DeviceCostRegistry", "REGISTRY", "instrument",
            "FlushRecord", "FlushRing", "FlushCycle", "FlushTracer",
-           "NullCycle", "NULL_CYCLE", "capture_device_profile"]
+           "NullCycle", "NULL_CYCLE", "capture_device_profile",
+           "ClassDropTally", "Ledger", "LedgerRecord",
+           "TraceIndex", "span_to_dict"]

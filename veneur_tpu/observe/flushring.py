@@ -30,6 +30,9 @@ class FlushRecord:
     tally: dict[str, int] = field(default_factory=dict)
     compiles: int = 0  # compile events observed during this cycle
     error: str = ""
+    # trace id of the cycle's span tree — the /debug/flushes ->
+    # /debug/trace/<id> link (string in JSON: ids are 63-bit)
+    trace_id: int = 0
 
     def to_dict(self) -> dict:
         return {"seq": self.seq, "start_unix": self.start_unix,
@@ -40,7 +43,8 @@ class FlushRecord:
                 "forward_rows": self.forward_rows,
                 "tally": dict(self.tally),
                 "compiles": self.compiles,
-                "error": self.error}
+                "error": self.error,
+                "trace_id": str(self.trace_id)}
 
 
 class FlushRing:

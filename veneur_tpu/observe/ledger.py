@@ -1,0 +1,319 @@
+"""Per-interval sample-conservation ledger.
+
+Every hot path credits the ledger at the points where it already
+bumps server stats — received samples per protocol, accepted
+(staged) samples, overflow drops, invalid drops, parse errors,
+service-check STATUS samples — and the flush side credits what left
+the process: emitted rows, forwarded rows + wire bytes, per-sink
+metric counts, fanout busy-drops/retries.  At ``begin_swap`` the
+interval closes (``Ledger.close_interval``) and at the end of the
+flush it seals (``Ledger.seal``) with the conservation checks:
+
+    received == staged + status + overflow + invalid        (ingest)
+    staged_rows == emitted + forwarded - overlap + retained  (rows)
+
+plus two *independent* cross-checks against the table's own interval
+counters — ``staged`` vs the table's staged-sample count and
+``overflow`` vs the table's per-class drop tallies — so a fast path
+that forgets to credit one side shows up as a drift, not silence.
+
+Locking discipline mirrors the reader shards: ``parse`` runs with NO
+ledger interaction; all credits happen at ``commit``/apply time,
+already under the server's ingest lock, as a handful of integer adds
+(the ledger's own lock only matters for out-of-band readers like
+``/debug/ledger``).  Sealed records live in a bounded ring (last 128
+intervals) served at ``/debug/ledger``; ``summary()`` is what
+bench.py stamps into soak/chain artifacts.
+
+``strict=True`` (``VENEUR_TPU_LEDGER_STRICT=1``) turns any imbalance
+into a logged error + an ``on_imbalance`` callback (the server bumps
+``ledger_imbalance`` / ``veneur.ledger.imbalance_total``).
+
+``ClassDropTally`` is the centralized drop counter the table's
+per-class indexes use for overflow accounting (previously ad-hoc
+``idx.overflow += n`` at every fast-path call site) — one mutation
+API, so /debug/vars, snapshots, and the ledger all read one number.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+log = logging.getLogger("veneur_tpu.ledger")
+
+DEFAULT_CAPACITY = 128
+
+
+class ClassDropTally:
+    """Centralized per-class overflow-drop counter (counts SAMPLES,
+    not keys).  All fast-path drop sites go through ``add`` so the
+    count can't silently diverge from what snapshots and the ledger
+    read via ``count``/``take``."""
+
+    __slots__ = ("count",)
+
+    def __init__(self) -> None:
+        self.count = 0
+
+    def add(self, n: int = 1) -> None:
+        self.count += int(n)
+
+    def take(self) -> int:
+        """Read-and-reset (interval close; caller holds the ingest
+        lock, same as the bump sites)."""
+        n = self.count
+        self.count = 0
+        return n
+
+
+@dataclass
+class LedgerRecord:
+    """One interval's conservation account."""
+
+    seq: int = 0
+    start_unix: float = 0.0
+    trace_id: int = 0
+    # -- ingest side (credited per protocol at the stats-bump sites) --
+    received: dict[str, int] = field(default_factory=dict)
+    staged: int = 0          # accepted samples (site-credited)
+    status: int = 0          # service-check STATUS samples (never stage)
+    overflow: int = 0        # row-table overflow drops (site-credited)
+    invalid: int = 0         # malformed/non-finite drops at import sites
+    parse_errors: int = 0    # line/packet-level errors (pre-sample)
+    # -- independent table-side counters captured at begin_swap --------
+    table_staged: int | None = None
+    table_overflow: dict[str, int] = field(default_factory=dict)
+    # -- flush side (row granularity, from the flusher's routing) ------
+    staged_rows: int = 0
+    emitted_rows: int = 0
+    forwarded_rows: int = 0
+    overlap_rows: int = 0    # rows that both emit locally AND forward
+    retained_rows: int = 0   # rows that did neither (scope-gated out)
+    emitted_per_sink: dict[str, int] = field(default_factory=dict)
+    # -- wire outcomes (async; informational, not balance inputs) ------
+    forward_wire_rows: int = 0
+    forward_wire_bytes: int = 0
+    forward_errors: int = 0
+    fanout_busy_drops: int = 0
+    fanout_retries: int = 0
+    fanout_timeouts: int = 0
+    # -- verdict (filled by seal) --------------------------------------
+    sealed: bool = False
+    balanced: bool = True
+    owed: int = 0            # ingest samples unaccounted for
+    staged_drift: int = 0    # site-credited staged - table staged
+    overflow_drift: int = 0  # site-credited overflow - table overflow
+    rows_owed: int = 0       # staged rows unaccounted for at flush
+
+    def received_total(self) -> int:
+        return sum(self.received.values())
+
+    def dropped_total(self) -> int:
+        return self.overflow + self.invalid
+
+    def to_dict(self) -> dict:
+        return {
+            "seq": self.seq,
+            "start_unix": self.start_unix,
+            "trace_id": str(self.trace_id),
+            "received": dict(self.received),
+            "received_total": self.received_total(),
+            "staged": self.staged,
+            "status": self.status,
+            "dropped": {"overflow": self.overflow,
+                        "invalid": self.invalid,
+                        "total": self.dropped_total()},
+            "parse_errors": self.parse_errors,
+            "table": {"staged": self.table_staged,
+                      "overflow": dict(self.table_overflow)},
+            "rows": {"staged": self.staged_rows,
+                     "emitted": self.emitted_rows,
+                     "forwarded": self.forwarded_rows,
+                     "overlap": self.overlap_rows,
+                     "retained": self.retained_rows},
+            "emitted_per_sink": dict(self.emitted_per_sink),
+            "forward_wire": {"rows": self.forward_wire_rows,
+                             "bytes": self.forward_wire_bytes,
+                             "errors": self.forward_errors},
+            "fanout": {"busy_drops": self.fanout_busy_drops,
+                       "retries": self.fanout_retries,
+                       "timeouts": self.fanout_timeouts},
+            "balanced": self.balanced,
+            "owed": self.owed,
+            "staged_drift": self.staged_drift,
+            "overflow_drift": self.overflow_drift,
+            "rows_owed": self.rows_owed,
+        }
+
+
+class Ledger:
+    """Interval accumulator + bounded ring of sealed records.
+
+    Credit methods are a few integer adds under a lock; the server
+    calls them at the same points (and under the same ingest lock) as
+    its existing stats bumps, so per-sample cost is zero — crediting
+    is per *batch*, with counts the call sites already computed.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 strict: bool = False, node: str = "veneur",
+                 on_imbalance=None):
+        self.strict = strict
+        self.node = node
+        self.on_imbalance = on_imbalance
+        self._lock = threading.Lock()
+        self._ring: deque[LedgerRecord] = deque(maxlen=capacity)
+        self._cur = LedgerRecord(start_unix=time.time())
+        self.imbalanced_total = 0
+
+    # -- ingest-side crediting (call under the server's ingest lock) ---
+    def ingest(self, protocol: str, processed: int = 0, staged: int = 0,
+               overflow: int = 0, invalid: int = 0,
+               parse_errors: int = 0, status: int = 0) -> None:
+        """Credit one batch: ``processed`` samples presented on
+        ``protocol``, of which ``staged`` were accepted, ``overflow``
+        dropped on row-table overflow, ``invalid`` dropped for
+        malformed/non-finite values, and ``status`` were service-check
+        STATUS samples (accepted but never staged)."""
+        with self._lock:
+            cur = self._cur
+            if processed:
+                cur.received[protocol] = (
+                    cur.received.get(protocol, 0) + int(processed))
+            cur.staged += int(staged)
+            cur.overflow += int(overflow)
+            cur.invalid += int(invalid)
+            cur.parse_errors += int(parse_errors)
+            cur.status += int(status)
+
+    # -- interval close (under the ingest lock, same critical section
+    #    as the table's begin_swap so credits and table counters agree)
+    def close_interval(self, seq: int = 0, trace_id: int = 0,
+                       table_staged: int | None = None,
+                       table_overflow: dict[str, int] | None = None
+                       ) -> LedgerRecord:
+        with self._lock:
+            rec = self._cur
+            self._cur = LedgerRecord(start_unix=time.time())
+            rec.seq = int(seq)
+            rec.trace_id = int(trace_id)
+            rec.table_staged = table_staged
+            if table_overflow:
+                rec.table_overflow = dict(table_overflow)
+            return rec
+
+    # -- flush-side crediting (synchronous inputs to the row balance) --
+    def credit_rows(self, rec: LedgerRecord, accounting: dict) -> None:
+        with self._lock:
+            rec.staged_rows += int(accounting.get("staged_rows", 0))
+            rec.emitted_rows += int(accounting.get("emitted_rows", 0))
+            rec.forwarded_rows += int(
+                accounting.get("forwarded_rows", 0))
+            rec.overlap_rows += int(accounting.get("overlap_rows", 0))
+            rec.retained_rows += int(
+                accounting.get("retained_rows", 0))
+
+    def credit_sink(self, rec: LedgerRecord, name: str,
+                    metrics: int) -> None:
+        with self._lock:
+            rec.emitted_per_sink[name] = (
+                rec.emitted_per_sink.get(name, 0) + int(metrics))
+
+    # -- wire outcomes (may land after seal; informational) ------------
+    def credit_forward_wire(self, rec: LedgerRecord, rows: int = 0,
+                            nbytes: int = 0, errors: int = 0) -> None:
+        with self._lock:
+            rec.forward_wire_rows += int(rows)
+            rec.forward_wire_bytes += int(nbytes)
+            rec.forward_errors += int(errors)
+
+    def credit_fanout(self, rec: LedgerRecord, busy_drops: int = 0,
+                      retries: int = 0, timeouts: int = 0) -> None:
+        with self._lock:
+            rec.fanout_busy_drops += int(busy_drops)
+            rec.fanout_retries += int(retries)
+            rec.fanout_timeouts += int(timeouts)
+
+    # -- seal ----------------------------------------------------------
+    def seal(self, rec: LedgerRecord) -> LedgerRecord:
+        """Run the balance checks, append to the ring, and (strict
+        mode) escalate any imbalance to an error + counter."""
+        with self._lock:
+            rec.owed = rec.received_total() - (
+                rec.staged + rec.status + rec.overflow + rec.invalid)
+            if rec.table_staged is not None:
+                rec.staged_drift = rec.staged - rec.table_staged
+            if rec.table_overflow:
+                rec.overflow_drift = rec.overflow - sum(
+                    rec.table_overflow.values())
+            rec.rows_owed = rec.staged_rows - (
+                rec.emitted_rows + rec.forwarded_rows
+                - rec.overlap_rows + rec.retained_rows)
+            rec.balanced = (rec.owed == 0 and rec.staged_drift == 0
+                            and rec.overflow_drift == 0
+                            and rec.rows_owed == 0)
+            rec.sealed = True
+            self._ring.append(rec)
+            if not rec.balanced:
+                self.imbalanced_total += 1
+        if not rec.balanced:
+            msg = ("ledger imbalance node=%s seq=%d: owed=%d samples "
+                   "(received=%d staged=%d status=%d overflow=%d "
+                   "invalid=%d) staged_drift=%d overflow_drift=%d "
+                   "rows_owed=%d")
+            args = (self.node, rec.seq, rec.owed, rec.received_total(),
+                    rec.staged, rec.status, rec.overflow, rec.invalid,
+                    rec.staged_drift, rec.overflow_drift, rec.rows_owed)
+            if self.strict:
+                log.error(msg, *args)
+            else:
+                log.warning(msg, *args)
+            if self.on_imbalance is not None:
+                self.on_imbalance(rec)
+        return rec
+
+    # -- readers -------------------------------------------------------
+    def records(self) -> list[LedgerRecord]:
+        """Sealed records, oldest -> newest."""
+        with self._lock:
+            return list(self._ring)
+
+    def last(self) -> LedgerRecord | None:
+        with self._lock:
+            return self._ring[-1] if self._ring else None
+
+    def to_json(self) -> bytes:
+        recs = self.records()
+        out = {
+            "node": self.node,
+            "strict": self.strict,
+            "intervals": len(recs),
+            "imbalanced": [r.seq for r in recs if not r.balanced],
+            "records": [r.to_dict() for r in recs],
+        }
+        return json.dumps(out, indent=1).encode()
+
+    def summary(self) -> dict:
+        """Aggregate over the retained ring — what bench.py stamps
+        into soak/chain artifacts as the conservation proof."""
+        recs = self.records()
+        out = {
+            "intervals": len(recs),
+            "balanced": sum(1 for r in recs if r.balanced),
+            "imbalanced": sum(1 for r in recs if not r.balanced),
+            "owed_total": sum(abs(r.owed) for r in recs),
+            "received_total": sum(r.received_total() for r in recs),
+            "staged_total": sum(r.staged for r in recs),
+            "dropped_total": sum(r.dropped_total() for r in recs),
+            "emitted_rows_total": sum(r.emitted_rows for r in recs),
+            "forwarded_rows_total": sum(
+                r.forwarded_rows for r in recs),
+            "retained_rows_total": sum(
+                r.retained_rows for r in recs),
+        }
+        return out

@@ -42,6 +42,9 @@ from veneur_tpu.observe.flushring import FlushRecord, FlushRing
 
 
 class _NullSpan:
+    trace_id = 0
+    span_id = 0
+
     def add_tag(self, key, value):
         pass
 
@@ -58,17 +61,30 @@ class NullCycle:
     def add_readback(self, nbytes: int) -> None:
         REGISTRY.add_readback(nbytes)
 
+    def wire_context(self, span=None) -> tuple[int, int]:
+        return 0, 0
+
 
 NULL_CYCLE = NullCycle()
 
 
 class FlushCycle:
-    def __init__(self, root, client, record: FlushRecord, registry):
+    def __init__(self, root, client, record: FlushRecord, registry,
+                 index=None):
         self.root = root
         self._client = client
         self.record = record
         self._registry = registry
+        self._index = index
         self._lock = threading.Lock()
+
+    def wire_context(self, span=None) -> tuple[int, int]:
+        """(trace_id, span_id) to stamp onto a forward wire so the
+        receiving tier can parent its import span under ours.  Pass
+        the stage span actually doing the shipping (e.g. the
+        ``forward`` child) to parent under it instead of the root."""
+        sp = span if span is not None else self.root
+        return sp.trace_id, sp.span_id
 
     @contextlib.contextmanager
     def stage(self, name: str, alias: str | None = None):
@@ -95,6 +111,8 @@ class FlushCycle:
                     self.record.stages[alias] = (
                         self.record.stages.get(alias, 0) + dt)
             sp.finish(self._client)
+            if self._index is not None:
+                self._index.add(sp.proto)
 
     def add_readback(self, nbytes: int) -> None:
         self._registry.add_readback(nbytes)
@@ -104,11 +122,12 @@ class FlushCycle:
 
 class FlushTracer:
     def __init__(self, client, ring: FlushRing, registry=None,
-                 service: str = "veneur"):
+                 service: str = "veneur", index=None):
         self.client = client
         self.ring = ring
         self.registry = registry or REGISTRY
         self.service = service
+        self.index = index
 
     @contextlib.contextmanager
     def cycle(self):
@@ -120,7 +139,9 @@ class FlushTracer:
         # sinks/ssfmetrics.py) — they still reach every span sink
         root = Span("flush", service=self.service,
                     tags={"veneur.internal": "true"})
-        cyc = FlushCycle(root, self.client, record, self.registry)
+        record.trace_id = root.trace_id
+        cyc = FlushCycle(root, self.client, record, self.registry,
+                         index=self.index)
         compiles0 = self.registry.totals()["compile_total"]
         t0 = time.monotonic_ns()
         try:
@@ -135,4 +156,6 @@ class FlushTracer:
                                - compiles0)
             root.add_tag("flush.seq", str(record.seq))
             root.finish(self.client)
+            if self.index is not None:
+                self.index.add(root.proto)
             self.ring.append(record)

@@ -642,6 +642,10 @@ class ShardedTable:
             2 * self.cfg.c_rows() + self.cfg.rows +
             self.cfg.set_rows) + 1024
         self._staged_n = 0
+        # interval conservation count at ITEM granularity, matching
+        # the single-chip table's (table.py _note_staged): the ledger
+        # cross-checks it against site-credited staged totals
+        self._interval_ingested = 0
         self._rr = 0  # round-robin shard cursor
 
     # -- ingest (the slow-path Sample surface the Server uses) --------
@@ -695,6 +699,7 @@ class ShardedTable:
         else:
             raise ValueError(f"unknown metric type {s.type}")
         self._staged_n += 1
+        self._interval_ingested += 1
         return True
 
     def ingest_many(self, samples) -> int:
@@ -761,6 +766,7 @@ class ShardedTable:
                        counter_wts=np.ones(len(rows), np.float32))
         self.counter_idx.touch_rows(rows, self.gen)
         self._staged_n += len(rows)
+        self._interval_ingested += len(rows)
 
     def import_gauge_batch(self, rows, values) -> None:
         # one ticket per write preserves last-write-wins in wire
@@ -774,6 +780,7 @@ class ShardedTable:
                            gauge_ticket=self.agg.next_ticket())
         self.gauge_idx.touch_rows(rows, self.gen)
         self._staged_n += len(rows)
+        self._interval_ingested += len(rows)
 
     def import_set_at(self, row, regs) -> None:
         regs = np.asarray(regs, np.uint8)
@@ -789,6 +796,7 @@ class ShardedTable:
         self.set_idx.touched[row] = True
         self.set_idx.last_gen[row] = self.gen
         self._staged_n += max(1, len(nz))
+        self._interval_ingested += 1
 
     def import_counter(self, name, tags, value) -> bool:
         from veneur_tpu.protocol import dogstatsd as dsd
@@ -800,6 +808,7 @@ class ShardedTable:
         self.agg.stage(self._next_shard(), counter_rows=[row],
                        counter_vals=[value], counter_wts=[1.0])
         self._staged_n += 1
+        self._interval_ingested += 1
         return True
 
     def import_gauge(self, name, tags, value) -> bool:
@@ -813,6 +822,7 @@ class ShardedTable:
                        gauge_vals=[value],
                        gauge_ticket=self.agg.next_ticket())
         self._staged_n += 1
+        self._interval_ingested += 1
         return True
 
     def import_histo_row(self, name, mtype, tags, scope=None):
@@ -878,6 +888,7 @@ class ShardedTable:
         # that triggers device_step rides on this counter (table.py:694)
         self._staged_n += (n_live + (2 if w > 0 else 0) +
                            (1 if corr else 0))
+        self._interval_ingested += 1
         return True
 
     def import_histo_batch(self, rows, stats, cent_rows, cent_means,
@@ -935,6 +946,7 @@ class ShardedTable:
         # so flush emission sees the series
         self.histo_idx.touch_rows(rows, self.gen)
         self._staged_n += n_staged
+        self._interval_ingested += len(rows)
 
     def import_set(self, name, tags, regs, scope=None) -> bool:
         """Forwarded HLL plane: registers convert to (idx, rank)
@@ -956,12 +968,21 @@ class ShardedTable:
                            set_idx=nz.astype(_np.int32),
                            set_rank=regs[nz].astype(_np.int32))
         self._staged_n += max(1, len(nz))
+        self._interval_ingested += 1
         return True
 
     # -- lifecycle -----------------------------------------------------
 
     def staged(self) -> int:
         return self._staged_n
+
+    def overflow_total(self) -> int:
+        """Interval overflow drops summed over classes — same surface
+        as the single-chip table's (table.py): import call sites delta
+        this around an apply to split dropped counts into overflow vs
+        invalid for the conservation ledger."""
+        return (self.counter_idx.overflow + self.gauge_idx.overflow +
+                self.histo_idx.overflow + self.set_idx.overflow)
 
     def device_step(self, final: bool = False) -> None:
         if final or self._staged_n >= self.cfg.batch:
@@ -1010,7 +1031,9 @@ class ShardedTable:
                 "gauge": self.gauge_idx.overflow,
                 "histo": self.histo_idx.overflow,
                 "set": self.set_idx.overflow,
-            })
+            },
+            ingested=self._interval_ingested)
+        self._interval_ingested = 0
         self.gen += 1
         for idx in (self.counter_idx, self.gauge_idx, self.histo_idx,
                     self.set_idx):
