@@ -1950,6 +1950,144 @@ def chain_bench() -> dict:
     return out
 
 
+def proxy_chain_bench() -> dict:
+    """``--proxy-chain`` (also runs under ``--chain``): the proxy hop
+    of the local->proxy->global chain at 100k+ series, columnar route
+    path vs the per-item oracle.  Wires are real serialized
+    MetricLists (what a local's gRPC forward produces); sends are
+    stubbed so the capture isolates the routing hop itself: decode ->
+    key hash -> ring assignment -> per-destination re-encode ->
+    worker handoff.  Headline: routed items/sec (median of warm
+    passes) and the columnar-vs-oracle speedup, which is
+    platform-relative by construction (both paths run on the same
+    host in the same process)."""
+    from veneur_tpu.core.config import ProxyConfig
+    from veneur_tpu.core.proxy import ProxyServer
+    from veneur_tpu.forward import route as routemod
+    from veneur_tpu.forward.gen import forward_pb2
+    from veneur_tpu.forward.grpc_forward import decode_metric_list
+
+    n_series = 20_000 if QUICK else 120_000
+    wire_items = 10_000
+    n_dests = 8
+    passes = 3 if QUICK else 5          # first pass of each = warmup
+    oracle_passes = 2 if QUICK else 3
+    out: dict = {"mode": "proxy_chain", "quick": QUICK,
+                 "series": n_series, "destinations": n_dests,
+                 "wire_items": wire_items}
+
+    # -- build the forward wires once (setup, untimed) -----------------
+    wires: list[bytes] = []
+    ml = forward_pb2.MetricList()
+    for i in range(n_series):
+        m = ml.metrics.add()
+        m.name = f"chain.m.{i}"
+        m.type = i % 5
+        m.tags.append(f"host:h{i % 64}")
+        m.tags.append(f"az:z{i % 4}")
+        if i % 5 == 0:
+            m.counter.value = i
+        if len(ml.metrics) == wire_items:
+            wires.append(ml.SerializeToString())
+            ml = forward_pb2.MetricList()
+    if len(ml.metrics):
+        wires.append(ml.SerializeToString())
+
+    dests = ",".join(f"10.255.0.{i}:8128" for i in range(n_dests))
+
+    def _proxy(columnar: bool) -> ProxyServer:
+        p = ProxyServer(ProxyConfig(
+            grpc_forward_address=dests, tpu_columnar_proxy=columnar))
+        p._send_grpc_wire = lambda dest, body, metadata=None: None
+        p._send_grpc = lambda dest, batch, trace_ctx=None: None
+        return p
+
+    def _drain(p: ProxyServer, expect: int, timeout=60.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            t = p.destpool.totals()
+            settled = (t["sent_items"] + t["error_items"] +
+                       t["busy_dropped_items"])
+            if settled >= expect and all(
+                    s["queued"] == 0
+                    for s in p.destpool.stats().values()):
+                return
+            time.sleep(0.005)
+
+    # -- columnar passes ----------------------------------------------
+    p = _proxy(True)
+    col_times = []
+    try:
+        for _ in range(passes):
+            t0 = time.perf_counter()
+            for w in wires:
+                p.route_pb_wire(w)
+            col_times.append(time.perf_counter() - t0)
+            _drain(p, p.stats["metrics_routed"])
+            p.ledger.roll()
+        assert p.stats.get("columnar_fallbacks", 0) == 0, \
+            "columnar path fell back to the oracle mid-bench"
+        out["ledger"] = p.ledger.summary()
+        out["destpool"] = p.destpool.totals()
+    finally:
+        p.shutdown()
+    warm = sorted(col_times[1:])
+    col_s = warm[len(warm) // 2]
+
+    # -- per-item oracle passes ---------------------------------------
+    p = _proxy(False)
+    oracle_times = []
+    try:
+        for _ in range(oracle_passes):
+            t0 = time.perf_counter()
+            for w in wires:
+                p.route_pb_wire(w)
+            oracle_times.append(time.perf_counter() - t0)
+        p._pool.shutdown(wait=True)
+    finally:
+        p.shutdown()
+    warm_o = sorted(oracle_times[1:]) or oracle_times
+    oracle_s = warm_o[len(warm_o) // 2]
+
+    # -- per-phase timings on one wire set (columnar internals) -------
+    from veneur_tpu.forward.ring import ConsistentRing
+    ring = ConsistentRing(dests.split(","))
+    phases = {"decode_s": 0.0, "keyhash_s": 0.0, "assign_s": 0.0,
+              "group_encode_s": 0.0}
+    for w in wires:
+        t0 = time.perf_counter()
+        cols = decode_metric_list(w)
+        t1 = time.perf_counter()
+        hashes = routemod.proxy_key_hashes(w, cols)
+        t2 = time.perf_counter()
+        ring.assign(hashes)
+        t3 = time.perf_counter()
+        routemod.route_metric_list(w, ring)
+        t4 = time.perf_counter()
+        phases["decode_s"] += t1 - t0
+        phases["keyhash_s"] += t2 - t1
+        phases["assign_s"] += t3 - t2
+        # route_metric_list redoes decode+hash+assign; isolate the
+        # group/re-encode share by subtraction
+        phases["group_encode_s"] += max(
+            0.0, (t4 - t3) - (t3 - t0))
+    out["phases"] = {k: round(v, 4) for k, v in phases.items()}
+
+    out.update({
+        "passes": passes,
+        "oracle_passes": oracle_passes,
+        "pass_seconds": [round(t, 4) for t in col_times],
+        "oracle_pass_seconds": [round(t, 4) for t in oracle_times],
+        "routed_items_per_sec": round(n_series / col_s, 1),
+        "oracle_items_per_sec": round(n_series / oracle_s, 1),
+        "speedup_vs_oracle": round(oracle_s / col_s, 2),
+    })
+    out.update(_backend_info())
+    out["captured_unix"] = round(time.time(), 1)
+    _save_artifact("proxy_chain", out)
+    return out
+
+
 CONFIGS = (
     ("0_counters_1k_names", bench_counters),
     ("1_cardinality_100k", bench_cardinality),
@@ -2210,8 +2348,13 @@ if __name__ == "__main__":
         print(json.dumps(soak_bench()))
     elif "--pallas-parity" in sys.argv:
         print(json.dumps(pallas_parity()))
+    elif "--proxy-chain" in sys.argv:
+        print(json.dumps(proxy_chain_bench()))
     elif "--chain" in sys.argv:
-        print(json.dumps(chain_bench()))
+        out = chain_bench()
+        # the proxy hop of the same chain, isolated at 100k+ series
+        out["proxy_chain"] = proxy_chain_bench()
+        print(json.dumps(out))
     elif "--global-merge" in sys.argv:
         print(json.dumps(global_merge_import()))
     elif "--config" in sys.argv:

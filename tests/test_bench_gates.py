@@ -238,6 +238,35 @@ def test_chain_bench_artifact_committed():
     assert "platform" in d and "gates" in d
 
 
+def test_proxy_chain_artifact_committed():
+    """bench.py --proxy-chain: the proxy hop at 100k+ series.  The
+    committed artifact must show the columnar route path >=5x the
+    per-item oracle (ISSUE acceptance bar — platform-relative: both
+    paths ran on the same host in the same process), a balanced
+    routing ledger (routed == enqueued + busy_dropped every
+    interval), and zero fail-open fallbacks during the capture."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench_results", "proxy_chain.json")
+    with open(path) as f:
+        d = json.load(f)
+    assert d["mode"] == "proxy_chain" and d["quick"] is False
+    assert d["series"] >= 100_000
+    assert d["speedup_vs_oracle"] >= 5.0
+    assert d["routed_items_per_sec"] > d["oracle_items_per_sec"]
+    led = d["ledger"]
+    assert led["imbalanced"] == 0
+    assert led["owed_total"] == 0
+    assert led["balanced"] == led["intervals"]
+    assert led["fallbacks_total"] == 0
+    # every routed item settled at a destination worker
+    assert (led["routed_total"] ==
+            led["enqueued_total"] + led["busy_dropped_total"])
+    assert {"decode_s", "keyhash_s", "assign_s",
+            "group_encode_s"} <= set(d["phases"])
+    assert "platform" in d and "gates" in d
+
+
 def test_flush_wide_cardinality_artifact_committed():
     """bench.py config 5: the columnar flush->emit pipeline at wide
     cardinality.  The committed artifact must cover >=100k touched

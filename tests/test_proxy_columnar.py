@@ -1,0 +1,506 @@
+"""Columnar proxy route path: bit-parity with the per-item oracle,
+per-destination isolation, and conservation accounting."""
+
+from __future__ import annotations
+
+import http.server
+import json
+import random
+import threading
+import time
+import zlib
+
+import pytest
+
+np = pytest.importorskip("numpy")
+
+from veneur_tpu.core.config import ProxyConfig
+from veneur_tpu.core.proxy import ProxyServer
+from veneur_tpu.forward import route as routemod
+from veneur_tpu.forward import ring as ringmod
+from veneur_tpu.forward.destpool import DestinationPool
+from veneur_tpu.forward.discovery import (DestinationRing,
+                                          StaticDiscoverer)
+from veneur_tpu.forward.gen import forward_pb2
+from veneur_tpu.forward.ring import ConsistentRing
+from veneur_tpu.observe.ledger import ProxyLedger
+
+
+def _random_metric_list(rng: random.Random, n: int,
+                        weird_types: bool = True):
+    ml = forward_pb2.MetricList()
+    for i in range(n):
+        m = ml.metrics.add()
+        m.name = rng.choice([
+            f"svc.req.{i}", f"a.b.{rng.randint(0, 99)}",
+            "x" * rng.randint(1, 300),  # >256B exercises long keys
+            f"unicode.é中.{i}"])
+        m.type = (rng.randint(0, 6) if weird_types
+                  else rng.randint(0, 4))
+        for j in range(rng.randint(0, 4)):
+            m.tags.append(f"k{j}:{rng.randint(0, 9)}")
+        if m.type == 0:
+            m.counter.value = i
+        elif m.type == 1:
+            m.gauge.value = float(i)
+    return ml
+
+
+def _oracle_dest(ring: ConsistentRing, m) -> str:
+    return ring.get(ProxyServer._pb_key(m))
+
+
+# ----------------------------------------------------------------------
+# fuzz parity: vectorized assignment == ConsistentRing.get
+
+
+def test_route_metric_list_fuzz_parity():
+    rng = random.Random(42)
+    for trial in range(12):
+        nmembers = rng.choice([1, 2, 3, 7, 16, 64])
+        members = [f"10.0.{trial}.{i}:8128" for i in range(nmembers)]
+        ring = ConsistentRing(members)
+        ml = _random_metric_list(rng, rng.randint(1, 200))
+        data = ml.SerializeToString()
+        routed = routemod.route_metric_list(data, ring)
+        assert routed is not None, "native route path unavailable"
+        assert routed.n == len(ml.metrics)
+        assert routed.routed == len(ml.metrics)
+        assert routed.dropped == 0
+        # reassemble: every record must land on the oracle's dest,
+        # byte-identical to the original metric, preserving order
+        seen = 0
+        for d, body, count in routed.batches:
+            dest = routed.members[d]
+            sub = forward_pb2.MetricList.FromString(body)
+            assert len(sub.metrics) == count
+            expect = [m for m in ml.metrics
+                      if _oracle_dest(ring, m) == dest]
+            assert list(sub.metrics) == expect
+            seen += count
+        assert seen == routed.n
+
+
+def test_hash_keys_matches_scalar_hash():
+    rng = random.Random(7)
+    keys = []
+    for i in range(100):
+        keys.append(("k" * rng.randint(1, 400) +
+                     f"|counter|{i}").encode())
+    out = ringmod.hash_keys(keys)
+    for i, k in enumerate(keys):
+        assert int(out[i]) == ringmod._h(k.decode()) & (2**64 - 1)
+
+
+def test_assign_matches_get_across_memberships():
+    rng = random.Random(3)
+    for nmembers in (1, 2, 5, 13, 33, 64):
+        ring = ConsistentRing(
+            [f"host{i}.example:{8000 + i}" for i in range(nmembers)])
+        keys = [f"metric.{i}|gauge|a:b,c:{i}" for i in range(500)]
+        assign = ring.assign(
+            ringmod.hash_keys([k.encode() for k in keys]))
+        for i, k in enumerate(keys):
+            assert ring.members[int(assign[i])] == ring.get(k)
+
+
+def test_epoch_transition_mid_batch():
+    """A batch routes against ONE membership snapshot even when the
+    ring refreshes mid-flight: assignments always agree with the
+    oracle evaluated on the same snapshot."""
+    disc = StaticDiscoverer([f"10.1.0.{i}:80" for i in range(4)])
+    dring = DestinationRing(disc, "static")
+    assert dring.refresh()
+    keys = [f"m.{i}|counter|" for i in range(300)]
+
+    snap1 = dring.snapshot()
+    assign1 = snap1.assign(
+        ringmod.hash_keys([k.encode() for k in keys]))
+    # membership changes under our feet
+    disc._destinations = [f"10.1.0.{i}:80" for i in range(2, 9)]
+    assert dring.refresh()
+    assert dring.epoch == 2
+    snap2 = dring.snapshot()
+    assert snap1.members != snap2.members
+    assign2 = snap2.assign(
+        ringmod.hash_keys([k.encode() for k in keys]))
+    for i, k in enumerate(keys):
+        # snap1 still answers for the in-flight batch, bit-identical
+        # to its own oracle; the new snapshot answers for the next
+        assert snap1.members[int(assign1[i])] == snap1.get(k)
+        assert snap2.members[int(assign2[i])] == snap2.get(k)
+
+
+def test_record_spans_matches_python_oracle():
+    rng = random.Random(11)
+    ml = _random_metric_list(rng, 64)
+    data = ml.SerializeToString()
+    spans = routemod.record_spans(data)
+    assert spans is not None
+    rec_off, rec_len = spans
+    expect = routemod.record_spans_py(data)
+    assert len(rec_off) == len(expect)
+    for i, (off, ln) in enumerate(expect):
+        assert (int(rec_off[i]), int(rec_len[i])) == (off, ln)
+
+
+# ----------------------------------------------------------------------
+# proxy-level parity: columnar vs legacy accounting
+
+
+def _capture_proxy(columnar: bool, dests: str):
+    cfg = ProxyConfig(grpc_forward_address=dests,
+                      tpu_columnar_proxy=columnar)
+    p = ProxyServer(cfg)
+    sent: dict[str, list] = {}
+    lock = threading.Lock()
+
+    def fake_wire(dest, body, metadata=None):
+        sub = forward_pb2.MetricList.FromString(body)
+        with lock:
+            sent.setdefault(dest, []).extend(sub.metrics)
+
+    def fake_batch(dest, batch, trace_ctx=None):
+        with lock:
+            sent.setdefault(dest, []).extend(batch)
+        p.bump("forwards_sent")
+
+    p._send_grpc_wire = fake_wire
+    p._send_grpc = fake_batch
+    return p, sent
+
+
+def _drain_destpool(p, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        stats = p.destpool.stats()
+        if all(s["queued"] == 0 for s in stats.values()):
+            time.sleep(0.05)
+            return
+        time.sleep(0.01)
+
+
+def test_proxy_wire_parity_with_legacy():
+    rng = random.Random(99)
+    dests = ",".join(f"10.9.0.{i}:8128" for i in range(5))
+    ml = _random_metric_list(rng, 400)
+    data = ml.SerializeToString()
+
+    pc, sent_c = _capture_proxy(True, dests)
+    pl, sent_l = _capture_proxy(False, dests)
+    try:
+        pc.route_pb_wire(data)
+        _drain_destpool(pc)
+        pl.route_pb_wire(data)
+        # legacy path routes through the shared executor
+        pl._pool.shutdown(wait=True)
+
+        assert set(sent_c) == set(sent_l)
+        for dest in sent_c:
+            assert ([(m.name, m.type, tuple(m.tags))
+                     for m in sent_c[dest]] ==
+                    [(m.name, m.type, tuple(m.tags))
+                     for m in sent_l[dest]])
+        # identical drop/route accounting on both paths
+        for key in ("metrics_routed", "metrics_dropped"):
+            assert pc.stats[key] == pl.stats[key], key
+    finally:
+        pc.shutdown()
+        pl.shutdown()
+
+
+def test_proxy_wire_empty_ring_drops_all():
+    # trace-only config: metric rings legally empty
+    cfg = ProxyConfig(forward_address="10.0.0.1:1",
+                      tpu_columnar_proxy=True)
+    p = ProxyServer(cfg)
+    try:
+        # force an empty ring (initial refresh succeeded; clear it)
+        p.ring.ring = ConsistentRing()
+        ml = _random_metric_list(random.Random(1), 25)
+        p.route_pb_wire(ml.SerializeToString())
+        assert p.stats["metrics_dropped"] == 25
+        assert p.stats["metrics_routed"] == 0
+        rec = p.ledger.roll()
+        assert rec.balanced and rec.dropped == 25
+    finally:
+        p.shutdown()
+
+
+def test_proxy_json_parity_with_legacy():
+    items = [{"name": f"m.{i}", "type": "counter",
+              "tags": [f"t:{i % 3}"], "value": i}
+             for i in range(200)]
+    dests = ",".join(f"10.8.0.{i}:8128" for i in range(4))
+
+    def capture(columnar):
+        cfg = ProxyConfig(forward_address=dests,
+                          tpu_columnar_proxy=columnar)
+        p = ProxyServer(cfg)
+        sent: dict[str, list] = {}
+        lock = threading.Lock()
+
+        def fake_post(dest, batch, trace_ctx=None):
+            with lock:
+                sent.setdefault(dest, []).extend(batch)
+
+        p._post_import = fake_post
+        p._send_http = lambda dest, batch, trace_ctx=None: \
+            fake_post(dest, batch, trace_ctx)
+        return p, sent
+
+    pc, sent_c = capture(True)
+    pl, sent_l = capture(False)
+    try:
+        pc.route_json_items(items)
+        _drain_destpool(pc)
+        pl.route_json_items(items)
+        pl._pool.shutdown(wait=True)
+        assert sent_c == sent_l
+        assert (pc.stats["metrics_routed"] ==
+                pl.stats["metrics_routed"] == 200)
+    finally:
+        pc.shutdown()
+        pl.shutdown()
+
+
+def test_proxy_trace_parity_with_legacy():
+    rng = random.Random(5)
+    spans = []
+    for i in range(150):
+        sp = {"trace_id": rng.randint(1, 2**63), "span_id": i,
+              "name": f"op.{i}"}
+        if i % 10 == 0:
+            sp.pop("trace_id")  # untraced: content-hash fallback
+        spans.append(sp)
+    dests = ",".join(f"10.7.0.{i}:8128" for i in range(3))
+
+    def capture(columnar):
+        cfg = ProxyConfig(trace_address=dests,
+                          tpu_columnar_proxy=columnar)
+        p = ProxyServer(cfg)
+        sent: dict[str, list] = {}
+        lock = threading.Lock()
+
+        def fake_post(dest, batch):
+            with lock:
+                sent.setdefault(dest, []).extend(batch)
+
+        p._post_spans = fake_post
+        p._send_traces = lambda dest, batch: fake_post(dest, batch)
+        return p, sent
+
+    pc, sent_c = capture(True)
+    pl, sent_l = capture(False)
+    try:
+        pc.route_traces(spans)
+        _drain_destpool(pc)
+        pl.route_traces(spans)
+        pl._pool.shutdown(wait=True)
+        assert sent_c == sent_l
+        assert (pc.stats["traces_routed"] ==
+                pl.stats["traces_routed"] == 150)
+        assert (pc.stats["untraced_spans_total"] ==
+                pl.stats["untraced_spans_total"] == 15)
+    finally:
+        pc.shutdown()
+        pl.shutdown()
+
+
+# ----------------------------------------------------------------------
+# destination isolation + conservation
+
+
+def test_stalled_destination_does_not_delay_healthy():
+    """A wedged destination stalls ONLY its own worker: healthy
+    destinations keep receiving, and the stalled one's overflow is a
+    counted busy-drop, not a routing delay."""
+    dests = "10.6.0.1:1,10.6.0.2:2"
+    cfg = ProxyConfig(grpc_forward_address=dests,
+                      tpu_columnar_proxy=True,
+                      tpu_proxy_dest_queue=1,
+                      tpu_proxy_send_retries=0)
+    p = ProxyServer(cfg)
+    stall = threading.Event()
+    healthy_sent = []
+
+    def fake_wire(dest, body, metadata=None):
+        if dest == "10.6.0.1:1":
+            stall.wait(10.0)
+        else:
+            healthy_sent.append(len(
+                forward_pb2.MetricList.FromString(body).metrics))
+
+    p._send_grpc_wire = fake_wire
+    try:
+        rng = random.Random(8)
+        # enough batches that both destinations see traffic each time
+        t0 = time.monotonic()
+        for _ in range(6):
+            ml = _random_metric_list(rng, 60, weird_types=False)
+            p.route_pb_wire(ml.SerializeToString())
+            # let the healthy worker drain its 1-slot queue between
+            # batches; the stalled one stays wedged throughout
+            time.sleep(0.02)
+        routing_elapsed = time.monotonic() - t0
+        # routing never blocked on the stalled worker
+        assert routing_elapsed < 2.0
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and len(healthy_sent) < 6:
+            time.sleep(0.01)
+        assert len(healthy_sent) == 6  # healthy dest got every batch
+        stats = p.destpool.stats()
+        assert stats["10.6.0.1:1"]["busy_drops"] >= 1
+        assert stats["10.6.0.2:2"]["busy_drops"] == 0
+        # conservation: routed == enqueued + busy_dropped
+        rec = p.ledger.roll()
+        assert rec.balanced, rec.to_dict()
+        assert rec.busy_dropped > 0
+        assert rec.routed == rec.enqueued + rec.busy_dropped
+    finally:
+        stall.set()
+        p.shutdown()
+
+
+def test_destpool_retry_and_accounting():
+    pool = DestinationPool(queue_size=2, retries=2, backoff=0.001)
+    calls = {"n": 0}
+    done = threading.Event()
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        done.set()
+
+    assert pool.submit("d1", flaky, n_items=10)
+    assert done.wait(5.0)
+    time.sleep(0.05)
+    s = pool.stats()["d1"]
+    assert s["sent_items"] == 10
+    assert s["retries"] == 2
+    assert s["errors"] == 0
+    pool.stop()
+
+
+def test_destpool_retire_stops_workers():
+    pool = DestinationPool(queue_size=2, retries=0)
+    pool.submit("a", lambda: None)
+    pool.submit("b", lambda: None)
+    time.sleep(0.05)
+    gone = pool.retire(keep={"b"})
+    assert gone == ["a"]
+    assert pool.destinations() == ["b"]
+    pool.stop()
+
+
+def test_proxy_ledger_balance_and_summary():
+    led = ProxyLedger()
+    led.credit_route(routed=100, dropped=5, enqueued=90,
+                     busy_dropped=10)
+    led.credit_send(sent_items=90)
+    rec = led.roll()
+    assert rec.balanced and rec.owed == 0
+    led.credit_route(routed=50, enqueued=40)  # lost 10: imbalance
+    rec2 = led.roll()
+    assert not rec2.balanced and rec2.owed == 10
+    s = led.summary()
+    assert s["intervals"] == 2
+    assert s["balanced"] == 1 and s["imbalanced"] == 1
+    assert s["owed_total"] == 10
+    assert s["routed_total"] == 150
+
+
+# ----------------------------------------------------------------------
+# eviction + connection reuse satellites
+
+
+def test_refresh_evicts_grpc_clients_workers_and_conns():
+    disc_dests = ["10.5.0.1:1", "10.5.0.2:2"]
+    cfg = ProxyConfig(forward_address="placeholder:0",
+                      tpu_columnar_proxy=True)
+    p = ProxyServer(cfg)
+    closed = []
+
+    class FakeClient:
+        def __init__(self, dest):
+            self.dest = dest
+
+        def close(self):
+            closed.append(self.dest)
+
+    try:
+        # point discovery at a mutable static list
+        p.ring.discoverer = StaticDiscoverer(disc_dests)
+        assert p.ring.refresh()
+        p._clients = {d: FakeClient(d) for d in disc_dests}
+        p.destpool.submit("10.5.0.1:1", lambda: None)
+        p.destpool.submit("10.5.0.2:2", lambda: None)
+        p._http_conns = {d: [None, threading.Lock()]
+                         for d in disc_dests}
+        # second dest leaves the fleet
+        p.ring.discoverer = StaticDiscoverer(["10.5.0.1:1"])
+        p._refresh_once()
+        assert closed == ["10.5.0.2:2"]
+        assert "10.5.0.2:2" not in p._clients
+        assert p.destpool.destinations() == ["10.5.0.1:1"]
+        assert list(p._http_conns) == ["10.5.0.1:1"]
+    finally:
+        p.shutdown()
+
+
+class _CountingImportHandler(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    connections = 0
+    requests = 0
+    lock = threading.Lock()
+
+    def log_message(self, *a):
+        pass
+
+    def setup(self):
+        super().setup()
+        with _CountingImportHandler.lock:
+            _CountingImportHandler.connections += 1
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        json.loads(zlib.decompress(body))
+        with _CountingImportHandler.lock:
+            _CountingImportHandler.requests += 1
+        out = b"{}"
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+
+def test_http_connection_reuse_per_destination():
+    _CountingImportHandler.connections = 0
+    _CountingImportHandler.requests = 0
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                          _CountingImportHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    dest = f"127.0.0.1:{srv.server_port}"
+    cfg = ProxyConfig(forward_address=dest, tpu_columnar_proxy=True)
+    p = ProxyServer(cfg)
+    try:
+        items = [{"name": "m", "type": "counter", "tags": [],
+                  "value": 1}]
+        for _ in range(5):
+            p.route_json_items(items)
+            _drain_destpool(p)
+        deadline = time.monotonic() + 5.0
+        while (time.monotonic() < deadline and
+               _CountingImportHandler.requests < 5):
+            time.sleep(0.01)
+        assert _CountingImportHandler.requests == 5
+        # one persistent connection carried all five flushes
+        assert _CountingImportHandler.connections == 1
+        assert p.stats["forwards_sent"] == 5
+    finally:
+        p.shutdown()
+        srv.shutdown()

@@ -514,8 +514,9 @@ class ProxyConfig:
     # cadence of the proxy's periodic runtime stats (proxy.go:210)
     runtime_metrics_interval: str = "10s"
     # Go http.Transport pool tuning: parsed for compat, documented
-    # no-ops (forward connections here are per-request HTTP and
-    # persistent gRPC channels, not a pooled Go transport)
+    # no-ops (forward connections here are one persistent HTTP
+    # connection per destination and persistent gRPC channels, not a
+    # pooled Go transport)
     idle_connection_timeout: str = ""
     max_idle_conns: int = 0
     max_idle_conns_per_host: int = 0
@@ -526,6 +527,18 @@ class ProxyConfig:
     # forward_grpc_tls_ca)
     forward_grpc_tls: bool = False
     forward_grpc_tls_ca: str = ""
+    # columnar route path: native batched decode + vectorized
+    # consistent-hash assignment + per-destination worker pool
+    # (VENEUR_TPU_COLUMNAR_PROXY=0 falls back to the per-item legacy
+    # loop, which stays as the bit-parity oracle)
+    tpu_columnar_proxy: bool = True
+    # per-destination worker pool knobs (VENEUR_TPU_PROXY_DEST_QUEUE /
+    # VENEUR_TPU_PROXY_SEND_RETRIES / VENEUR_TPU_PROXY_SEND_BACKOFF):
+    # bounded handoff queue depth per destination, in-worker retry
+    # count, and the exponential-backoff base between retries
+    tpu_proxy_dest_queue: int = 8
+    tpu_proxy_send_retries: int = 2
+    tpu_proxy_send_backoff: float = 0.25
 
     def consul_refresh_interval_seconds(self) -> float:
         return parse_duration(self.consul_refresh_interval)

@@ -23,11 +23,13 @@ from collections import defaultdict
 from concurrent.futures import ThreadPoolExecutor
 
 from veneur_tpu.forward import http_import
+from veneur_tpu.forward.destpool import DestinationPool
 from veneur_tpu.forward.discovery import (ConsulDiscoverer,
                                           DestinationRing,
                                           StaticDiscoverer)
-# direct module import (not the observe package facade): a pure-proxy
+# direct module imports (not the observe package facade): a pure-proxy
 # process must not pull the jax-backed devicecost module at startup
+from veneur_tpu.observe.ledger import ProxyLedger
 from veneur_tpu.observe.traceindex import TraceIndex
 
 log = logging.getLogger("veneur_tpu.proxy")
@@ -43,6 +45,25 @@ class ProxyServer:
         self._pool = ThreadPoolExecutor(max_workers=16)
         self._clients: dict[str, object] = {}
         self._clients_lock = threading.Lock()
+        # persistent per-destination HTTP connections (satellite of
+        # the columnar rebuild: one TCP handshake per destination, not
+        # per flush); entries are [conn_or_None, lock]
+        self._http_conns: dict[str, list] = {}
+        self._http_conns_lock = threading.Lock()
+        # columnar route path: native batched decode + vectorized
+        # ring assignment + per-destination workers; the legacy
+        # per-item loop stays as the bit-parity oracle and the
+        # fail-open fallback
+        self.columnar = bool(getattr(config, "tpu_columnar_proxy",
+                                     True))
+        self.destpool = DestinationPool(
+            queue_size=getattr(config, "tpu_proxy_dest_queue", 8),
+            retries=getattr(config, "tpu_proxy_send_retries", 2),
+            backoff=getattr(config, "tpu_proxy_send_backoff", 0.25),
+            on_result=self._metric_send_result)
+        # item-conservation ledger for the proxy hop:
+        # routed == enqueued + busy_dropped per interval
+        self.ledger = ProxyLedger(node="veneur-proxy")
         # the proxy's fragment of cross-tier flush traces: route spans
         # parented under the local tier's forward span, served at
         # /debug/trace/<trace_id>
@@ -150,20 +171,38 @@ class ProxyServer:
             options=[("grpc.max_receive_message_length",
                       64 * 1024 * 1024)])
 
-        def send_metrics(request, context):
-            from veneur_tpu.forward.grpc_forward import \
-                decode_trace_metadata
-            self.route_pb_metrics(
-                list(request.metrics),
-                trace_ctx=decode_trace_metadata(
-                    context.invocation_metadata()))
-            return empty_pb2.Empty()
+        if self.columnar:
+            # raw-bytes deserializer: the columnar router works off
+            # the serialized wire (native decode + record-span
+            # re-encode), so materializing protobuf objects here
+            # would pay the per-item cost the rewrite removes
+            deserializer = bytes
+
+            def send_metrics(request, context):
+                from veneur_tpu.forward.grpc_forward import \
+                    decode_trace_metadata
+                self.route_pb_wire(
+                    request,
+                    trace_ctx=decode_trace_metadata(
+                        context.invocation_metadata()))
+                return empty_pb2.Empty()
+        else:
+            deserializer = forward_pb2.MetricList.FromString
+
+            def send_metrics(request, context):
+                from veneur_tpu.forward.grpc_forward import \
+                    decode_trace_metadata
+                self.route_pb_metrics(
+                    list(request.metrics),
+                    trace_ctx=decode_trace_metadata(
+                        context.invocation_metadata()))
+                return empty_pb2.Empty()
 
         handler = grpc.method_handlers_generic_handler(
             "forwardrpc.Forward",
             {"SendMetrics": grpc.unary_unary_rpc_method_handler(
                 send_metrics,
-                request_deserializer=forward_pb2.MetricList.FromString,
+                request_deserializer=deserializer,
                 response_serializer=empty_pb2.Empty.SerializeToString)})
         self.grpc_server.add_generic_rpc_handlers((handler,))
         host, _, port = self.config.grpc_address.rpartition(":")
@@ -196,6 +235,8 @@ class ProxyServer:
                 elif self.path.startswith("/debug/trace"):
                     debughttp.trace_dump(self, proxy.trace_index,
                                          self.path)
+                elif self.path.startswith("/debug/ledger"):
+                    debughttp.ledger_dump(self, proxy.ledger)
                 elif self.path.startswith("/debug/vars"):
                     # same expvar surface as the server's listener;
                     # the proxy has no flush ring, but its routing
@@ -210,6 +251,8 @@ class ProxyServer:
                         "devicecost": observe.REGISTRY.snapshot(),
                         "destinations": len(proxy.ring.ring)
                         if proxy.ring is not None else 0,
+                        "columnar": proxy.columnar,
+                        "destpool": proxy.destpool.stats(),
                     })
                 else:
                     self.send_error(404)
@@ -337,9 +380,111 @@ class ProxyServer:
         self.bump("metrics_routed", routed)
         if dropped:
             self.bump("metrics_dropped", dropped)
+        # the shared executor's work queue is unbounded, so the legacy
+        # path never busy-drops: every routed item is enqueued
+        self.ledger.credit_route(routed=routed, dropped=dropped,
+                                 enqueued=routed)
         wire_ctx = self._finish_route_span(span)
         for dest, batch in groups.items():
             self._pool.submit(self._send_grpc, dest, batch, wire_ctx)
+
+    def route_pb_wire(self, data: bytes, trace_ctx=None) -> None:
+        """Route a serialized MetricList: columnar when the gate is on
+        and the native path runs, else fail-open to the per-item
+        oracle (`route_pb_metrics`).  Routes on the dedicated gRPC
+        destination set when configured, else the main ring."""
+        from veneur_tpu.forward import route as routemod
+        routed = None
+        snap = None
+        if self.columnar:
+            snap = (self.grpc_ring or self.ring).snapshot()
+            try:
+                routed = routemod.route_metric_list(data, snap)
+            except Exception:
+                log.exception("columnar route failed; falling back "
+                              "to the per-item path")
+                routed = None
+        if routed is None:
+            from veneur_tpu.forward.gen import forward_pb2
+            if self.columnar:
+                self.bump("columnar_fallbacks")
+                self.ledger.credit_route(fallbacks=1)
+            try:
+                ml = forward_pb2.MetricList.FromString(data)
+            except Exception as e:
+                self.bump("import_errors")
+                log.warning("undecodable forward wire: %s", e)
+                return
+            self.route_pb_metrics(list(ml.metrics),
+                                  trace_ctx=trace_ctx)
+            return
+        span = self._route_span("grpc", trace_ctx, routed.n)
+        self.bump("metrics_routed", routed.routed)
+        if routed.dropped:
+            self.bump("metrics_dropped", routed.dropped)
+        wire_ctx = self._finish_route_span(span)
+        metadata = None
+        if wire_ctx and wire_ctx[0]:
+            from veneur_tpu.forward.grpc_forward import (SPAN_ID_KEY,
+                                                         TRACE_ID_KEY)
+            metadata = [(TRACE_ID_KEY, str(wire_ctx[0])),
+                        (SPAN_ID_KEY, str(wire_ctx[1]))]
+        enqueued = busy = 0
+        for d, body, count in routed.batches:
+            dest = routed.members[d]
+            if self.destpool.submit(
+                    dest,
+                    lambda dest=dest, body=body, md=metadata:
+                    self._send_grpc_wire(dest, body, md),
+                    n_items=count,
+                    on_result=self._metric_send_result):
+                enqueued += count
+            else:
+                busy += count
+        if busy:
+            self.bump("busy_dropped", busy)
+        self.ledger.credit_route(routed=routed.routed,
+                                 dropped=routed.dropped,
+                                 enqueued=enqueued, busy_dropped=busy)
+
+    def _metric_send_result(self, dest: str, n_items: int, err,
+                            retries: int) -> None:
+        """Destination-worker completion callback for metric sends:
+        the async half of the accounting (`forwards_sent` /
+        `forward_errors` stats plus the ledger's informational wire
+        outcomes)."""
+        if err is None:
+            self.bump("forwards_sent")
+            self.ledger.credit_send(sent_items=n_items,
+                                    retries=retries)
+        else:
+            self.bump("forward_errors")
+            self.ledger.credit_send(error_items=n_items,
+                                    retries=retries)
+
+    def _trace_send_result(self, dest: str, n_items: int, err,
+                           retries: int) -> None:
+        if err is None:
+            self.bump("traces_sent")
+        else:
+            self.bump("trace_errors")
+
+    def _send_grpc_wire(self, dest: str, body: bytes,
+                        metadata=None) -> None:
+        """Send pre-serialized MetricList bytes to ``dest`` on its
+        cached channel; raises on failure (the destination worker
+        retries + counts)."""
+        with self._clients_lock:
+            client = self._clients.get(dest)
+            if client is None:
+                from veneur_tpu.forward.grpc_forward import \
+                    ForwardClient
+                client = ForwardClient(
+                    dest, timeout=self.config.forward_timeout,
+                    credentials=self._grpc_channel_credentials())
+                self._clients[dest] = client
+        client.send_wire(body, timeout=self.config.forward_timeout,
+                         metadata=metadata)
 
     def _grpc_channel_credentials(self):
         c = self.config
@@ -386,8 +531,16 @@ class ProxyServer:
     def route_json_items(self, items: list[dict],
                          trace_ctx=None) -> None:
         """HTTP /import half: route decoded JSON items and re-POST per
-        destination (proxy.go:587 ProxyMetrics)."""
+        destination (proxy.go:587 ProxyMetrics).  The key hash + ring
+        walk + grouping run vectorized over the batch when the
+        columnar gate is on (the items themselves are already decoded
+        dicts — the native gob/JSON decode happened in decode_body)."""
         span = self._route_span("http", trace_ctx, len(items))
+        if self.columnar and items:
+            if self._route_json_columnar(items, span):
+                return
+            self.bump("columnar_fallbacks")
+            self.ledger.credit_route(fallbacks=1)
         groups: dict[str, list] = defaultdict(list)
         dropped = 0
         for item in items:
@@ -395,32 +548,135 @@ class ProxyServer:
                 groups[self.ring.get(self._json_key(item))].append(item)
             except LookupError:
                 dropped += 1
-        self.bump("metrics_routed", len(items) - dropped)
+        routed = len(items) - dropped
+        self.bump("metrics_routed", routed)
         if dropped:
             self.bump("metrics_dropped", dropped)
+        self.ledger.credit_route(routed=routed, dropped=dropped,
+                                 enqueued=routed)
         wire_ctx = self._finish_route_span(span)
         for dest, batch in groups.items():
             self._pool.submit(self._send_http, dest, batch, wire_ctx)
 
-    def _send_http(self, dest: str, batch: list[dict],
-                   trace_ctx=None) -> None:
-        import urllib.request
-        body = zlib.compress(json.dumps(batch).encode())
+    def _route_json_columnar(self, items: list[dict], span) -> bool:
+        """Vectorized /import routing: one hash pass over the batch's
+        keys, one searchsorted, one argsort grouping, per-destination
+        workers.  Returns False to fail-open to the per-item loop."""
+        from veneur_tpu.forward import ring as ringmod
+        from veneur_tpu.forward import route as routemod
+        snap = self.ring.snapshot()
+        try:
+            keys = [self._json_key(it).encode() for it in items]
+            if len(snap) == 0:
+                groups = []
+                routed, dropped = 0, len(items)
+            else:
+                assign = snap.assign(ringmod.hash_keys(keys))
+                groups = routemod.group_indices(assign,
+                                                len(snap.members))
+                routed, dropped = len(items), 0
+        except Exception:
+            log.exception("columnar /import route failed; falling "
+                          "back to the per-item path")
+            return False
+        self.bump("metrics_routed", routed)
+        if dropped:
+            self.bump("metrics_dropped", dropped)
+        wire_ctx = self._finish_route_span(span)
+        enqueued = busy = 0
+        for d, idxs in groups:
+            dest = snap.members[d]
+            batch = [items[i] for i in idxs]
+            if self.destpool.submit(
+                    dest,
+                    lambda dest=dest, batch=batch, ctx=wire_ctx:
+                    self._post_import(dest, batch, ctx),
+                    n_items=len(batch),
+                    on_result=self._metric_send_result):
+                enqueued += len(batch)
+            else:
+                busy += len(batch)
+        if busy:
+            self.bump("busy_dropped", busy)
+        self.ledger.credit_route(routed=routed, dropped=dropped,
+                                 enqueued=enqueued, busy_dropped=busy)
+        return True
+
+    # -- persistent per-destination HTTP connections -------------------
+
+    def _post_http(self, dest: str, path: str, body: bytes,
+                   headers: dict) -> None:
+        """POST over a persistent per-destination connection,
+        reconnecting once on a stale socket; raises on failure."""
+        import http.client
+        import urllib.parse
+        with self._http_conns_lock:
+            entry = self._http_conns.get(dest)
+            if entry is None:
+                entry = [None, threading.Lock()]
+                self._http_conns[dest] = entry
         url = dest if dest.startswith("http") else f"http://{dest}"
+        parsed = urllib.parse.urlsplit(url)
+        base = parsed.path.rstrip("/")
+        with entry[1]:
+            for attempt in (0, 1):
+                conn = entry[0]
+                if conn is None:
+                    cls = (http.client.HTTPSConnection
+                           if parsed.scheme == "https"
+                           else http.client.HTTPConnection)
+                    conn = cls(parsed.hostname, parsed.port,
+                               timeout=self.config.forward_timeout)
+                    entry[0] = conn
+                try:
+                    conn.request("POST", base + path, body=body,
+                                 headers=headers)
+                    resp = conn.getresponse()
+                    resp.read()
+                    if resp.status >= 400:
+                        raise OSError(f"HTTP {resp.status} from "
+                                      f"{dest}{path}")
+                    return
+                except (OSError, http.client.HTTPException):
+                    # stale keep-alive or dead peer: drop the
+                    # connection and retry once on a fresh socket
+                    try:
+                        conn.close()
+                    finally:
+                        entry[0] = None
+                    if attempt:
+                        raise
+
+    def _close_http_conns(self, gone=None) -> None:
+        with self._http_conns_lock:
+            dests = (list(self._http_conns) if gone is None
+                     else [d for d in gone if d in self._http_conns])
+            entries = [self._http_conns.pop(d) for d in dests]
+        for entry in entries:
+            conn = entry[0]
+            if conn is not None:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+
+    def _post_import(self, dest: str, batch: list[dict],
+                     trace_ctx=None) -> None:
+        body = zlib.compress(json.dumps(batch).encode())
         headers = {"Content-Type": "application/json",
-                   "Content-Encoding": "deflate"}
+                   "Content-Encoding": "deflate",
+                   "Content-Length": str(len(body))}
         if trace_ctx and trace_ctx[0]:
             headers[http_import.TRACE_HEADER] = \
                 http_import.encode_trace_header(*trace_ctx)
-        req = urllib.request.Request(
-            url.rstrip("/") + "/import", data=body,
-            headers=headers, method="POST")
+        self._post_http(dest, "/import", body, headers)
+
+    def _send_http(self, dest: str, batch: list[dict],
+                   trace_ctx=None) -> None:
         try:
-            with urllib.request.urlopen(
-                    req, timeout=self.config.forward_timeout) as r:
-                r.read()
+            self._post_import(dest, batch, trace_ctx)
             self.bump("forwards_sent")
-        except OSError as e:
+        except Exception as e:
             self.bump("forward_errors")
             log.warning("proxy forward to %s failed: %s", dest, e)
 
@@ -430,9 +686,12 @@ class ProxyServer:
         to each dest's /spans — the reference's exact wire
         (proxy.go:543-567 ProxyTraces; the endpoint takes a flat
         []DatadogTraceSpan and no deflate).  Nested span lists are
-        flattened for callers that batch per trace."""
-        groups: dict[str, list] = defaultdict(list)
-        routed = dropped = untraced = 0
+        flattened for callers that batch per trace.  With the
+        columnar gate on, the trace-id hash + ring walk + grouping
+        run vectorized over the flattened batch."""
+        flat: list[dict] = []
+        keys: list[bytes] = []
+        dropped = untraced = 0
         for t in traces:
             spans = t if isinstance(t, list) else [t]
             for sp in spans:
@@ -451,12 +710,22 @@ class ProxyServer:
                     untraced += 1
                     raw_tid = zlib.crc32(json.dumps(
                         sp, sort_keys=True, default=str).encode())
-                tid = str(raw_tid)
-                try:
-                    groups[self.trace_ring.get(tid)].append(sp)
-                    routed += 1
-                except LookupError:
-                    dropped += 1
+                flat.append(sp)
+                keys.append(str(raw_tid).encode())
+        if self.columnar and flat:
+            done = self._route_traces_columnar(flat, keys, dropped,
+                                               untraced)
+            if done:
+                return
+            self.bump("columnar_fallbacks")
+        groups: dict[str, list] = defaultdict(list)
+        routed = 0
+        for sp, key in zip(flat, keys):
+            try:
+                groups[self.trace_ring.get(key.decode())].append(sp)
+                routed += 1
+            except LookupError:
+                dropped += 1
         self.bump("traces_routed", routed)
         if untraced:
             self.bump("untraced_spans_total", untraced)
@@ -465,20 +734,56 @@ class ProxyServer:
         for dest, batch in groups.items():
             self._pool.submit(self._send_traces, dest, batch)
 
-    def _send_traces(self, dest: str, batch: list) -> None:
-        import urllib.request
-        body = json.dumps(batch).encode()
-        url = dest if dest.startswith("http") else f"http://{dest}"
-        req = urllib.request.Request(
-            url.rstrip("/") + "/spans", data=body,
-            headers={"Content-Type": "application/json"},
-            method="POST")
+    def _route_traces_columnar(self, flat: list[dict],
+                               keys: list[bytes], dropped: int,
+                               untraced: int) -> bool:
+        """Vectorized trace routing over the flattened span batch;
+        returns False to fail-open to the per-span loop."""
+        from veneur_tpu.forward import ring as ringmod
+        from veneur_tpu.forward import route as routemod
+        snap = self.trace_ring.snapshot()
         try:
-            with urllib.request.urlopen(
-                    req, timeout=self.config.forward_timeout) as r:
-                r.read()
+            if len(snap) == 0:
+                groups = []
+                routed = 0
+                dropped += len(flat)
+            else:
+                assign = snap.assign(ringmod.hash_keys(keys))
+                groups = routemod.group_indices(assign,
+                                                len(snap.members))
+                routed = len(flat)
+        except Exception:
+            log.exception("columnar trace route failed; falling back "
+                          "to the per-span path")
+            return False
+        self.bump("traces_routed", routed)
+        if untraced:
+            self.bump("untraced_spans_total", untraced)
+        if dropped:
+            self.bump("traces_dropped", dropped)
+        for d, idxs in groups:
+            dest = snap.members[d]
+            batch = [flat[i] for i in idxs]
+            if not self.destpool.submit(
+                    dest,
+                    lambda dest=dest, batch=batch:
+                    self._post_spans(dest, batch),
+                    n_items=len(batch),
+                    on_result=self._trace_send_result):
+                self.bump("trace_busy_dropped", len(batch))
+        return True
+
+    def _post_spans(self, dest: str, batch: list) -> None:
+        body = json.dumps(batch).encode()
+        self._post_http(dest, "/spans", body,
+                        {"Content-Type": "application/json",
+                         "Content-Length": str(len(body))})
+
+    def _send_traces(self, dest: str, batch: list) -> None:
+        try:
+            self._post_spans(dest, batch)
             self.bump("traces_sent")
-        except OSError as e:
+        except Exception as e:
             self.bump("trace_errors")
             log.warning("proxy trace forward to %s failed: %s",
                         dest, e)
@@ -549,7 +854,10 @@ class ProxyServer:
             snap = dict(self.stats)
         for key in ("metrics_routed", "metrics_dropped",
                     "forwards_sent", "forward_errors",
-                    "import_errors", "untraced_spans_total"):
+                    "import_errors", "untraced_spans_total",
+                    "busy_dropped", "trace_busy_dropped",
+                    "columnar_fallbacks", "traces_routed",
+                    "traces_dropped", "traces_sent", "trace_errors"):
             d = snap.get(key, 0) - self._stats_last.get(key, 0)
             self._stats_last[key] = snap.get(key, 0)
             if d:
@@ -565,21 +873,44 @@ class ProxyServer:
     def _refresh_loop(self) -> None:
         interval = self.config.consul_refresh_interval_seconds()
         while not self._shutdown.wait(interval):
-            self.ring.refresh()
-            for ring in (self.grpc_ring, self.trace_ring):
-                if ring is not None:
-                    ring.refresh()
-            self._emit_stats()
-            # drop clients for destinations that left the ring the
-            # gRPC forwarders actually route on
-            grpc_members = (self.grpc_ring or self.ring).ring.members
-            with self._clients_lock:
-                gone = set(self._clients) - set(grpc_members)
-                for dest in gone:
-                    try:
-                        self._clients.pop(dest).close()
-                    except Exception:
-                        pass
+            self._refresh_once()
+
+    def _refresh_once(self) -> None:
+        """One discovery refresh + the housekeeping that rides on it:
+        stats emission, ledger interval seal, and eviction of cached
+        clients/workers/connections for departed destinations."""
+        self.ring.refresh()
+        for ring in (self.grpc_ring, self.trace_ring):
+            if ring is not None:
+                ring.refresh()
+        self._emit_stats()
+        # seal the routing-conservation interval (the proxy has
+        # no flush cycle, so discovery cadence doubles as the
+        # ledger interval); skip empty intervals to keep the
+        # /debug/ledger ring informative
+        cur = self.ledger._cur
+        if cur.routed or cur.dropped or cur.fallbacks:
+            self.ledger.roll()
+        # drop clients for destinations that left the ring the
+        # gRPC forwarders actually route on
+        grpc_members = (self.grpc_ring or self.ring).ring.members
+        with self._clients_lock:
+            gone = set(self._clients) - set(grpc_members)
+            for dest in gone:
+                try:
+                    self._clients.pop(dest).close()
+                except Exception:
+                    pass
+        # per-destination workers + persistent HTTP connections
+        # for destinations no ring routes to anymore
+        keep = set(grpc_members) | set(self.ring.ring.members)
+        for ring in (self.grpc_ring, self.trace_ring):
+            if ring is not None:
+                keep |= set(ring.ring.members)
+        self.destpool.retire(keep)
+        with self._http_conns_lock:
+            conn_gone = set(self._http_conns) - keep
+        self._close_http_conns(gone=conn_gone)
 
     def shutdown(self) -> None:
         self._shutdown.set()
@@ -589,6 +920,8 @@ class ProxyServer:
             self.grpc_server.stop(0.5)
         if self._httpd is not None:
             self._httpd.shutdown()
+        self.destpool.stop()
+        self._close_http_conns()
         with self._clients_lock:
             for c in self._clients.values():
                 try:

@@ -1,0 +1,186 @@
+"""Per-destination forward workers: bounded fan-out with isolation.
+
+The proxy used to hand every per-destination send to one shared
+``ThreadPoolExecutor(16)``: a single stalled global destination (slow
+network, wedged peer) soaks up pool slots until every destination's
+forwards queue behind it.  Modeled on ``sinks/fanout.py``, each
+destination here owns ONE worker thread and a bounded handoff queue:
+
+- a stalled destination times out on its own worker without delaying
+  the others; once its queue fills, new batches for it are counted
+  ``busy_drops`` instead of piling onto shared state (the reference's
+  drop-don't-buffer stance, flusher.go:536-549)
+- transient send errors retry in-worker with exponential backoff,
+  so a blip doesn't drop a batch but a dead peer can't block routing
+- per-destination sent/error/retry/busy-drop counters (in ITEMS as
+  well as batches) feed ``/debug/vars`` and the proxy ledger
+
+``retire`` drops workers for destinations a discovery refresh removed
+from the ring, closing the leak the shared pool never had to think
+about.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+
+log = logging.getLogger("veneur_tpu.destpool")
+
+
+class _DestWorker:
+    def __init__(self, dest: str, queue_size: int, retries: int,
+                 backoff: float, on_result=None):
+        self.dest = dest
+        self.retries = max(0, int(retries))
+        self.backoff = backoff
+        self.on_result = on_result
+        self.queue: queue.Queue = queue.Queue(
+            maxsize=max(1, int(queue_size)))
+        self.sent_batches = 0
+        self.sent_items = 0
+        self.errors = 0
+        self.error_items = 0
+        self.retry_count = 0
+        self.busy_drops = 0
+        self.busy_dropped_items = 0
+        self.last_duration = 0.0
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"proxy-dest-{dest}")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            task = self.queue.get()
+            if task is None:
+                return
+            fn, n_items, on_result = task
+            start = time.perf_counter()
+            err = None
+            tries = 0
+            for attempt in range(self.retries + 1):
+                try:
+                    fn()
+                    err = None
+                    break
+                except Exception as e:
+                    err = e
+                    if attempt < self.retries and not self._stop:
+                        tries += 1
+                        self.retry_count += 1
+                        time.sleep(self.backoff * (2 ** attempt))
+            self.last_duration = time.perf_counter() - start
+            if err is None:
+                self.sent_batches += 1
+                self.sent_items += n_items
+            else:
+                self.errors += 1
+                self.error_items += n_items
+                log.warning("proxy forward to %s failed after %d "
+                            "attempts: %s", self.dest,
+                            self.retries + 1, err)
+            cb = on_result or self.on_result
+            if cb is not None:
+                try:
+                    cb(self.dest, n_items, err, tries)
+                except Exception:
+                    pass
+
+    def stats(self) -> dict:
+        return {
+            "sent_batches": self.sent_batches,
+            "sent_items": self.sent_items,
+            "errors": self.errors,
+            "error_items": self.error_items,
+            "retries": self.retry_count,
+            "busy_drops": self.busy_drops,
+            "busy_dropped_items": self.busy_dropped_items,
+            "queued": self.queue.qsize(),
+            "last_duration_s": round(self.last_duration, 6),
+        }
+
+
+class DestinationPool:
+    """One worker per destination address; ``submit`` hands a send
+    closure to the destination's worker, returning False (and counting
+    a busy-drop) when its queue is full — routing never blocks on a
+    slow peer."""
+
+    def __init__(self, queue_size: int = 8, retries: int = 2,
+                 backoff: float = 0.25, on_result=None):
+        self._queue_size = queue_size
+        self._retries = retries
+        self._backoff = backoff
+        self._on_result = on_result
+        self._workers: dict[str, _DestWorker] = {}
+        self._lock = threading.Lock()
+
+    def submit(self, dest: str, fn, n_items: int = 1,
+               on_result=None) -> bool:
+        """Hand a send closure to ``dest``'s worker.  ``on_result``
+        (or the pool default) is called as ``(dest, n_items, err,
+        retries)`` after the final attempt.  Returns False (counting
+        a busy-drop) when the worker's queue is full."""
+        with self._lock:
+            w = self._workers.get(dest)
+            if w is None:
+                w = _DestWorker(dest, self._queue_size, self._retries,
+                                self._backoff, self._on_result)
+                self._workers[dest] = w
+        try:
+            w.queue.put_nowait((fn, n_items, on_result))
+        except queue.Full:
+            w.busy_drops += 1
+            w.busy_dropped_items += n_items
+            return False
+        return True
+
+    @staticmethod
+    def _signal_stop(w: _DestWorker) -> None:
+        w._stop = True
+        for _ in range(w.queue.maxsize + 1):
+            try:
+                w.queue.put_nowait(None)
+                return
+            except queue.Full:
+                try:  # discard a queued batch to make room
+                    w.queue.get_nowait()
+                except queue.Empty:
+                    pass
+
+    def retire(self, keep) -> list[str]:
+        """Stop + drop workers whose destination left the ring;
+        returns the retired addresses."""
+        keep = set(keep)
+        with self._lock:
+            gone = [d for d in self._workers if d not in keep]
+            retired = {d: self._workers.pop(d) for d in gone}
+        for w in retired.values():
+            self._signal_stop(w)
+        return gone
+
+    def destinations(self) -> list[str]:
+        with self._lock:
+            return list(self._workers)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {d: w.stats() for d, w in self._workers.items()}
+
+    def totals(self) -> dict:
+        out = {"sent_batches": 0, "sent_items": 0, "errors": 0,
+               "error_items": 0, "retries": 0, "busy_drops": 0,
+               "busy_dropped_items": 0}
+        for s in self.stats().values():
+            for k in out:
+                out[k] += s[k]
+        return out
+
+    def stop(self) -> None:
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            self._signal_stop(w)

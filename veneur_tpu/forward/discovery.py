@@ -122,6 +122,7 @@ class DestinationRing:
         self.service = service
         self.ring = ConsistentRing()
         self._lock = threading.Lock()
+        self.epoch = 0  # bumped on every membership swap
         self.refreshes = 0
         self.refresh_failures = 0
 
@@ -145,9 +146,21 @@ class DestinationRing:
             if tuple(sorted(dests)) != self.ring.members:
                 ring = ConsistentRing(dests)
                 self.ring = ring
+                self.epoch += 1
         self.refreshes += 1
         return True
 
     def get(self, key: str) -> str:
         with self._lock:
             return self.ring.get(key)
+
+    def snapshot(self) -> ConsistentRing:
+        """The current ring object, read atomically.
+
+        ``ConsistentRing`` is immutable after a refresh swap (refresh
+        builds a fresh ring rather than mutating in place), so the
+        columnar router can hash/assign a whole batch against one
+        membership epoch without holding the lock.
+        """
+        with self._lock:
+            return self.ring

@@ -838,6 +838,21 @@ class ForwardClient:
             _METHOD,
             request_serializer=forward_pb2.MetricList.SerializeToString,
             response_deserializer=empty_pb2.Empty.FromString)
+        # raw-bytes twin of _call: the columnar proxy re-encodes a
+        # destination's slice as wire bytes (concatenated record
+        # spans), so serializing through MetricList here would undo
+        # the whole zero-materialization route path
+        self._call_raw = self._channel.unary_unary(
+            _METHOD,
+            request_serializer=lambda b: b,
+            response_deserializer=empty_pb2.Empty.FromString)
+
+    def send_wire(self, body: bytes, timeout: float | None = None,
+                  metadata=None) -> None:
+        """Send an already-serialized MetricList body verbatim.
+        Raises grpc.RpcError on failure (caller drops-and-counts)."""
+        self._call_raw(body, timeout=timeout or self._timeout,
+                       metadata=metadata)
 
     def send(self, rows: list[ForwardRow],
              trace_context: tuple[int, int] | None = None) -> None:

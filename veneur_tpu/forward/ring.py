@@ -8,19 +8,68 @@ destination remaps only ~1/N of keys, and the same key always lands on
 the same destination while membership is unchanged.  The hash function
 itself is process-internal (both ends of the wire are ours), so this
 uses the repo's fnv1a-64+fmix64 instead of stathat's crc32.
+
+``get`` is the scalar oracle; ``assign``/``hash_keys`` are the
+vectorized batch equivalents the columnar proxy routes through —
+bit-identical destination per key by construction (same hash, and
+``np.searchsorted(side="right")`` on the sorted vnode array is exactly
+``bisect.bisect`` with the same wrap-to-0).
 """
 
 from __future__ import annotations
 
 import bisect
+import ctypes
 
-from veneur_tpu.utils.hashing import _fmix64, fnv1a_64_int
+import numpy as np
+
+from veneur_tpu.utils.hashing import _fmix64, fnv1a_64_int, hash64
 
 REPLICAS = 120  # vnodes per member: keeps load spread within ~10%
+
+# hash64() packs members into a fixed 256-byte matrix and tail-folds
+# anything longer, so it is only bit-exact with _h for keys <= 256
+# bytes; longer keys take the scalar path in hash_keys.
+_HASH64_EXACT_LEN = 256
 
 
 def _h(data: str) -> int:
     return _fmix64(fnv1a_64_int(data.encode()))
+
+
+def hash_keys(keys: list[bytes]) -> np.ndarray:
+    """Vectorized ``_h`` over already-encoded keys -> uint64[n].
+
+    Bit-identical to ``_h(k.decode())`` per element: the native
+    ``vtpu_hash_members`` streams the same fnv1a64+fmix64; the numpy
+    fallback (``hash64``) is exact up to 256 bytes, beyond which the
+    scalar loop takes over.
+    """
+    n = len(keys)
+    if n == 0:
+        return np.empty(0, dtype=np.uint64)
+    from veneur_tpu import native
+    lib = native.load()
+    if lib is not None:
+        buf = np.frombuffer(b"".join(keys), dtype=np.uint8)
+        lens = np.fromiter((len(k) for k in keys), dtype=np.int64,
+                           count=n)
+        offs = np.zeros(n, dtype=np.int64)
+        np.cumsum(lens[:-1], out=offs[1:])
+        out = np.empty(n, dtype=np.uint64)
+        lib.vtpu_hash_members(
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+        return out
+    short = all(len(k) <= _HASH64_EXACT_LEN for k in keys)
+    if short:
+        return hash64(keys).astype(np.uint64, copy=False)
+    out = np.empty(n, dtype=np.uint64)
+    for i, k in enumerate(keys):
+        out[i] = _fmix64(fnv1a_64_int(k)) & 0xFFFFFFFFFFFFFFFF
+    return out
 
 
 class ConsistentRing:
@@ -30,18 +79,24 @@ class ConsistentRing:
         self._points: list[int] = []
         self._owners: list[str] = []
         self._members: tuple[str, ...] = ()
+        self._points_arr = np.empty(0, dtype=np.uint64)
+        self._owner_idx = np.empty(0, dtype=np.int32)
         if members:
             self.set_members(members)
 
     def set_members(self, members: list[str]) -> None:
+        uniq = sorted(set(members))
         pairs = []
-        for m in sorted(set(members)):
+        for mi, m in enumerate(uniq):
             for i in range(self.replicas):
-                pairs.append((_h(f"{i}:{m}"), m))
+                pairs.append((_h(f"{i}:{m}"), mi))
         pairs.sort()
         self._points = [p for p, _ in pairs]
-        self._owners = [m for _, m in pairs]
-        self._members = tuple(sorted(set(members)))
+        self._owners = [uniq[mi] for _, mi in pairs]
+        self._members = tuple(uniq)
+        self._points_arr = np.asarray(self._points, dtype=np.uint64)
+        self._owner_idx = np.fromiter(
+            (mi for _, mi in pairs), dtype=np.int32, count=len(pairs))
 
     @property
     def members(self) -> tuple[str, ...]:
@@ -58,3 +113,16 @@ class ConsistentRing:
         if i == len(self._points):
             i = 0
         return self._owners[i]
+
+    def assign(self, hashes: np.ndarray) -> np.ndarray:
+        """Member index (into ``members``) per key hash -> int32[n].
+
+        ``hashes`` is the uint64 output of ``hash_keys`` (or the
+        native proxy key hasher).  Raises LookupError when empty,
+        matching ``get``.
+        """
+        if not self._points:
+            raise LookupError("empty ring")
+        idx = np.searchsorted(self._points_arr, hashes, side="right")
+        idx[idx == len(self._points_arr)] = 0
+        return self._owner_idx[idx]

@@ -317,3 +317,158 @@ class Ledger:
                 r.retained_rows for r in recs),
         }
         return out
+
+
+@dataclass
+class ProxyLedgerRecord:
+    """One proxy routing interval's conservation account.
+
+    Balance (checked at seal): every item presented to the router is
+    either ``routed`` (assigned a destination) or ``dropped`` (no
+    destination — empty ring), and every routed item was either
+    ``enqueued`` on its destination worker or ``busy_dropped`` when
+    that worker's bounded queue was full:
+
+        routed == enqueued + busy_dropped
+
+    ``sent_items``/``error_items``/``retries`` are the destination
+    workers' ASYNC wire outcomes — they may land after the interval
+    that enqueued them seals, so (like the server ledger's
+    forward_wire block) they're informational, not balance inputs.
+    """
+
+    seq: int = 0
+    start_unix: float = 0.0
+    routed: int = 0
+    dropped: int = 0
+    enqueued: int = 0
+    busy_dropped: int = 0
+    sent_items: int = 0
+    error_items: int = 0
+    retries: int = 0
+    fallbacks: int = 0       # columnar->legacy fail-open takes
+    sealed: bool = False
+    balanced: bool = True
+    owed: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "seq": self.seq,
+            "start_unix": self.start_unix,
+            "routed": self.routed,
+            "dropped": self.dropped,
+            "enqueued": self.enqueued,
+            "busy_dropped": self.busy_dropped,
+            "wire": {"sent_items": self.sent_items,
+                     "error_items": self.error_items,
+                     "retries": self.retries},
+            "fallbacks": self.fallbacks,
+            "balanced": self.balanced,
+            "owed": self.owed,
+        }
+
+
+class ProxyLedger:
+    """Item-conservation ledger for the proxy hop.
+
+    Both route paths credit it: the columnar router and the legacy
+    per-item oracle make ONE ``credit_route`` call per batch with all
+    four synchronous counts, so an interval roll can never split a
+    batch's credits across records.  ``roll()`` closes + seals the
+    current interval in one step (the proxy has no flush cycle to
+    separate the two); the refresh loop drives it once per discovery
+    interval and bench drives it per pass.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 node: str = "veneur-proxy", strict: bool = False,
+                 on_imbalance=None):
+        self.node = node
+        self.strict = strict
+        self.on_imbalance = on_imbalance
+        self._lock = threading.Lock()
+        self._ring: deque[ProxyLedgerRecord] = deque(maxlen=capacity)
+        self._cur = ProxyLedgerRecord(start_unix=time.time())
+        self._seq = 0
+        self.imbalanced_total = 0
+
+    def credit_route(self, routed: int = 0, dropped: int = 0,
+                     enqueued: int = 0, busy_dropped: int = 0,
+                     fallbacks: int = 0) -> None:
+        with self._lock:
+            cur = self._cur
+            cur.routed += int(routed)
+            cur.dropped += int(dropped)
+            cur.enqueued += int(enqueued)
+            cur.busy_dropped += int(busy_dropped)
+            cur.fallbacks += int(fallbacks)
+
+    def credit_send(self, sent_items: int = 0, error_items: int = 0,
+                    retries: int = 0) -> None:
+        with self._lock:
+            cur = self._cur
+            cur.sent_items += int(sent_items)
+            cur.error_items += int(error_items)
+            cur.retries += int(retries)
+
+    def roll(self) -> ProxyLedgerRecord:
+        """Close + seal the current interval; returns the sealed
+        record."""
+        with self._lock:
+            rec = self._cur
+            self._seq += 1
+            self._cur = ProxyLedgerRecord(start_unix=time.time())
+            rec.seq = self._seq
+            rec.owed = rec.routed - (rec.enqueued + rec.busy_dropped)
+            rec.balanced = rec.owed == 0
+            rec.sealed = True
+            self._ring.append(rec)
+            if not rec.balanced:
+                self.imbalanced_total += 1
+        if not rec.balanced:
+            msg = ("proxy ledger imbalance node=%s seq=%d: owed=%d "
+                   "(routed=%d enqueued=%d busy_dropped=%d dropped=%d)")
+            args = (self.node, rec.seq, rec.owed, rec.routed,
+                    rec.enqueued, rec.busy_dropped, rec.dropped)
+            if self.strict:
+                log.error(msg, *args)
+            else:
+                log.warning(msg, *args)
+            if self.on_imbalance is not None:
+                self.on_imbalance(rec)
+        return rec
+
+    def records(self) -> list[ProxyLedgerRecord]:
+        with self._lock:
+            return list(self._ring)
+
+    def to_json(self) -> bytes:
+        recs = self.records()
+        out = {
+            "node": self.node,
+            "strict": self.strict,
+            "intervals": len(recs),
+            "imbalanced": [r.seq for r in recs if not r.balanced],
+            "records": [r.to_dict() for r in recs],
+        }
+        return json.dumps(out, indent=1).encode()
+
+    def summary(self) -> dict:
+        """Aggregate over the retained ring — the shape the proxy
+        bench stamps into its artifact (same gate keys as
+        ``Ledger.summary``: intervals/balanced/imbalanced/
+        owed_total)."""
+        recs = self.records()
+        return {
+            "intervals": len(recs),
+            "balanced": sum(1 for r in recs if r.balanced),
+            "imbalanced": sum(1 for r in recs if not r.balanced),
+            "owed_total": sum(abs(r.owed) for r in recs),
+            "routed_total": sum(r.routed for r in recs),
+            "dropped_total": sum(r.dropped for r in recs),
+            "enqueued_total": sum(r.enqueued for r in recs),
+            "busy_dropped_total": sum(r.busy_dropped for r in recs),
+            "sent_items_total": sum(r.sent_items for r in recs),
+            "error_items_total": sum(r.error_items for r in recs),
+            "fallbacks_total": sum(r.fallbacks for r in recs),
+        }
