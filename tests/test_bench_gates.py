@@ -322,6 +322,55 @@ def test_global_merge_artifact_committed():
         assert d["apply_decode_host_per_wire"] <= 0.002
 
 
+def test_cluster_shard_artifact_committed():
+    """bench.py --cluster: the sharded global tier's N-local x
+    M-global soak (ISSUE 10 headline).  The committed artifact must
+    show exact cluster-wide sample conservation on the real-server
+    e2e half, >=100k distinct series on the scaling half, M-scaling
+    over the modeled per-shard service floor (>=1.6x at M=2, >=2.5x
+    at M=4 — the keyspace split must actually parallelize the global
+    tier), measured per-item python work far under that floor (the
+    topology, not the host, was the variable), and every tier's
+    ledger balanced."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench_results", "cluster_shard.json")
+    with open(path) as f:
+        d = json.load(f)
+    assert d["mode"] == "cluster_shard" and d["quick"] is False
+
+    e = d["e2e"]
+    assert e["locals"] >= 4 and e["globals"] >= 2
+    assert e["conservation_exact"] is True
+    assert e["items_received"] == e["items_expected"]
+    assert e["ledgers_balanced"] is True
+    assert e["split_equals_global_intake"] is True
+    assert e["both_dests_hit"] is True
+    assert e["zero_fallbacks"] is True
+
+    s = d["scaling"]
+    assert s["series_total"] >= 100_000
+    assert s["n_locals"] >= 4
+    for m in ("m1", "m2", "m4"):
+        c = s[m]
+        assert c["conservation_exact"] is True, m
+        assert c["wire_errors"] == 0 and c["busy_dropped"] == 0, m
+        assert c["route_fallbacks"] == 0, m
+        assert c["local_ledgers_balanced"], m
+        assert c["global_ledgers_balanced"], m
+        # the modeled service floor must dominate the python work, or
+        # the M-ratio measures the host instead of the topology
+        assert (c["measured_work_us_per_item"]
+                < s["service_us_per_item"] / 10), m
+    assert s["scaling_m2_vs_m1"] >= 1.6
+    assert s["scaling_m4_vs_m1"] >= 2.5
+    for gate, ok in d["cluster_gates"].items():
+        assert ok is True, gate
+    assert d["cluster_items_per_sec"] > 0
+    assert d["global_shards"] == 4
+    assert "platform" in d and "gates" in d
+
+
 def _bench_module():
     import importlib.util
     path = os.path.join(
@@ -358,6 +407,15 @@ def test_summary_line_compact_and_parseable():
     assert d["configs"]["4_global_merge"]["rate"] == 46600.0
     assert len(d["configs"]["2_timers_10k_series"]["error"]) <= 80
     assert d["configs"]["3_sets_1m_uniques"]["skipped"] is True
+    # the normal line never grows the cluster fields...
+    assert "cluster_items_per_sec" not in d
+    # ...and a --cluster artifact's line carries exactly its verdict
+    cline = m._summary_line({"cluster_items_per_sec": 23040.2,
+                             "global_shards": 4, "platform": "cpu"})
+    assert len(cline) < 1024
+    cd = json.loads(cline)
+    assert cd["cluster_items_per_sec"] == 23040.2
+    assert cd["global_shards"] == 4
 
 
 def test_median_pass_result_headline_is_median():

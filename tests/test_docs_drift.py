@@ -56,6 +56,10 @@ def test_ledger_and_sink_counters_present():
             "veneur.sink.flush_timeouts_total",
             "veneur.sink.flush_errors_total",
             "veneur.proxy.untraced_spans_total",
+            "veneur.forward.shard.wires_total",
+            "veneur.forward.shard.busy_dropped_total",
+            "veneur.forward.shard.fallback_total",
+            "veneur.ledger.forward_split_dropped_total",
     ):
         assert name in DOCS, name
         # and the emitting source actually still carries it
@@ -72,6 +76,7 @@ def test_debug_endpoints_documented():
 def test_env_vars_documented_in_readme():
     readme = (ROOT / "README.md").read_text()
     for var in ("VENEUR_TPU_LEDGER_STRICT",
-                "VENEUR_TPU_TRACE_PROPAGATION"):
+                "VENEUR_TPU_TRACE_PROPAGATION",
+                "VENEUR_TPU_SHARDED_GLOBAL"):
         assert var in readme, var
         assert var in DOCS, var

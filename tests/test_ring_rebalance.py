@@ -1,0 +1,107 @@
+"""Consistent-hash rebalance stability.
+
+The property the sharded global tier leans on (and the reference's
+stathat ring guarantees): membership changes remap only the keys whose
+owning vnode arcs changed hands.  Adding a member moves keys ONLY onto
+the new member; removing one moves ONLY the keys it owned; everything
+else stays put, and the churn is ~1/M of the keyspace, not a full
+reshuffle.  Fuzzed over 1-16 members with the vectorized assign path
+(the one the columnar router uses), plus a mid-batch epoch swap: an
+in-place ``set_members`` must behave exactly like a fresh ring — a
+batch split across the swap sees old or new owners, never a third.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from veneur_tpu.forward.ring import ConsistentRing, hash_keys
+
+
+def _keys(n, seed):
+    rng = np.random.default_rng(seed)
+    return [f"svc{rng.integers(40)}.metric.{i}|counter|"
+            f"env:{rng.integers(4)},z:{i % 11}".encode()
+            for i in range(n)]
+
+
+def _member(j):
+    return f"10.0.{j}.1:8128"
+
+
+def _owners(ring, hashes):
+    assign = ring.assign(hashes)
+    return np.asarray(ring.members, dtype=object)[assign]
+
+
+N_KEYS = 4000
+
+
+@pytest.mark.parametrize("m", range(1, 17))
+def test_add_member_moves_only_onto_it(m):
+    keys = _keys(N_KEYS, seed=m)
+    hashes = hash_keys(keys)
+    ring = ConsistentRing([_member(j) for j in range(m)])
+    before = _owners(ring, hashes)
+    ring.set_members(list(ring.members) + [_member(99)])
+    after = _owners(ring, hashes)
+
+    moved = before != after
+    # every moved key landed on the new member — nothing shuffled
+    # between the survivors
+    assert set(after[moved]) <= {_member(99)}
+    # churn ~ 1/(m+1) of the keyspace, generously bounded at 2x
+    assert moved.sum() <= 2 * N_KEYS / (m + 1)
+    if m <= 8:
+        # enough vnode arcs that the new member actually takes load
+        assert moved.any()
+
+
+@pytest.mark.parametrize("m", range(2, 17))
+def test_remove_member_moves_only_its_keys(m):
+    keys = _keys(N_KEYS, seed=100 + m)
+    hashes = hash_keys(keys)
+    members = [_member(j) for j in range(m)]
+    ring = ConsistentRing(members)
+    before = _owners(ring, hashes)
+    gone = members[m // 2]
+    ring.set_members([x for x in members if x != gone])
+    after = _owners(ring, hashes)
+
+    moved = before != after
+    # only the removed member's keys moved, and ALL of them did
+    assert np.array_equal(moved, before == gone)
+    assert gone not in set(after)
+    # its share was ~1/m of the keyspace
+    assert moved.sum() <= 2 * N_KEYS / m
+
+
+@pytest.mark.parametrize("m", [1, 3, 7, 16])
+def test_epoch_swap_matches_fresh_ring(m):
+    """An in-place membership swap mid-batch is indistinguishable
+    from a freshly built ring: assignment is a pure function of the
+    member set, so a batch hashed once and assigned half before /
+    half after the swap sees only old-or-new owners."""
+    keys = _keys(N_KEYS, seed=200 + m)
+    hashes = hash_keys(keys)
+    old = [_member(j) for j in range(m)]
+    new = old[:-1] + [_member(50), _member(51)]
+
+    ring = ConsistentRing(old)
+    first_half = _owners(ring, hashes[:N_KEYS // 2])
+    ring.set_members(new)
+    second_half = _owners(ring, hashes[N_KEYS // 2:])
+
+    fresh_old = _owners(ConsistentRing(old), hashes)
+    fresh_new = _owners(ConsistentRing(new), hashes)
+    assert np.array_equal(first_half, fresh_old[:N_KEYS // 2])
+    assert np.array_equal(second_half, fresh_new[N_KEYS // 2:])
+
+
+def test_scalar_get_agrees_with_vectorized_assign():
+    keys = _keys(512, seed=7)
+    ring = ConsistentRing([_member(j) for j in range(5)])
+    vec = _owners(ring, hash_keys(keys))
+    for k, dest in zip(keys, vec):
+        assert ring.get(k.decode()) == dest

@@ -1,0 +1,315 @@
+"""Sharded global forward tier (tpu_sharded_global).
+
+The PR's parity contracts: with M=1 the routed body is byte-identical
+to the legacy single-global wire (columnar AND scalar fallback); with
+M>1 the columnar router and the per-row oracle agree on ownership;
+the ledger's forward split seals only when the per-destination counts
+account for every forwarded row; and a real local -> {global A,
+global B} chain over loopback gRPC lands every keyspace exactly once,
+with one flush.forward.shard child span per destination stitched
+under the flush.forward stage on the local and the import spans
+parented under those children on the globals.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+pytest.importorskip("grpc")
+
+from veneur_tpu.core.config import read_config
+from veneur_tpu.core.flusher import ForwardRow
+from veneur_tpu.core.server import Server
+from veneur_tpu.core.table import RowMeta
+from veneur_tpu.forward.gen import forward_pb2
+from veneur_tpu.forward.shard import ShardedForwarder, row_route_key
+from veneur_tpu.observe.ledger import Ledger, ProxyLedger
+from veneur_tpu.protocol import dogstatsd as dsd
+from veneur_tpu.sinks.simple import CaptureSink
+
+
+def _meta(name, mtype, tags=(), scope=dsd.SCOPE_DEFAULT):
+    return RowMeta(name=name, tags=tuple(tags), scope=scope,
+                   type=mtype)
+
+
+def _rows(n):
+    """A mixed flush: counters, gauges and tagged variants with
+    distinct route keys so a multi-member ring splits them."""
+    rows = []
+    for i in range(n):
+        if i % 3 == 0:
+            rows.append(ForwardRow(
+                _meta(f"shard.ctr.{i}", dsd.COUNTER, (f"k:{i % 7}",)),
+                "counter", value=float(i + 1)))
+        elif i % 3 == 1:
+            rows.append(ForwardRow(
+                _meta(f"shard.gauge.{i}", dsd.GAUGE),
+                "gauge", value=float(i) / 2))
+        else:
+            rows.append(ForwardRow(
+                _meta(f"shard.ctr.{i}", dsd.COUNTER,
+                      ("env:prod", f"z:{i % 5}")),
+                "counter", value=float(i)))
+    return rows
+
+
+# ----------------------------------------------------------------------
+# M=1 byte parity: the sharded path must be indistinguishable on the
+# wire from the legacy single-global send
+
+
+def test_m1_columnar_body_byte_identical():
+    fwd = ShardedForwarder(["127.0.0.1:9999"])
+    rows = _rows(64)
+    data = fwd.serialize(rows)
+    routed = fwd.route(data)
+    assert routed is not None
+    assert routed.dropped == 0 and routed.routed == 64
+    assert len(routed.batches) == 1
+    d, body, n = routed.batches[0]
+    assert routed.members[d] == "127.0.0.1:9999" and n == 64
+    # MetricList is one repeated field, so the concatenated record
+    # spans in wire order ARE the original serialization
+    assert bytes(body) == data
+
+
+def test_m1_scalar_fallback_body_byte_identical():
+    fwd = ShardedForwarder(["127.0.0.1:9999"])
+    rows = _rows(64)
+    batches = fwd.route_rows_scalar(rows)
+    assert len(batches) == 1
+    dest, body, n = batches[0]
+    assert dest == "127.0.0.1:9999" and n == 64
+    assert body == fwd.serialize(rows)
+
+
+def test_columnar_and_scalar_routers_agree_on_ownership():
+    """The wire hasher (vtpu_proxy_keyhash off the serialized bytes)
+    and the per-row oracle (row_route_key through ring.get) must put
+    every metric on the same destination."""
+    members = ["10.0.0.1:8128", "10.0.0.2:8128", "10.0.0.3:8128"]
+    fwd = ShardedForwarder(members)
+    rows = _rows(200)
+    routed = fwd.route(fwd.serialize(rows))
+    assert routed is not None and routed.dropped == 0
+
+    def names(body):
+        ml = forward_pb2.MetricList.FromString(bytes(body))
+        return sorted((m.name, tuple(m.tags)) for m in ml.metrics)
+
+    columnar = {routed.members[d]: names(body)
+                for d, body, n in routed.batches}
+    scalar = {dest: names(body)
+              for dest, body, n in fwd.route_rows_scalar(rows)}
+    assert columnar == scalar
+    assert sum(n for _, _, n in routed.batches) == len(rows)
+    # the oracle's key is the one the ring hashes
+    for row in rows[:5]:
+        assert fwd.ring.get(row_route_key(row)) in members
+
+
+# ----------------------------------------------------------------------
+# ledger: forwarded_total == sum(per-dest) + split drops, only
+# enforced when a split was credited
+
+
+def test_ledger_split_balances():
+    led = Ledger(node="t")
+    rec = led.close_interval(seq=1)
+    led.credit_rows(rec, {"staged_rows": 10, "emitted_rows": 4,
+                          "forwarded_rows": 6})
+    led.credit_forward_split(rec, "a:1", 4)
+    led.credit_forward_split(rec, "b:1", 2)
+    led.seal(rec)
+    assert rec.balanced and rec.split_owed == 0
+    assert rec.forward_split == {"a:1": 4, "b:1": 2}
+    s = led.summary()
+    assert s["forward_split_per_dest"] == {"a:1": 4, "b:1": 2}
+    assert s["forward_split_total"] == 6
+    assert s["forward_split_dropped_total"] == 0
+
+
+def test_ledger_split_busy_drop_balances():
+    """A busy-dropped shard wire is accounted as a split drop — the
+    rows are gone but not unaccounted."""
+    led = Ledger(node="t")
+    rec = led.close_interval(seq=1)
+    led.credit_rows(rec, {"staged_rows": 6, "forwarded_rows": 6})
+    led.credit_forward_split(rec, "a:1", 4)
+    led.credit_forward_split(rec, dropped=2)
+    led.seal(rec)
+    assert rec.balanced and rec.split_owed == 0
+    assert rec.forward_split_dropped == 2
+
+
+def test_ledger_split_catches_lost_shard():
+    """Forwarded rows that never reached any destination's split are
+    owed; strict mode escalates."""
+    hits = []
+    led = Ledger(strict=True, node="t", on_imbalance=hits.append)
+    rec = led.close_interval(seq=1)
+    led.credit_rows(rec, {"staged_rows": 6, "forwarded_rows": 6})
+    led.credit_forward_split(rec, "a:1", 4)   # 2 rows vanish
+    led.seal(rec)
+    assert not rec.balanced and rec.split_owed == 2
+    assert hits == [rec]
+    assert rec.to_dict()["forward_split"]["owed"] == 2
+
+
+def test_ledger_no_split_means_no_split_check():
+    """The legacy single-global path credits no split — seal must not
+    invent an imbalance for it."""
+    led = Ledger(node="t")
+    rec = led.close_interval(seq=1)
+    led.credit_rows(rec, {"staged_rows": 6, "forwarded_rows": 6})
+    led.seal(rec)
+    assert rec.balanced and rec.split_owed == 0
+
+
+def test_proxy_ledger_routed_per_dest():
+    led = ProxyLedger(node="p")
+    led.credit_route(routed=10, enqueued=10,
+                     per_dest={"a:1": 7, "b:1": 3})
+    led.credit_route(routed=5, enqueued=5, per_dest={"a:1": 5})
+    rec = led.roll()
+    assert rec.balanced
+    assert rec.routed_per_dest == {"a:1": 12, "b:1": 3}
+    assert rec.to_dict()["routed_per_dest"] == {"a:1": 12, "b:1": 3}
+    assert led.summary()["routed_per_dest"] == {"a:1": 12, "b:1": 3}
+
+
+# ----------------------------------------------------------------------
+# end-to-end: one local, two globals, real loopback gRPC
+
+
+def test_sharded_chain_two_globals():
+    caps = [CaptureSink(), CaptureSink()]
+    globals_ = []
+    for cap in caps:
+        g = Server(read_config(data={
+            "grpc_listen_addresses": ["tcp://127.0.0.1:0"],
+            "interval": "10s", "hostname": "g"}), extra_sinks=[cap])
+        g.start()
+        globals_.append(g)
+    try:
+        addrs = [f"127.0.0.1:{g.grpc_ports[0]}" for g in globals_]
+        local = Server(read_config(data={
+            "statsd_listen_addresses": [],
+            "forward_address": ",".join(addrs),
+            "forward_use_grpc": True,
+            "tpu_sharded_global": True,
+            "interval": "10s", "hostname": "l"}), extra_sinks=[])
+        local.start()
+        try:
+            n_series = 300
+            for i in range(n_series):
+                # global-scope counters: locals forward them instead
+                # of emitting (the keyspace the split carves up)
+                local.handle_packet(
+                    f"shard.e2e.{i}:{i}|c|#veneurglobalonly".encode())
+            local.flush_once()
+
+            # both shards took a wire; no fallbacks anywhere
+            assert local.stats["forward_shard_wires"] == 2
+            assert local.stats.get("sharded_route_fallbacks", 0) == 0
+            assert local.stats.get("sharded_forward_fallbacks", 0) == 0
+            assert local.stats.get("forward_busy_dropped", 0) == 0
+
+            # ledger: the split accounts for every forwarded row
+            rec = local.ledger.last()
+            assert rec is not None and rec.sealed and rec.balanced
+            assert set(rec.forward_split) == set(addrs)
+            assert (sum(rec.forward_split.values())
+                    == rec.forwarded_rows == n_series)
+
+            # each keyspace landed exactly once across the two
+            # globals, with its value intact
+            for g in globals_:
+                assert g.stats["imports_received"] >= 1
+                g.flush_once()
+            merged = {}
+            for cap in caps:
+                for m in cap.metrics:
+                    assert m.name not in merged, "key owned twice"
+                    merged[m.name] = m.value
+            assert len(merged) == n_series
+            for i in range(n_series):
+                assert merged[f"shard.e2e.{i}"] == float(i)
+            # and both sides actually did work (hash split is uneven
+            # but 300 keys over 2 members never lands one-sided)
+            assert all(cap.metrics for cap in caps)
+
+            # trace: flush.forward -> M flush.forward.shard children
+            # on the local, import spans under those on the globals
+            tid = next(t for t in reversed(local.trace_index.trace_ids())
+                       if any(s["name"] == "flush.forward"
+                              for s in local.trace_index.get(t)))
+            spans = local.trace_index.get(tid)
+            fwd_span = next(s for s in spans
+                            if s["name"] == "flush.forward")
+            shards = [s for s in spans
+                      if s["name"] == "flush.forward.shard"]
+            assert len(shards) == 2
+            assert {s["tags"]["dest"] for s in shards} == set(addrs)
+            assert all(s["parent_id"] == fwd_span["span_id"]
+                       for s in shards)
+            assert len({s["span_id"] for s in shards}) == 2
+            assert (sum(int(s["tags"]["rows"]) for s in shards)
+                    == n_series)
+            # the wire carried each child's ids: the remote import
+            # span parents under its own shard branch
+            shard_ids = {s["span_id"] for s in shards}
+            for g in globals_:
+                gspans = g.trace_index.get(tid)
+                imports = [s for s in gspans if s["name"] == "import"]
+                assert imports
+                assert all(s["parent_id"] in shard_ids
+                           for s in imports)
+        finally:
+            local.shutdown()
+    finally:
+        for g in globals_:
+            g.shutdown()
+
+
+def test_m1_gate_on_still_single_wire(tmp_path):
+    """tpu_sharded_global with ONE member must behave exactly like the
+    legacy path on the wire: one destination, one wire, full split."""
+    cap = CaptureSink()
+    glob = Server(read_config(data={
+        "grpc_listen_addresses": ["tcp://127.0.0.1:0"],
+        "interval": "10s", "hostname": "g"}), extra_sinks=[cap])
+    glob.start()
+    try:
+        local = Server(read_config(data={
+            "statsd_listen_addresses": [],
+            "forward_address": f"127.0.0.1:{glob.grpc_ports[0]}",
+            "forward_use_grpc": True,
+            "tpu_sharded_global": True,
+            "interval": "10s", "hostname": "l"}), extra_sinks=[])
+        local.start()
+        try:
+            for i in range(50):
+                local.handle_packet(
+                    f"m1.{i}:1|c|#veneurglobalonly".encode())
+            local.flush_once()
+            assert local.stats["forward_shard_wires"] == 1
+            rec = local.ledger.last()
+            assert rec.balanced
+            assert rec.forward_split == {
+                f"127.0.0.1:{glob.grpc_ports[0]}": 50}
+            glob.flush_once()
+            assert len({m.name for m in cap.metrics}) == 50
+        finally:
+            local.shutdown()
+    finally:
+        glob.shutdown()
+
+
+def test_multi_member_without_gate_rejected():
+    with pytest.raises(ValueError):
+        read_config(data={
+            "forward_address": "a:1,b:1",
+            "forward_use_grpc": True,
+            "interval": "10s", "hostname": "l"})

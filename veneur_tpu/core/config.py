@@ -355,6 +355,15 @@ class Config:
     # header, missing headers just start no span.
     # VENEUR_TPU_TRACE_PROPAGATION=0 disables.
     tpu_trace_propagation: bool = True
+    # sharded global tier: split each flush's gRPC forward wire by
+    # route-key consistent hash across the comma-separated
+    # forward_address members (one bounded worker per destination),
+    # so the keyspace scales across M globals instead of funnelling
+    # into one.  M=1 routes byte-identically to the legacy single
+    # destination (the parity oracle).  gRPC forwards only; the HTTP
+    # path fails open to the legacy POST.
+    # VENEUR_TPU_SHARDED_GLOBAL=1 overrides.
+    tpu_sharded_global: bool = False
 
     def resolve_aliases(self) -> None:
         """Fold the reference's deprecated alias keys into their
@@ -444,6 +453,10 @@ class Config:
                 "yes", "no"):
             problems.append(
                 "tpu_collective_import must be auto, on or off")
+        if "," in self.forward_address and not self.tpu_sharded_global:
+            problems.append(
+                "multiple forward_address members need "
+                "tpu_sharded_global (the legacy path dials one)")
         if self.kafka_span_serialization_format not in ("protobuf",
                                                         "json"):
             problems.append(

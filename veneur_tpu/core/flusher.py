@@ -174,6 +174,11 @@ class FlushResult:
     row_accounting: dict = field(default_factory=lambda: {
         "staged_rows": 0, "emitted_rows": 0, "forwarded_rows": 0,
         "overlap_rows": 0, "retained_rows": 0})
+    # sharded forward: ``forwarded_rows`` above is the scalar total;
+    # when the tpu_sharded_global router splits the wire this records
+    # the per-destination counts (the ledger's seal holds
+    # ``forwarded == sum(split) + dropped`` against it)
+    forward_split: dict = field(default_factory=dict)
 
     def account_rows(self, staged: int = 0, emitted: int = 0,
                      forwarded: int = 0, overlap: int = 0,
@@ -184,6 +189,14 @@ class FlushResult:
         acct["forwarded_rows"] += int(forwarded)
         acct["overlap_rows"] += int(overlap)
         acct["retained_rows"] += int(retained)
+
+    def account_forward_split(self, split: dict) -> None:
+        """Fold one sharded forward's {destination: rows} routing
+        outcome into the result (runs from the forward stage, after
+        ``account_rows`` already counted the scalar total)."""
+        for dest, n in split.items():
+            self.forward_split[dest] = (
+                self.forward_split.get(dest, 0) + int(n))
 
     def metric_count(self) -> int:
         return len(self.metrics) + (len(self.frame)

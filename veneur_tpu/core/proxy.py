@@ -383,7 +383,9 @@ class ProxyServer:
         # the shared executor's work queue is unbounded, so the legacy
         # path never busy-drops: every routed item is enqueued
         self.ledger.credit_route(routed=routed, dropped=dropped,
-                                 enqueued=routed)
+                                 enqueued=routed,
+                                 per_dest={d: len(b)
+                                           for d, b in groups.items()})
         wire_ctx = self._finish_route_span(span)
         for dest, batch in groups.items():
             self._pool.submit(self._send_grpc, dest, batch, wire_ctx)
@@ -445,7 +447,9 @@ class ProxyServer:
             self.bump("busy_dropped", busy)
         self.ledger.credit_route(routed=routed.routed,
                                  dropped=routed.dropped,
-                                 enqueued=enqueued, busy_dropped=busy)
+                                 enqueued=enqueued, busy_dropped=busy,
+                                 per_dest={routed.members[d]: n
+                                           for d, _, n in routed.batches})
 
     def _metric_send_result(self, dest: str, n_items: int, err,
                             retries: int) -> None:
@@ -553,7 +557,9 @@ class ProxyServer:
         if dropped:
             self.bump("metrics_dropped", dropped)
         self.ledger.credit_route(routed=routed, dropped=dropped,
-                                 enqueued=routed)
+                                 enqueued=routed,
+                                 per_dest={d: len(b)
+                                           for d, b in groups.items()})
         wire_ctx = self._finish_route_span(span)
         for dest, batch in groups.items():
             self._pool.submit(self._send_http, dest, batch, wire_ctx)
@@ -599,7 +605,9 @@ class ProxyServer:
         if busy:
             self.bump("busy_dropped", busy)
         self.ledger.credit_route(routed=routed, dropped=dropped,
-                                 enqueued=enqueued, busy_dropped=busy)
+                                 enqueued=enqueued, busy_dropped=busy,
+                                 per_dest={snap.members[d]: len(idxs)
+                                           for d, idxs in groups})
         return True
 
     # -- persistent per-destination HTTP connections -------------------

@@ -319,6 +319,10 @@ class Server:
         self.grpc_servers: list = []
         self.grpc_ports: list[int] = []
         self._grpc_client = None
+        # sharded global forward (tpu_sharded_global): consistent-hash
+        # split of the forward wire across the comma-separated
+        # forward_address members, lazily built on first forward
+        self._sharded_fwd = None
 
         if getattr(config, "tpu_warmup", False) and \
                 hasattr(self.table, "take_staged"):
@@ -1554,11 +1558,16 @@ class Server:
             # runs on the pool; the forward stage span hangs off the
             # same cycle root (stage timing is lock-guarded).  The
             # forward span's (trace_id, span_id) ride the wire so the
-            # receiving tier parents its import span under it.
+            # receiving tier parents its import span under it; the
+            # sharded path re-stamps a CHILD span per destination so
+            # /debug/trace renders one forward branch per shard.
             with cyc.stage("forward") as sp:
                 sp.add_tag("rows", str(len(rows)))
-                self._forward(rows, trace_ctx=cyc.wire_context(sp),
-                              led=led)
+                split = self._forward(
+                    rows, trace_ctx=cyc.wire_context(sp), led=led,
+                    cyc=cyc, span=sp)
+                if split:
+                    res.account_forward_split(split)
 
         with cyc.stage("sink_flush"):
             fanout_tasks = []
@@ -1722,21 +1731,34 @@ class Server:
                     "the CPU backend so metrics keep flowing", why)
         jax.config.update("jax_platforms", "cpu")
 
-    def _forward(self, rows, trace_ctx=None, led=None) -> None:
+    def _forward(self, rows, trace_ctx=None, led=None, cyc=None,
+                 span=None):
         """Ship mergeable state upstream over gRPC or HTTP (reference
         flusher.go:82-99: forwardGRPC when configured, else
         flushForward; errors dropped-and-counted, never retried).
         ``trace_ctx`` is the flush cycle's (trace_id, span_id) stamped
         onto the wire for cross-tier stitching; ``led`` is the closed
         interval's ledger record (wire outcomes credit it
-        asynchronously, possibly after seal)."""
+        asynchronously, possibly after seal).  ``cyc``/``span`` are
+        the flush cycle and its forward stage span — the sharded path
+        hangs one child span per destination off ``span``.  Returns
+        the per-destination row split when the sharded router ran,
+        else None."""
         t0 = time.monotonic_ns()
         if not getattr(self.config, "tpu_trace_propagation", True):
             trace_ctx = None
         try:
             if self.config.forward_use_grpc:
+                fwd = self._sharded_forwarder()
+                if fwd is not None:
+                    return self._forward_sharded(
+                        fwd, rows, trace_ctx, led, cyc, span)
                 self._forward_grpc(rows, trace_ctx, led)
-                return
+                return None
+            if getattr(self.config, "tpu_sharded_global", False):
+                # the split rides MetricList wires; HTTP JSON has no
+                # record-span router — fail open to the legacy POST
+                self.bump("sharded_forward_fallbacks")
             self._forward_http(rows, trace_ctx, led)
         except Exception as e:
             # encoding bugs / missing grpcio / anything: forwarding
@@ -1750,6 +1772,113 @@ class Server:
             self.bump("forward_duration_ns",
                       time.monotonic_ns() - t0)
             self.bump("forward_post_metrics", len(rows))
+        return None
+
+    def _sharded_forwarder(self):
+        """The lazily-built ShardedForwarder when tpu_sharded_global
+        is on (gRPC mode only); None keeps the legacy single-global
+        path, which stays the M=1 parity oracle."""
+        if not getattr(self.config, "tpu_sharded_global", False):
+            return None
+        if self._sharded_fwd is None:
+            from veneur_tpu.forward.shard import ShardedForwarder
+            addrs = [a.strip()
+                     for a in self.config.forward_address.split(",")
+                     if a.strip()]
+            self._sharded_fwd = ShardedForwarder(
+                addrs, compression=float(self.config.tpu_compression),
+                credentials=self._forward_grpc_credentials())
+        return self._sharded_fwd
+
+    def _forward_sharded(self, fwd, rows, trace_ctx, led, cyc,
+                         span) -> dict:
+        """Split the flush's forward wire by route-key hash across the
+        global ring and fan the per-destination bodies out on their
+        workers.  Synchronous routing counts credit the ledger's
+        forward split (seal checks forwarded == sum per-dest +
+        dropped); wire outcomes land via worker callbacks.  The tail
+        waits for this flush's wires within the interval budget — the
+        M sends overlap (the fan-out win) and the legacy path's
+        send-within-the-flush semantics hold, but a wedged shard can
+        only eat its slice of the budget, never stall the next tick.
+        Returns {dest: rows} for the flush result's accounting."""
+        data = fwd.serialize(rows)
+        routed = None
+        try:
+            routed = fwd.route(data)
+        except Exception:
+            log.exception("columnar forward route failed; falling "
+                          "back to the per-row path")
+        if routed is not None:
+            batches = [(routed.members[d], body, n)
+                       for d, body, n in routed.batches]
+            if routed.dropped:
+                self.bump("metrics_dropped", routed.dropped)
+                if led is not None:
+                    self.ledger.credit_forward_split(
+                        led, dropped=routed.dropped)
+        else:
+            self.bump("sharded_route_fallbacks")
+            batches = fwd.route_rows_scalar(rows)
+        split: dict[str, int] = {}
+        done: list[threading.Event] = []
+        for dest, body, n in batches:
+            ch = None
+            if cyc is not None and span is not None:
+                ch = cyc.child(span, "forward.shard",
+                               {"dest": dest, "rows": str(n)})
+            wire_ctx = trace_ctx
+            if trace_ctx and ch is not None and ch.trace_id:
+                # per-destination child ids: each shard's wire parents
+                # the remote import span under its OWN branch
+                wire_ctx = (ch.trace_id, ch.span_id)
+
+            landed = threading.Event()
+
+            def _result(dest, n_items, err, retries, ch=ch,
+                        nbytes=len(body), landed=landed):
+                if err is None:
+                    if led is not None:
+                        self.ledger.credit_forward_wire(
+                            led, rows=n_items, nbytes=nbytes)
+                else:
+                    self.bump("metrics_dropped", n_items)
+                    self.bump("forward_errors")
+                    if led is not None:
+                        self.ledger.credit_forward_wire(led, errors=1)
+                if ch is not None:
+                    if err is not None:
+                        ch.set_error(err)
+                    if retries:
+                        ch.add_tag("retries", str(retries))
+                    if cyc is not None:
+                        cyc.finish(ch)
+                landed.set()
+
+            if fwd.send(dest, body, n, trace_context=wire_ctx,
+                        on_result=_result):
+                self.bump("forward_shard_wires")
+                split[dest] = split.get(dest, 0) + n
+                done.append(landed)
+                if led is not None:
+                    self.ledger.credit_forward_split(led, dest, n)
+            else:
+                # bounded-queue busy-drop: the wedged shard loses its
+                # own wire, the other destinations sail on
+                self.bump("forward_busy_dropped", n)
+                self.bump("metrics_dropped", n)
+                if led is not None:
+                    self.ledger.credit_forward_split(led, dropped=n)
+                if ch is not None:
+                    ch.add_tag("busy_dropped", "true")
+                    ch.set_error(True)
+                    if cyc is not None:
+                        cyc.finish(ch)
+        deadline = time.monotonic() + max(self.interval * 0.9, 1.0)
+        for landed in done:
+            if not landed.wait(max(0.0, deadline - time.monotonic())):
+                self.bump("forward_shard_overruns")
+        return split
 
     def _forward_http(self, rows, trace_ctx=None, led=None) -> None:
         if self.config.forward_json_schema == "reference":
@@ -1890,6 +2019,8 @@ class Server:
                 pass
         if self._grpc_client is not None:
             self._grpc_client.close()
+        if self._sharded_fwd is not None:
+            self._sharded_fwd.stop()
         for s in self.metric_sinks + self.span_sinks:
             if hasattr(s, "stop"):
                 try:

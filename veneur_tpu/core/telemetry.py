@@ -180,6 +180,20 @@ class Telemetry:
                   tally.get(mtype, 0), (f"metric_type:{mtype}",))
         count("veneur.forward.post_metrics_total",
               self._delta("forward_post_metrics"))
+        # sharded global forward (tpu_sharded_global): per-destination
+        # wires shipped, items busy-dropped on a wedged shard's
+        # bounded queue, and fail-open takes (columnar router ->
+        # per-row path, or sharded -> legacy single-destination)
+        count("veneur.forward.shard.wires_total",
+              self._delta("forward_shard_wires"))
+        count("veneur.forward.shard.busy_dropped_total",
+              self._delta("forward_busy_dropped"))
+        count("veneur.forward.shard.fallback_total",
+              self._delta("sharded_route_fallbacks"),
+              ("reason:route",))
+        count("veneur.forward.shard.fallback_total",
+              self._delta("sharded_forward_fallbacks"),
+              ("reason:forward",))
         sentry_client = getattr(self.server, "sentry", None)
         if sentry_client is not None:
             # reference sentry.go:61 reports sentry.errors_total per
@@ -291,7 +305,10 @@ class Telemetry:
                   rec.forwarded_rows)
             count("veneur.ledger.owed_total",
                   abs(rec.owed) + abs(rec.staged_drift)
-                  + abs(rec.overflow_drift) + abs(rec.rows_owed))
+                  + abs(rec.overflow_drift) + abs(rec.rows_owed)
+                  + abs(rec.split_owed))
+            count("veneur.ledger.forward_split_dropped_total",
+                  rec.forward_split_dropped)
             count("veneur.ledger.imbalance_total",
                   self._delta("ledger_imbalance"))
 

@@ -1,0 +1,165 @@
+"""Sharded global forward: consistent-hash keyspace split of the
+local tier's forward wire across M global destinations.
+
+Every keyspace used to funnel into the ONE node named by
+``forward_address`` — the last serial hop after the ingest path, the
+flush pipeline and the proxy hop all went columnar/parallel.  Gated by
+``tpu_sharded_global`` (``VENEUR_TPU_SHARDED_GLOBAL``), the flush's
+forward rows are serialized ONCE into a MetricList wire and split by
+route-key hash across the comma-separated ``forward_address`` members,
+reusing the proxy's vectorized routing machinery end to end:
+
+- ``route_metric_list`` — native columnar decode + ``vtpu_proxy_keyhash``
+  off-the-wire hashing + ``ConsistentRing.assign`` owner vectors +
+  ``vtpu_metriclist_spans`` ragged byte gather into per-destination
+  MetricList bodies (plain slices of one destination-major blob)
+- ``DestinationPool`` — one bounded worker per global, so a wedged
+  shard busy-drops its own wires instead of stalling the others
+- ``ForwardClient.send_wire`` — the pre-serialized bodies go out
+  verbatim on cached per-destination channels
+
+With M=1 the routed body is the concatenation of every record span in
+wire order — byte-identical to the legacy single-global send (pinned
+as the parity oracle in tests).  When the native router can't run the
+scalar fallback groups rows by the same ``name|type|tags`` key the
+wire hasher streams (``row_route_key``), so the split survives with
+identical ownership, just slower.
+
+Mergeable sketches make the split safe: counters/sets/digest unions
+are order-independent CRDT merges, so M independent globals each own
+an exact subset of the keyspace (see ISSUE 10 / ROADMAP item 1).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from veneur_tpu.forward.destpool import DestinationPool
+from veneur_tpu.forward.ring import ConsistentRing
+from veneur_tpu.forward.route import _TYPE_NAMES, RoutedWire
+
+log = logging.getLogger("veneur_tpu.forward.shard")
+
+
+def row_route_key(row) -> str:
+    """The routing identity of one ForwardRow — exactly the
+    ``name|type|tags`` key ``vtpu_proxy_keyhash`` streams off the
+    serialized wire (and the proxy's ``_pb_key`` builds per item), so
+    the scalar fallback assigns every row to the same owner the
+    columnar path would."""
+    from veneur_tpu.forward.grpc_forward import _TYPE_TO_PB
+    tname = _TYPE_NAMES[int(_TYPE_TO_PB[row.meta.type])].decode()
+    return f"{row.meta.name}|{tname}|{','.join(row.meta.tags)}"
+
+
+class ShardedForwarder:
+    """Route one flush's forward wire across the M-member global ring.
+
+    Owns the ring over the destination set, the per-destination
+    bounded workers, and the cached gRPC clients; the server drives it
+    from the ``flush.forward`` stage and keeps all stats/ledger/trace
+    crediting to itself (callbacks), so this stays a pure routing +
+    shipping surface that tests can drive without a Server.
+    """
+
+    def __init__(self, addresses, compression: float = 100.0,
+                 credentials=None, timeout: float = 10.0,
+                 queue_size: int = 8, retries: int = 2,
+                 backoff: float = 0.25):
+        self.addresses = tuple(addresses)
+        if not self.addresses:
+            raise ValueError("sharded forward needs >= 1 destination")
+        self.compression = float(compression)
+        self._credentials = credentials
+        self._timeout = timeout
+        self.ring = ConsistentRing(self.addresses)
+        self.pool = DestinationPool(queue_size=queue_size,
+                                    retries=retries, backoff=backoff)
+        self._clients: dict[str, object] = {}
+        self._clients_lock = threading.Lock()
+
+    # -- wire assembly + routing ---------------------------------------
+
+    def serialize(self, rows) -> bytes:
+        """One MetricList wire for the whole flush — the single
+        serialization every destination's body is then a byte-gather
+        of."""
+        from veneur_tpu.forward.grpc_forward import rows_to_metric_list
+        return rows_to_metric_list(
+            rows, self.compression).SerializeToString()
+
+    def route(self, data: bytes) -> RoutedWire | None:
+        """Columnar split of a serialized MetricList by route-key hash;
+        None when the native path can't run (caller falls back to
+        :meth:`route_rows_scalar`)."""
+        from veneur_tpu.forward.route import route_metric_list
+        return route_metric_list(data, self.ring)
+
+    def route_rows_scalar(self, rows) -> list[tuple[str, bytes, int]]:
+        """Per-row oracle fallback: group rows by the ring owner of
+        ``row_route_key`` and serialize one MetricList per
+        destination.  Same ownership as :meth:`route`, kept as the
+        fail-open path and the parity oracle."""
+        from veneur_tpu.forward.grpc_forward import rows_to_metric_list
+        groups: dict[str, list] = {}
+        for row in rows:
+            groups.setdefault(
+                self.ring.get(row_route_key(row)), []).append(row)
+        return [(dest,
+                 rows_to_metric_list(
+                     batch, self.compression).SerializeToString(),
+                 len(batch))
+                for dest, batch in groups.items()]
+
+    # -- shipping ------------------------------------------------------
+
+    def client(self, dest: str):
+        with self._clients_lock:
+            cl = self._clients.get(dest)
+            if cl is None:
+                from veneur_tpu.forward.grpc_forward import \
+                    ForwardClient
+                cl = ForwardClient(dest, timeout=self._timeout,
+                                   credentials=self._credentials,
+                                   compression=self.compression)
+                self._clients[dest] = cl
+        return cl
+
+    def send(self, dest: str, body: bytes, n_items: int,
+             trace_context=None, on_result=None) -> bool:
+        """Enqueue one destination's body on its worker; False is a
+        busy-drop (bounded queue full — the wedged-shard isolation).
+        ``on_result(dest, n_items, err, retries)`` fires after the
+        final attempt."""
+        from veneur_tpu.forward.grpc_forward import (SPAN_ID_KEY,
+                                                     TRACE_ID_KEY)
+        metadata = None
+        if trace_context and trace_context[0] and trace_context[1]:
+            metadata = ((TRACE_ID_KEY, str(trace_context[0])),
+                        (SPAN_ID_KEY, str(trace_context[1])))
+
+        def _ship(dest=dest, body=body, metadata=metadata):
+            self.client(dest).send_wire(body, metadata=metadata)
+
+        return self.pool.submit(dest, _ship, n_items=n_items,
+                                on_result=on_result)
+
+    # -- lifecycle / introspection -------------------------------------
+
+    def stats(self) -> dict:
+        return self.pool.stats()
+
+    def totals(self) -> dict:
+        return self.pool.totals()
+
+    def stop(self) -> None:
+        self.pool.stop()
+        with self._clients_lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for cl in clients:
+            try:
+                cl.close()
+            except Exception:
+                pass

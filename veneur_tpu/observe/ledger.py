@@ -95,6 +95,13 @@ class LedgerRecord:
     overlap_rows: int = 0    # rows that both emit locally AND forward
     retained_rows: int = 0   # rows that did neither (scope-gated out)
     emitted_per_sink: dict[str, int] = field(default_factory=dict)
+    # -- sharded-forward split (synchronous at route time): every
+    #    forwarded row lands in exactly one destination's count or in
+    #    ``forward_split_dropped`` (busy-drop/no-owner), so a dropped
+    #    SHARD — not just a dropped interval — breaks the seal check
+    #    ``forwarded == sum(dests) + dropped`` below
+    forward_split: dict[str, int] = field(default_factory=dict)
+    forward_split_dropped: int = 0
     # -- wire outcomes (async; informational, not balance inputs) ------
     forward_wire_rows: int = 0
     forward_wire_bytes: int = 0
@@ -109,6 +116,7 @@ class LedgerRecord:
     staged_drift: int = 0    # site-credited staged - table staged
     overflow_drift: int = 0  # site-credited overflow - table overflow
     rows_owed: int = 0       # staged rows unaccounted for at flush
+    split_owed: int = 0      # forwarded rows no destination accounts for
 
     def received_total(self) -> int:
         return sum(self.received.values())
@@ -137,6 +145,9 @@ class LedgerRecord:
                      "overlap": self.overlap_rows,
                      "retained": self.retained_rows},
             "emitted_per_sink": dict(self.emitted_per_sink),
+            "forward_split": {"per_dest": dict(self.forward_split),
+                              "dropped": self.forward_split_dropped,
+                              "owed": self.split_owed},
             "forward_wire": {"rows": self.forward_wire_rows,
                              "bytes": self.forward_wire_bytes,
                              "errors": self.forward_errors},
@@ -218,6 +229,20 @@ class Ledger:
             rec.retained_rows += int(
                 accounting.get("retained_rows", 0))
 
+    def credit_forward_split(self, rec: LedgerRecord,
+                             dest: str | None = None, rows: int = 0,
+                             dropped: int = 0) -> None:
+        """Credit the sharded forward's routing decision for one
+        destination: ``rows`` assigned to ``dest`` (or ``dropped``
+        rows no worker accepted).  Synchronous at route time — a
+        balance input, unlike the async wire outcomes — so seal can
+        hold ``forwarded == sum(dests) + dropped`` per interval."""
+        with self._lock:
+            if dest is not None and rows:
+                rec.forward_split[dest] = (
+                    rec.forward_split.get(dest, 0) + int(rows))
+            rec.forward_split_dropped += int(dropped)
+
     def credit_sink(self, rec: LedgerRecord, name: str,
                     metrics: int) -> None:
         with self._lock:
@@ -254,9 +279,18 @@ class Ledger:
             rec.rows_owed = rec.staged_rows - (
                 rec.emitted_rows + rec.forwarded_rows
                 - rec.overlap_rows + rec.retained_rows)
+            # sharded-forward conservation: only checked when the
+            # router credited a split this interval (the legacy
+            # single-destination path never does), so a forward that
+            # overran the interval budget can't fake an imbalance
+            if rec.forward_split or rec.forward_split_dropped:
+                rec.split_owed = rec.forwarded_rows - (
+                    sum(rec.forward_split.values())
+                    + rec.forward_split_dropped)
             rec.balanced = (rec.owed == 0 and rec.staged_drift == 0
                             and rec.overflow_drift == 0
-                            and rec.rows_owed == 0)
+                            and rec.rows_owed == 0
+                            and rec.split_owed == 0)
             rec.sealed = True
             self._ring.append(rec)
             if not rec.balanced:
@@ -265,10 +299,11 @@ class Ledger:
             msg = ("ledger imbalance node=%s seq=%d: owed=%d samples "
                    "(received=%d staged=%d status=%d overflow=%d "
                    "invalid=%d) staged_drift=%d overflow_drift=%d "
-                   "rows_owed=%d")
+                   "rows_owed=%d split_owed=%d")
             args = (self.node, rec.seq, rec.owed, rec.received_total(),
                     rec.staged, rec.status, rec.overflow, rec.invalid,
-                    rec.staged_drift, rec.overflow_drift, rec.rows_owed)
+                    rec.staged_drift, rec.overflow_drift, rec.rows_owed,
+                    rec.split_owed)
             if self.strict:
                 log.error(msg, *args)
             else:
@@ -316,6 +351,16 @@ class Ledger:
             "retained_rows_total": sum(
                 r.retained_rows for r in recs),
         }
+        if any(r.forward_split or r.forward_split_dropped
+               for r in recs):
+            per_dest: dict[str, int] = {}
+            for r in recs:
+                for dest, n in r.forward_split.items():
+                    per_dest[dest] = per_dest.get(dest, 0) + n
+            out["forward_split_per_dest"] = per_dest
+            out["forward_split_total"] = sum(per_dest.values())
+            out["forward_split_dropped_total"] = sum(
+                r.forward_split_dropped for r in recs)
         return out
 
 
@@ -343,6 +388,10 @@ class ProxyLedgerRecord:
     dropped: int = 0
     enqueued: int = 0
     busy_dropped: int = 0
+    # per-destination routed split (same role as the server ledger's
+    # forward_split: a shard silently losing its wires shows up as a
+    # skewed/missing destination, not just a shrunken total)
+    routed_per_dest: dict[str, int] = field(default_factory=dict)
     sent_items: int = 0
     error_items: int = 0
     retries: int = 0
@@ -359,6 +408,7 @@ class ProxyLedgerRecord:
             "dropped": self.dropped,
             "enqueued": self.enqueued,
             "busy_dropped": self.busy_dropped,
+            "routed_per_dest": dict(self.routed_per_dest),
             "wire": {"sent_items": self.sent_items,
                      "error_items": self.error_items,
                      "retries": self.retries},
@@ -394,7 +444,8 @@ class ProxyLedger:
 
     def credit_route(self, routed: int = 0, dropped: int = 0,
                      enqueued: int = 0, busy_dropped: int = 0,
-                     fallbacks: int = 0) -> None:
+                     fallbacks: int = 0,
+                     per_dest: dict | None = None) -> None:
         with self._lock:
             cur = self._cur
             cur.routed += int(routed)
@@ -402,6 +453,10 @@ class ProxyLedger:
             cur.enqueued += int(enqueued)
             cur.busy_dropped += int(busy_dropped)
             cur.fallbacks += int(fallbacks)
+            if per_dest:
+                for dest, n in per_dest.items():
+                    cur.routed_per_dest[dest] = (
+                        cur.routed_per_dest.get(dest, 0) + int(n))
 
     def credit_send(self, sent_items: int = 0, error_items: int = 0,
                     retries: int = 0) -> None:
@@ -459,6 +514,10 @@ class ProxyLedger:
         ``Ledger.summary``: intervals/balanced/imbalanced/
         owed_total)."""
         recs = self.records()
+        per_dest: dict[str, int] = {}
+        for r in recs:
+            for dest, n in r.routed_per_dest.items():
+                per_dest[dest] = per_dest.get(dest, 0) + n
         return {
             "intervals": len(recs),
             "balanced": sum(1 for r in recs if r.balanced),
@@ -471,4 +530,5 @@ class ProxyLedger:
             "sent_items_total": sum(r.sent_items for r in recs),
             "error_items_total": sum(r.error_items for r in recs),
             "fallbacks_total": sum(r.fallbacks for r in recs),
+            "routed_per_dest": per_dest,
         }

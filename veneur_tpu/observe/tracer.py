@@ -48,6 +48,12 @@ class _NullSpan:
     def add_tag(self, key, value):
         pass
 
+    def set_error(self, err=True):
+        pass
+
+    def finish(self, client=None):
+        return None
+
 
 class NullCycle:
     """Stage spans are no-ops; readback bytes still count."""
@@ -57,6 +63,12 @@ class NullCycle:
     @contextlib.contextmanager
     def stage(self, name: str, alias: str | None = None):
         yield _NullSpan()
+
+    def child(self, parent, name: str, tags=None):
+        return _NullSpan()
+
+    def finish(self, span) -> None:
+        pass
 
     def add_readback(self, nbytes: int) -> None:
         REGISTRY.add_readback(nbytes)
@@ -113,6 +125,26 @@ class FlushCycle:
             sp.finish(self._client)
             if self._index is not None:
                 self._index.add(sp.proto)
+
+    def child(self, parent, name: str, tags=None):
+        """A live child span under ``parent`` (a stage span), for
+        sub-stage work that outlives the stage block — e.g. one span
+        per sharded-forward destination, so ``/debug/trace/<id>``
+        renders M forward branches instead of M wires sharing the one
+        ``flush.forward`` span id.  Callers finish it with
+        :meth:`finish` (safe from destination-worker threads)."""
+        sp = parent.child(f"flush.{name}")
+        sp.add_tag("veneur.internal", "true")
+        for k, v in (tags or {}).items():
+            sp.add_tag(k, v)
+        return sp
+
+    def finish(self, span) -> None:
+        """Record a :meth:`child` span to the trace client + debug
+        index (mirrors the tail of :meth:`stage`)."""
+        span.finish(self._client)
+        if self._index is not None:
+            self._index.add(span.proto)
 
     def add_readback(self, nbytes: int) -> None:
         self._registry.add_readback(nbytes)

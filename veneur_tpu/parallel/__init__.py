@@ -3,6 +3,7 @@
 ``sharded`` for the design)."""
 
 from veneur_tpu.parallel.sharded import (  # noqa: F401
-    SHARD, SERIES, ShardedAggregator, ShardedConfig, ShardedTable,
-    empty_state, make_merge_step, make_mesh, make_update_step,
-    readout)
+    SHARD, SERIES, CollectiveWireFold, ShardedAggregator,
+    ShardedConfig, ShardedTable, empty_state, init_process_mesh,
+    make_import_mesh, make_merge_step, make_mesh, make_update_step,
+    mesh_process_count, readout)

@@ -333,9 +333,57 @@ def readout(merged: dict, qs: np.ndarray) -> dict:
 def make_import_mesh(devices=None) -> Mesh:
     """1D all-``shard`` mesh for the collective import fold: every
     device folds wires, the series axis stays size 1 because the
-    import table's planes live replicated (one host-side table)."""
+    import table's planes live replicated (one host-side table).
+
+    ``jax.devices()`` is the GLOBAL device list, so after
+    :func:`init_process_mesh` this same constructor yields a mesh that
+    spans every process of a ``jax.distributed`` job — the fold's
+    all_gather then rides the cross-process (DCN) axis with no code
+    change in the fold body itself."""
     devs = np.asarray(devices if devices is not None else jax.devices())
     return Mesh(devs.reshape(devs.size, 1), (SHARD, SERIES))
+
+
+def init_process_mesh(coordinator_address: str | None = None,
+                      num_processes: int | None = None,
+                      process_id: int | None = None) -> bool:
+    """Join a multi-process ``jax.distributed`` job so one global
+    "node" can span hosts/slices (ROADMAP item 1: the DCN-distributed
+    collective merge).
+
+    Arguments default from the operator env knobs
+    ``VENEUR_TPU_DIST_COORDINATOR`` (host:port of process 0),
+    ``VENEUR_TPU_DIST_NUM_PROCS`` and ``VENEUR_TPU_DIST_PROCESS_ID``.
+    Returns False (single-process mode) when no coordinator is
+    configured.  On the CPU backend the cross-process collective
+    implementation must be selected BEFORE the backend initializes —
+    XLA:CPU refuses multi-process computations under the default
+    ("Multiprocess computations aren't implemented on the CPU
+    backend"), so this flips ``jax_cpu_collectives_implementation`` to
+    gloo first.  Call before any other jax use in the process.
+    """
+    import os
+    coord = coordinator_address or os.environ.get(
+        "VENEUR_TPU_DIST_COORDINATOR", "")
+    if not coord:
+        return False
+    nproc = num_processes if num_processes is not None else int(
+        os.environ.get("VENEUR_TPU_DIST_NUM_PROCS", "0"))
+    pid = process_id if process_id is not None else int(
+        os.environ.get("VENEUR_TPU_DIST_PROCESS_ID", "-1"))
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # older/newer jaxlib without the knob: TPU paths need none
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=nproc or None,
+                               process_id=pid if pid >= 0 else None)
+    return True
+
+
+def mesh_process_count(mesh: Mesh) -> int:
+    """Number of distinct processes owning the mesh's devices."""
+    return len({d.process_index for d in mesh.devices.flat})
 
 
 class CollectiveWireFold:
@@ -360,12 +408,20 @@ class CollectiveWireFold:
     scan (tests pin this); in general it is an equally valid t-digest
     union of the same mass, which is why the serial path stays
     available as the oracle (VENEUR_TPU_COLLECTIVE_IMPORT=off).
+
+    The mesh may span PROCESSES: after :func:`init_process_mesh`,
+    ``make_import_mesh()`` covers every device of the
+    ``jax.distributed`` job, each process stages its own local wire
+    slice (``scatter_wires``), and the same all_gather union rides the
+    cross-process axis — one logical global node spread over
+    hosts/slices, bit-compatible with the single-host fold.
     """
 
     def __init__(self, mesh: Mesh,
                  compression: float = tdigest.DEFAULT_COMPRESSION):
         self.mesh = mesh
         self.n_shard = int(mesh.shape[SHARD])
+        self.n_proc = mesh_process_count(mesh)
         self.compression = comp = compression
 
         def fold(sub_m, sub_w, stack_m, stack_w, live):
@@ -410,15 +466,37 @@ class CollectiveWireFold:
 
     def pad_wires(self, n: int) -> int:
         """Wire-axis length the stack must pad to: a multiple of the
-        shard count, so every device scans an equal slice."""
-        s = self.n_shard
+        shard count, so every device scans an equal slice.  On a
+        multi-process mesh ``n`` is the PER-PROCESS local wire count
+        (every process must stage the same count) and the result is
+        the padded per-process length."""
+        s = self.n_shard // self.n_proc
         return ((max(n, 1) + s - 1) // s) * s
+
+    def scatter_wires(self, stack_m, stack_w, live):
+        """Assemble the mesh-global wire stack from this process's
+        local slice.  Single-process meshes pass through as device
+        arrays; on a multi-process mesh each process contributes its
+        own (equal-length, ``pad_wires``-padded) slice and the global
+        wire order is process-major — the cross-process twin of the
+        per-device split the shard_map applies within a host."""
+        if self.n_proc <= 1:
+            return (jnp.asarray(stack_m), jnp.asarray(stack_w),
+                    jnp.asarray(live))
+        sh = NamedSharding(self.mesh, P(SHARD))
+        return tuple(
+            jax.make_array_from_process_local_data(sh, np.asarray(x))
+            for x in (stack_m, stack_w, live))
 
     def __call__(self, means, weights, row_idx, stack_m, stack_w,
                  live):
-        return self._run(means, weights, row_idx,
-                         jnp.asarray(stack_m), jnp.asarray(stack_w),
-                         jnp.asarray(live))
+        # table planes ride in replicated (identical on every process
+        # of a distributed mesh — they're the shared global table);
+        # only the wire stack is scattered over the shard axis
+        stack_m, stack_w, live = self.scatter_wires(stack_m, stack_w,
+                                                    live)
+        return self._run(means, weights, row_idx, stack_m, stack_w,
+                         live)
 
 
 class ShardedAggregator:
