@@ -2568,6 +2568,392 @@ def cluster_bench() -> dict:
     return out
 
 
+def _chaos_local_loop(name: str, globals_: list, wires: list[bytes],
+                      n_iters: int, results: dict,
+                      inject: bool) -> None:
+    """One local's fault-aware drive loop for the chaos soak.  Same
+    route -> split -> ship -> ledger shape as ``_cluster_local_loop``
+    but with a fixed iteration count and (for the injected local) a
+    deterministic fault schedule: wire drops (one recovered by retry,
+    one fatal), a persistent wire delay, a stalled destination worker,
+    a discovery flap, and a global-shard kill followed two iters later
+    by the discovery reshard that routes around the corpse.  The
+    pass criterion is pure accounting — every routed item must land
+    on a shard or be attributed to a NAMED counter (wire error items,
+    busy drops, route drops), and every reshard's moved arcs must be
+    ledger-credited."""
+    import threading
+
+    from veneur_tpu.chaos.injector import WireFaultInjector, flap_member
+    from veneur_tpu.forward.shard import ShardedForwarder
+    from veneur_tpu.observe.ledger import Ledger
+    dests = [f"127.0.0.1:{g.port}" for g in globals_]
+    fwd = ShardedForwarder(dests, queue_size=4, retries=2,
+                           backoff=0.02)
+    inj = WireFaultInjector().install(fwd) if inject else None
+    led = Ledger(node=name)
+    attr_lock = threading.Lock()
+    r = {"name": name, "injected": inject, "iters": 0,
+         "routed_total": 0, "items_sent_total": 0, "wire_errors": 0,
+         "error_items": 0, "busy_dropped": 0, "route_dropped": 0,
+         "route_fallbacks": 0, "reshards": 0, "reshard_moved": 0,
+         "stall_pending_after_short_wait": 0, "faults": [],
+         "per_dest": {}}
+    pending: list = []
+    try:
+        for it in range(n_iters):
+            wait_s = 5.0
+            if inj is not None:
+                if it == 3:
+                    # one injected failure, recovered by retry
+                    inj.drop_wires(dests[0], 1)
+                    r["faults"].append("wire_drop_retry")
+                elif it == 5:
+                    # retries + 1 failures: the wire dies attributed
+                    inj.drop_wires(dests[0], 3)
+                    r["faults"].append("wire_drop_fatal")
+                elif it == 7:
+                    inj.delay_wires(dests[1], 0.03)
+                    r["faults"].append("wire_delay")
+                elif it == 9:
+                    inj.clear(dests[1])
+                    inj.stall_once(dests[2], 1.0)
+                    # don't absorb the stall in this iter's wait: the
+                    # pinned worker's wire rides ``pending`` instead,
+                    # proving the stall didn't block the other dests
+                    wait_s = 0.05
+                    r["faults"].append("dest_stall")
+                elif it == 12:
+                    flap_member(fwd, dests[1])
+                    r["faults"].append("discovery_flap")
+                elif it == 15:
+                    globals_[2].stop()
+                    r["faults"].append("shard_kill")
+                elif it == 17:
+                    # discovery notices the dead shard two iters
+                    # later; the in-between wires to it are wire
+                    # errors — attributed, not lost
+                    fwd.set_members(dests[:2])
+                    r["faults"].append("shard_kill_reshard")
+            data = wires[it % len(wires)]
+            rec = led.close_interval(seq=it + 1)
+            routed = fwd.route(data)
+            if routed is None:
+                r["route_fallbacks"] += 1
+                led.seal(rec)
+                continue
+            resh = fwd.take_reshard()
+            if resh is not None:
+                epoch, added, removed, prev = resh
+                prev_routed = fwd.route(data, ring=prev)
+                moved = 0
+                if prev_routed is not None:
+                    old = {prev_routed.members[d]: n
+                           for d, _b, n in prev_routed.batches}
+                    new = {routed.members[d]: n
+                           for d, _b, n in routed.batches}
+                    moved = sum(
+                        max(0, new.get(m, 0) - old.get(m, 0))
+                        for m in set(old) | set(new))
+                led.credit_reshard(rec, epoch, added, removed, moved)
+                r["reshards"] += 1
+                r["reshard_moved"] += moved
+            led.credit_rows(rec, {"staged_rows": routed.routed,
+                                  "forwarded_rows": routed.routed})
+            r["routed_total"] += routed.routed
+            r["route_dropped"] += routed.dropped
+            landed = []
+            for d, body, n in routed.batches:
+                dest = routed.members[d]
+                ev = threading.Event()
+
+                def _res(dest, n_items, err, retries, ev=ev,
+                         nbytes=len(body)):
+                    if err is None:
+                        led.credit_forward_wire(rec, rows=n_items,
+                                                nbytes=nbytes)
+                    else:
+                        with attr_lock:
+                            r["wire_errors"] += 1
+                            r["error_items"] += n_items
+                        led.credit_forward_wire(rec, errors=1)
+                    ev.set()
+
+                if fwd.send(dest, body, n, on_result=_res):
+                    led.credit_forward_split(rec, dest, n)
+                    r["per_dest"][dest] = \
+                        r["per_dest"].get(dest, 0) + n
+                    r["items_sent_total"] += n
+                    landed.append(ev)
+                else:
+                    with attr_lock:
+                        r["busy_dropped"] += n
+                    led.credit_forward_split(rec, dropped=n)
+            for ev in landed:
+                if not ev.wait(wait_s):
+                    if wait_s < 1.0:
+                        r["stall_pending_after_short_wait"] += 1
+                    pending.append(ev)
+            led.seal(rec)
+            r["iters"] = it + 1
+        # every wire must RESOLVE (land or error) before the
+        # conservation check reads the shards' intake
+        for ev in pending:
+            ev.wait(30.0)
+        # swap EVENTS can outnumber credited reshard records: a flap's
+        # down+up burst merges into one pending record (oldest
+        # prev-ring survives) — that merge is the design, so report
+        # both counts
+        r["reshard_events"] = fwd.discovery_stats()["reshards"]
+    finally:
+        fwd.stop()
+    r["ledger"] = led.summary()
+    results[name] = r
+
+
+def _chaos_model_soak(n_iters: int, rows_per_iter: int,
+                      pool_wires: int) -> dict:
+    """Model-shard half of ``--chaos``: two locals drive the sharded
+    forward path against three ``_ModelGlobal`` shards while the four
+    fault kinds fire on one of them (the other stays clean — it still
+    rides through the shard kill, taking attributed wire errors).
+    The headline is the attribution identity: routed == accepted +
+    error_items + busy_dropped exactly, with the at-least-once
+    caveat that a kill mid-RPC can double-deliver (reported as
+    ``overdelivered``, never as a loss)."""
+    import threading
+    globals_ = [_ModelGlobal(20.0) for _ in range(3)]
+    results: dict = {}
+    try:
+        pools = {n: _cluster_wire_pool(n, pool_wires, rows_per_iter)
+                 for n in ("c0", "c1")}
+        threads = [
+            threading.Thread(
+                target=_chaos_local_loop,
+                args=("c0", globals_, pools["c0"], n_iters, results,
+                      True), daemon=True),
+            threading.Thread(
+                target=_chaos_local_loop,
+                args=("c1", globals_, pools["c1"], n_iters, results,
+                      False), daemon=True),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        globals_out = [g.summary() for g in globals_]
+    finally:
+        for g in globals_:
+            g.stop()
+    locals_out = [results[n] for n in sorted(results)]
+    routed = sum(l["routed_total"] for l in locals_out)
+    accepted = sum(g["accepted"] for g in globals_out)
+    error_items = sum(l["error_items"] for l in locals_out)
+    busy = sum(l["busy_dropped"] for l in locals_out)
+    attributed = accepted + error_items + busy
+    faults = sorted({f for l in locals_out for f in l["faults"]})
+    return {
+        "n_iters": n_iters,
+        "rows_per_iter": rows_per_iter,
+        "faults_injected": faults,
+        "items_routed": routed,
+        "items_accepted": accepted,
+        "items_error_attributed": error_items,
+        "items_busy_dropped": busy,
+        "route_dropped": sum(l["route_dropped"] for l in locals_out),
+        # > 0 would be silent loss; < 0 is at-least-once
+        # double-delivery from the kill window (attributed below)
+        "unattributed_lost": max(routed - attributed, 0),
+        "overdelivered": max(attributed - routed, 0),
+        "reshards": sum(l["reshards"] for l in locals_out),
+        "reshard_events": sum(l.get("reshard_events", 0)
+                              for l in locals_out),
+        "reshard_moved_rows": sum(l["reshard_moved"]
+                                  for l in locals_out),
+        "route_fallbacks": sum(l["route_fallbacks"]
+                               for l in locals_out),
+        "ledgers_balanced": (
+            all(l["ledger"]["imbalanced"] == 0 for l in locals_out)
+            and all(g["ledger"]["imbalanced"] == 0
+                    for g in globals_out)),
+        "locals": locals_out,
+        "globals": globals_out,
+    }
+
+
+def _chaos_e2e(n_histo: int, n_sets: int) -> dict:
+    """Real-server half of ``--chaos``: one local Server forwarding
+    sharded over loopback gRPC to two global Servers.  Proves, on the
+    production code path, the three properties the model soak can't:
+    the cross-process trace tree stays stitched (the survivor's
+    ``import`` span parents under the local's forward span), a shard
+    kill + discovery reshard loses no interval, and a rolling-restart
+    drain hands staged samples to the surviving global flagged
+    ``drain`` — cluster-wide conservation holds across all three."""
+    from veneur_tpu.core.config import read_config
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.protocol import dogstatsd as dsd
+
+    globals_ = []
+    for gi in range(2):
+        g = Server(read_config(data={
+            "grpc_listen_addresses": ["tcp://127.0.0.1:0"],
+            "interval": "10s", "hostname": f"chaos-g{gi}",
+            "accelerator_probe_timeout": "5s"}))
+        g.start()
+        globals_.append(g)
+    addrs = [f"127.0.0.1:{g.grpc_ports[0]}" for g in globals_]
+    l = Server(read_config(data={
+        "statsd_listen_addresses": [],
+        "forward_address": ",".join(addrs),
+        "forward_use_grpc": True,
+        "tpu_sharded_global": True,
+        "interval": "10s", "hostname": "chaos-l0",
+        "accelerator_probe_timeout": "5s"}))
+    l.start()
+    rng = np.random.default_rng(23)
+    out: dict = {"n_histo": n_histo, "n_sets": n_sets}
+    local_down = False
+    try:
+        def stage():
+            rows = np.repeat(np.arange(n_histo, dtype=np.int32), 16)
+            vals = rng.gamma(2.0, 30.0, len(rows)).astype(np.float32)
+            for i in range(n_histo):
+                l.table.ingest(dsd.Sample(
+                    name=f"chaos.lat.{i}", type=dsd.TIMER, value=1.0))
+            l.table._histo_stage.append(
+                rows, vals, np.ones(len(rows), np.float32))
+            for i in range(n_sets * 4):
+                l.table.ingest(dsd.Sample(
+                    name=f"chaos.uniq.{i % n_sets}", type=dsd.SET,
+                    value=f"m{i}".encode()))
+            l.ledger.ingest("bench-stage",
+                            processed=n_histo + n_sets * 4,
+                            staged=n_histo + n_sets * 4)
+            l.table.device_step()
+
+        def intake():
+            return sum(g.stats.get("imports_received", 0)
+                       for g in globals_)
+
+        def wait_intake(expect, budget=60.0):
+            deadline = time.monotonic() + budget
+            while (intake() < expect and
+                   time.monotonic() < deadline):
+                time.sleep(0.02)
+            return intake()
+
+        per_flush = n_histo + n_sets
+        # healthy baseline flush + the trace-stitch proof
+        stage()
+        l.flush_once()
+        base = wait_intake(per_flush)
+        if base < per_flush:
+            out["error"] = "baseline flush never reached the globals"
+            return out
+        tids = l.trace_index.trace_ids()
+        tid = tids[-1] if tids else 0
+        import_spans = [s for g in globals_
+                        for s in (g.trace_index.get(tid)
+                                  if tid else [])]
+        out["trace_id"] = tid
+        out["import_spans"] = len(import_spans)
+        out["trace_stitched"] = any(
+            s.get("name") == "import" and s.get("parent_id")
+            for s in import_spans)
+
+        # fault: kill one global mid-soak, discovery reshards the
+        # survivor in; the next interval must land whole
+        globals_[1].shutdown()
+        if l._sharded_fwd is not None:
+            l._sharded_fwd.set_members(addrs[:1])
+        stage()
+        l.flush_once()
+        got = wait_intake(base + per_flush)
+        out["reshard_intake_exact"] = got == base + per_flush
+        led_sum = l.ledger.summary()
+        out["reshard_credited"] = \
+            led_sum.get("reshards_total", 0) >= 1
+        out["reshard_conserved"] = bool(
+            out["reshard_intake_exact"]
+            and l.stats.get("forward_errors", 0) == 0
+            and l.stats.get("sharded_route_fallbacks", 0) == 0)
+
+        # rolling restart: stage WITHOUT flushing, then shut the
+        # local down — the drain handoff must carry the staged
+        # interval to the survivor flagged drain=true
+        stage()
+        l.shutdown()
+        local_down = True
+        final = wait_intake(base + 2 * per_flush)
+        out["drain_intake_exact"] = final == base + 2 * per_flush
+        out["drain_wires_received"] = \
+            globals_[0].stats.get("drain_wires_received", 0)
+        out["drain_flushes"] = l.stats.get("drain_flushes", 0)
+        out["drain_conserved"] = bool(
+            out["drain_intake_exact"]
+            and out["drain_wires_received"] > 0
+            and out["drain_flushes"] >= 1)
+
+        globals_[0].flush_once()
+        local_led = l.ledger.summary()
+        g0_led = globals_[0].ledger.summary()
+        out["ledger"] = {"local": local_led, "global": g0_led}
+        out["ledgers_balanced"] = (
+            local_led["imbalanced"] == 0
+            and g0_led["imbalanced"] == 0)
+        out["items_total"] = final
+    finally:
+        if not local_down:
+            l.shutdown()
+        for g in globals_:
+            g.shutdown()
+    return out
+
+
+def chaos_bench() -> dict:
+    """``--chaos``: the fault-injection chaos soak — the ISSUE 11
+    deliverable.  Kills a global shard mid-soak, stalls a destination
+    worker, flaps a discovery member, and drops/delays forward wires,
+    then passes ONLY on accounting: every routed item lands on a
+    shard or is attributed to a named drop counter, every tier's
+    conservation ledger balances, the live reshard and the
+    rolling-restart drain lose nothing, and the cross-process trace
+    tree stays stitched."""
+    if QUICK:
+        rows_per_iter, n_histo, n_sets = 200, 32, 8
+    else:
+        rows_per_iter, n_histo, n_sets = 800, 64, 16
+    out: dict = {"mode": "chaos_soak", "quick": QUICK}
+    out["model_soak"] = _chaos_model_soak(
+        n_iters=20, rows_per_iter=rows_per_iter, pool_wires=3)
+    out["e2e"] = _chaos_e2e(n_histo, n_sets)
+    ms, e2e = out["model_soak"], out["e2e"]
+    required = {"wire_drop_retry", "wire_drop_fatal", "wire_delay",
+                "dest_stall", "discovery_flap", "shard_kill",
+                "shard_kill_reshard"}
+    gates = {
+        "faults_all_injected": required.issubset(
+            set(ms["faults_injected"])),
+        "unattributed_zero": ms["unattributed_lost"] == 0,
+        "soak_ledgers_balanced": bool(ms["ledgers_balanced"]),
+        # 3 swap events (flap down, flap up, kill reshard) credit as
+        # 2 ledger records — the flap burst merges by design
+        "reshards_credited": (ms["reshards"] >= 2
+                              and ms["reshard_events"] >= 3),
+        "trace_stitched": bool(e2e.get("trace_stitched")),
+        "reshard_conserved": bool(e2e.get("reshard_conserved")),
+        "drain_conserved": bool(e2e.get("drain_conserved")),
+        "e2e_ledgers_balanced": bool(e2e.get("ledgers_balanced")),
+    }
+    out["chaos_gates"] = gates
+    out["chaos_pass"] = all(gates.values())
+    out.update(_backend_info())
+    out["captured_unix"] = round(time.time(), 1)
+    _save_artifact("chaos_soak", out)
+    return out
+
+
 CONFIGS = (
     ("0_counters_1k_names", bench_counters),
     ("1_cardinality_100k", bench_cardinality),
@@ -2845,6 +3231,13 @@ if __name__ == "__main__":
         out = cluster_bench()
         print(json.dumps(out))
         print(_summary_line(out))
+    elif "--chaos" in sys.argv:
+        out = chaos_bench()
+        print(json.dumps(out))
+        print(json.dumps({"chaos_summary": True,
+                          "chaos_pass": out.get("chaos_pass"),
+                          "gates": out.get("chaos_gates")},
+                         separators=(",", ":")))
     elif "--config" in sys.argv:
         _run_one_config(sys.argv[sys.argv.index("--config") + 1])
     else:

@@ -14,6 +14,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 from veneur_tpu.utils import devprobe
 
@@ -369,6 +370,69 @@ def test_cluster_shard_artifact_committed():
     assert d["cluster_items_per_sec"] > 0
     assert d["global_shards"] == 4
     assert "platform" in d and "gates" in d
+
+
+def test_chaos_soak_artifact_committed():
+    """bench.py --chaos: the fault-injection soak (ISSUE 11).  The
+    committed artifact must show all four fault kinds injected (wire
+    drop/delay, stalled destination, discovery flap, shard kill), the
+    attribution identity holding exactly — every routed item landed
+    on a shard or is attributed to a NAMED drop counter, zero silent
+    loss — every tier's ledger balanced, the live reshard and the
+    rolling-restart drain conserving their intervals, and the
+    cross-process trace tree stitched through the fault."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench_results", "chaos_soak.json")
+    with open(path) as f:
+        d = json.load(f)
+    assert d["mode"] == "chaos_soak" and d["quick"] is False
+    assert d["chaos_pass"] is True
+    for gate, ok in d["chaos_gates"].items():
+        assert ok is True, gate
+
+    ms = d["model_soak"]
+    assert {"wire_drop_retry", "wire_drop_fatal", "wire_delay",
+            "dest_stall", "discovery_flap", "shard_kill",
+            "shard_kill_reshard"} <= set(ms["faults_injected"])
+    assert ms["unattributed_lost"] == 0
+    # the injected faults must have actually BITTEN: attributed wire
+    # errors from the fatal drop + dead shard, and >=2 credited
+    # reshard records covering >=3 swap events
+    assert ms["items_error_attributed"] > 0
+    assert ms["reshards"] >= 2 and ms["reshard_events"] >= 3
+    assert ms["route_fallbacks"] == 0
+    assert ms["ledgers_balanced"] is True
+    # attribution identity, re-derived from the raw counts
+    assert (ms["items_routed"] + ms["overdelivered"] ==
+            ms["items_accepted"] + ms["items_error_attributed"] +
+            ms["items_busy_dropped"])
+
+    e = d["e2e"]
+    assert e["trace_stitched"] is True and e["import_spans"] >= 1
+    assert e["reshard_conserved"] is True
+    assert e["reshard_credited"] is True
+    assert e["drain_conserved"] is True
+    assert e["drain_wires_received"] >= 1
+    assert e["drain_flushes"] >= 1
+    assert e["ledgers_balanced"] is True
+    assert "platform" in d and "gates" in d
+
+
+@pytest.mark.slow
+def test_chaos_soak_quick_rerun():
+    """Re-run the chaos soak end to end (quick scale) — the committed
+    artifact's gates must be reproducible, not a lucky capture."""
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--chaos", "--quick"],
+        env={**_ENV, "VENEUR_BENCH_PLATFORM": "cpu"},
+        capture_output=True, text=True, timeout=560,
+        cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr[-2000:]
+    d = json.loads(out.stdout.strip().splitlines()[-1])
+    assert d["chaos_summary"] is True
+    assert d["chaos_pass"] is True, d["gates"]
 
 
 def _bench_module():

@@ -1,0 +1,170 @@
+"""Tier-1 chaos smoke (<30s): one injected fault through the real
+sharded forward path, passing on ACCOUNTING.
+
+The full four-fault soak lives behind ``bench.py --chaos`` (committed
+artifact ``bench_results/chaos_soak.json``, re-run under ``-m slow``);
+this smoke keeps the core property in the tier-1 loop: a global shard
+killed mid-stream costs only attributed wire errors until discovery
+reshards around the corpse, the ledger balances every interval, and
+the moved arcs are credited.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+pytest.importorskip("grpc")
+
+from veneur_tpu.chaos import InjectedWireDrop, WireFaultInjector
+from veneur_tpu.forward.shard import ShardedForwarder
+from veneur_tpu.observe.ledger import Ledger
+
+
+def _bench():
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench.py")
+    spec = importlib.util.spec_from_file_location(
+        "_bench_chaos_mod", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_bench_chaos_mod"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ----------------------------------------------------------------------
+# injector mechanics
+
+
+def test_injector_drop_is_counted_and_exhausts():
+    inj = WireFaultInjector()
+    inj.drop_wires("d:1", 2)
+    for _ in range(2):
+        with pytest.raises(InjectedWireDrop):
+            inj("d:1", b"")
+    inj("d:1", b"")  # armed drops exhausted: passes through
+    inj("other:1", b"")  # other dests never faulted
+    st = inj.stats()
+    assert st["injected_drops"] == 2
+    assert st["armed_drops"] == {"d:1": 0}
+
+
+def test_injector_stall_is_one_shot_and_delay_persists():
+    inj = WireFaultInjector()
+    inj.stall_once("d:1", 0.05)
+    t0 = time.perf_counter()
+    inj("d:1", b"")
+    assert time.perf_counter() - t0 >= 0.04
+    t0 = time.perf_counter()
+    inj("d:1", b"")
+    assert time.perf_counter() - t0 < 0.04  # stall consumed
+    inj.delay_wires("d:1", 0.03)
+    for _ in range(2):
+        t0 = time.perf_counter()
+        inj("d:1", b"")
+        assert time.perf_counter() - t0 >= 0.02  # delay persists
+    inj.clear()
+    t0 = time.perf_counter()
+    inj("d:1", b"")
+    assert time.perf_counter() - t0 < 0.02
+    assert inj.stats()["injected_delays"] == 2
+    assert inj.stats()["injected_stalls"] == 1
+
+
+def test_injector_installs_on_forwarder_fault_hook():
+    fwd = ShardedForwarder(("a:1",))
+    try:
+        inj = WireFaultInjector().install(fwd)
+        assert fwd.fault_hook is inj
+    finally:
+        fwd.stop()
+
+
+# ----------------------------------------------------------------------
+# single-fault smoke: shard kill + reshard, exact attribution
+
+
+def test_shard_kill_single_fault_smoke():
+    m = _bench()
+    globals_ = [m._ModelGlobal(0.0) for _ in range(2)]
+    fwd = None
+    try:
+        dests = [f"127.0.0.1:{g.port}" for g in globals_]
+        fwd = ShardedForwarder(dests, queue_size=4, retries=1,
+                               backoff=0.01)
+        led = Ledger(node="smoke")
+        wires = m._cluster_wire_pool("smoke", 2, 300)
+        attr_lock = threading.Lock()
+        counts = {"error_items": 0}
+        routed_total = 0
+        reshards = 0
+        moved_total = 0
+        for it in range(8):
+            if it == 3:
+                globals_[1].stop()  # THE fault
+            if it == 5:
+                fwd.set_members(dests[:1])  # discovery catches up
+            data = wires[it % len(wires)]
+            rec = led.close_interval(seq=it + 1)
+            routed = fwd.route(data)
+            assert routed is not None, "no fallback in the smoke"
+            resh = fwd.take_reshard()
+            if resh is not None:
+                epoch, added, removed, prev = resh
+                prev_routed = fwd.route(data, ring=prev)
+                new = {routed.members[d]: n
+                       for d, _b, n in routed.batches}
+                old = {prev_routed.members[d]: n
+                       for d, _b, n in prev_routed.batches}
+                moved = sum(max(0, new.get(x, 0) - old.get(x, 0))
+                            for x in set(new) | set(old))
+                led.credit_reshard(rec, epoch, added, removed, moved)
+                reshards += 1
+                moved_total += moved
+            led.credit_rows(rec, {"staged_rows": routed.routed,
+                                  "forwarded_rows": routed.routed})
+            routed_total += routed.routed
+            landed = []
+            for d, body, n in routed.batches:
+                dest = routed.members[d]
+                ev = threading.Event()
+
+                def _res(dest, n_items, err, retries, ev=ev):
+                    if err is not None:
+                        with attr_lock:
+                            counts["error_items"] += n_items
+                    ev.set()
+
+                assert fwd.send(dest, body, n, on_result=_res)
+                led.credit_forward_split(rec, dest, n)
+                landed.append(ev)
+            for ev in landed:
+                assert ev.wait(20.0)
+            rec = led.seal(rec)
+            assert rec.balanced, rec.to_dict()
+        accepted = sum(g.accepted for g in globals_)
+        # the attribution identity: every routed item landed on a
+        # shard or is a NAMED wire-error drop — zero silent loss
+        assert routed_total == accepted + counts["error_items"]
+        # the fault actually bit (iters 3-4 hit the corpse) and the
+        # reshard actually moved the dead member's arcs
+        assert counts["error_items"] > 0
+        assert reshards == 1
+        assert moved_total > 0
+        summ = led.summary()
+        assert summ["imbalanced"] == 0
+        assert summ["reshards_total"] == 1
+        assert summ["reshard_moved_rows_total"] == moved_total
+        # post-reshard traffic all lands on the survivor
+        assert fwd.addresses == (dests[0],)
+    finally:
+        if fwd is not None:
+            fwd.stop()
+        for g in globals_:
+            g.stop()

@@ -60,6 +60,14 @@ def test_ledger_and_sink_counters_present():
             "veneur.forward.shard.busy_dropped_total",
             "veneur.forward.shard.fallback_total",
             "veneur.ledger.forward_split_dropped_total",
+            "veneur.forward.shard.reshards_total",
+            "veneur.forward.shard.moved_rows_total",
+            "veneur.forward.shard.timeout_dropped_total",
+            "veneur.forward.drain.wires_total",
+            "veneur.forward.drain.items_total",
+            "veneur.import.drain_wires_total",
+            "veneur.import.drain_items_total",
+            "veneur.discovery.refresh_errors_total",
     ):
         assert name in DOCS, name
         # and the emitting source actually still carries it
@@ -77,6 +85,29 @@ def test_env_vars_documented_in_readme():
     readme = (ROOT / "README.md").read_text()
     for var in ("VENEUR_TPU_LEDGER_STRICT",
                 "VENEUR_TPU_TRACE_PROPAGATION",
-                "VENEUR_TPU_SHARDED_GLOBAL"):
+                "VENEUR_TPU_SHARDED_GLOBAL",
+                "VENEUR_TPU_DRAIN_ON_SHUTDOWN"):
         assert var in readme, var
         assert var in DOCS, var
+
+
+def test_operations_runbook_covers_zero_downtime_surface():
+    """docs/operations.md is the ISSUE 11 runbook: rolling restarts,
+    scale-out/in, and reading the ledger/trace surfaces during an
+    incident must each be covered, naming the real knobs."""
+    ops = (ROOT / "docs" / "operations.md").read_text()
+    for needle in (
+            "VENEUR_TPU_DRAIN_ON_SHUTDOWN",
+            "consul_forward_service_name",
+            "veneur.discovery.refresh_errors_total",
+            "veneur.forward.shard.reshards_total",
+            "veneur.forward.shard.timeout_dropped_total",
+            "/debug/ledger",
+            "/debug/trace",
+            "/debug/vars",
+            "bench.py --chaos",
+            "chaos_soak.json",
+            "drain",
+            "reshard",
+    ):
+        assert needle in ops, needle

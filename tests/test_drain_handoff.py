@@ -1,0 +1,190 @@
+"""Drain-and-handoff on shutdown (ISSUE 11 tentpole 2).
+
+A rolling restart must conserve every sample: a local's shutdown runs
+one final swap + flush BEFORE the shutdown flag drops the pipeline,
+ships the staged planes over the normal forward wire flagged drain
+(gRPC ``veneur-drain`` metadata / HTTP ``X-Veneur-Drain`` header),
+and the receiving global accepts drained wires past its normal
+interval cutoff, crediting them under their own ledger protocol.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+pytest.importorskip("grpc")
+
+from veneur_tpu.core.config import read_config
+from veneur_tpu.core.server import Server
+from veneur_tpu.forward import grpc_forward, http_import
+from veneur_tpu.sinks.simple import CaptureSink
+
+
+def _wait(pred, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+# ----------------------------------------------------------------------
+# wire codec: the drain flag must fail open
+
+
+def test_drain_metadata_codec_fail_open():
+    assert grpc_forward.decode_drain_metadata(
+        [(grpc_forward.DRAIN_KEY, "1")]) is True
+    for md in (None, [], [("other", "1")],
+               [(grpc_forward.DRAIN_KEY, "0")],
+               [(grpc_forward.DRAIN_KEY, "yes")]):
+        assert grpc_forward.decode_drain_metadata(md) is False
+
+
+def test_drain_header_codec_fail_open():
+    assert http_import.decode_drain_header("1") is True
+    for v in (None, "", "0", "true", "junk"):
+        assert http_import.decode_drain_header(v) is False
+
+
+# ----------------------------------------------------------------------
+# rolling restart over sharded gRPC: exact cluster-wide conservation
+
+
+def test_rolling_restart_grpc_sharded_conserves_staged_samples():
+    caps = [CaptureSink(), CaptureSink()]
+    globals_ = []
+    for cap in caps:
+        g = Server(read_config(data={
+            "grpc_listen_addresses": ["tcp://127.0.0.1:0"],
+            "interval": "10s", "hostname": "g"}), extra_sinks=[cap])
+        g.start()
+        globals_.append(g)
+    try:
+        addrs = [f"127.0.0.1:{g.grpc_ports[0]}" for g in globals_]
+        local = Server(read_config(data={
+            "statsd_listen_addresses": [],
+            "forward_address": ",".join(addrs),
+            "forward_use_grpc": True,
+            "tpu_sharded_global": True,
+            "interval": "10s", "hostname": "l"}), extra_sinks=[])
+        local.start()
+        n = 200
+        for i in range(n):
+            local.handle_packet(
+                f"drain.{i}:{i}|c|#veneurglobalonly".encode())
+        # the restart: staged samples, NO flush yet — shutdown must
+        # hand them off, not discard them
+        local.shutdown()
+
+        def intake():
+            return sum(g.stats.get("imports_received", 0)
+                       for g in globals_)
+
+        assert intake() == n  # zero unattributed drops
+        assert local.stats.get("drain_flushes", 0) == 1
+        assert local.stats.get("drain_wires_sent", 0) == 2
+        assert local.stats.get("drain_items_sent", 0) == n
+        got_wires = sum(g.stats.get("drain_wires_received", 0)
+                        for g in globals_)
+        got_items = sum(g.stats.get("drain_items_received", 0)
+                        for g in globals_)
+        assert got_wires == 2 and got_items == n
+
+        # the drained interval is a NORMAL ledger record — balanced,
+        # split fully accounted per destination
+        rec = local.ledger.last()
+        assert rec is not None and rec.sealed and rec.balanced
+        assert sum(rec.forward_split.values()) == n
+        # the global credited the handoff under its own protocol
+        for g in globals_:
+            g.flush_once()
+            grec = g.ledger.last()
+            assert grec.balanced
+            assert grec.received.get("grpc-import-drain", 0) >= 1
+            assert grec.received.get("grpc-import", 0) == 0
+        # every key landed exactly once with its value intact
+        merged = {}
+        for cap in caps:
+            for m in cap.metrics:
+                assert m.name not in merged
+                merged[m.name] = m.value
+        assert len(merged) == n
+        for i in range(n):
+            assert merged[f"drain.{i}"] == float(i)
+        # restart leg 2: a second shutdown is a no-op (no double
+        # drain, no double count)
+        local.shutdown()
+        assert local.stats.get("drain_flushes", 0) == 1
+        assert intake() == n
+    finally:
+        for g in globals_:
+            g.shutdown()
+
+
+def test_drain_gate_off_exits_without_handoff():
+    glob = Server(read_config(data={
+        "grpc_listen_addresses": ["tcp://127.0.0.1:0"],
+        "interval": "10s", "hostname": "g"}), extra_sinks=[])
+    glob.start()
+    try:
+        local = Server(read_config(data={
+            "statsd_listen_addresses": [],
+            "forward_address": f"127.0.0.1:{glob.grpc_ports[0]}",
+            "forward_use_grpc": True,
+            "tpu_sharded_global": True,
+            "tpu_drain_on_shutdown": False,
+            "interval": "10s", "hostname": "l"}), extra_sinks=[])
+        local.start()
+        local.handle_packet(b"nodrain.a:1|c|#veneurglobalonly")
+        local.shutdown()
+        assert local.stats.get("drain_flushes", 0) == 0
+        assert glob.stats.get("imports_received", 0) == 0
+    finally:
+        glob.shutdown()
+
+
+def test_global_shutdown_never_drains():
+    """Globals have nowhere to hand off to — drain is a LOCAL-side
+    behavior (config.is_local())."""
+    g = Server(read_config(data={
+        "grpc_listen_addresses": ["tcp://127.0.0.1:0"],
+        "interval": "10s", "hostname": "g"}), extra_sinks=[])
+    g.start()
+    g.handle_packet(b"g.local:1|c")
+    g.shutdown()
+    assert g.stats.get("drain_flushes", 0) == 0
+
+
+def test_rolling_restart_http_legacy_path_drains():
+    """The legacy single-destination HTTP forward carries the same
+    handoff via the X-Veneur-Drain header."""
+    glob = Server(read_config(data={
+        "http_address": "127.0.0.1:0",
+        "statsd_listen_addresses": [],
+        "interval": "10s", "hostname": "g"}), extra_sinks=[])
+    glob.start()
+    try:
+        local = Server(read_config(data={
+            "statsd_listen_addresses": [],
+            "forward_address": f"http://127.0.0.1:{glob.http_port}",
+            "interval": "10s", "hostname": "l"}), extra_sinks=[])
+        local.start()
+        for v in range(40):
+            local.handle_packet(f"hdrain.lat:{v}|ms".encode())
+        local.shutdown()
+        assert local.stats.get("drain_flushes", 0) == 1
+        assert local.stats.get("drain_wires_sent", 0) >= 1
+        assert _wait(lambda: glob.stats.get(
+            "drain_wires_received", 0) >= 1)
+        assert glob.stats.get("imports_received", 0) >= 1
+        assert glob.stats.get("drain_items_received", 0) >= 1
+        glob.flush_once()
+        grec = glob.ledger.last()
+        assert grec.balanced
+        assert grec.received.get("http-import-drain", 0) >= 1
+    finally:
+        glob.shutdown()

@@ -347,3 +347,67 @@ def test_debug_ledger_endpoint(make_server):
         f"http://127.0.0.1:{srv.http_port}/debug/vars",
         timeout=5).read())
     assert v["ledger"]["imbalanced"] == 0
+
+
+# ----------------------------------------------------------------------
+# strict escalation under injected shard loss (zero-downtime PR)
+
+
+def test_strict_shard_loss_escalates_with_split_owed():
+    """A shard whose routed rows never get a destination credit is a
+    LOSS, and strict mode names it: split_owed carries the missing
+    row count and the per-destination split map points at the hole."""
+    hits = []
+    led = Ledger(strict=True, node="test", on_imbalance=hits.append)
+    rec = led.close_interval(seq=1)
+    led.credit_rows(rec, {"staged_rows": 100, "forwarded_rows": 100})
+    # the router split 100 rows across two shards, but the second
+    # shard's 40 rows vanished before crediting (injected loss)
+    led.credit_forward_split(rec, "a:1", 60)
+    led.seal(rec)
+    assert not rec.balanced
+    assert rec.split_owed == 40
+    assert rec.owed == 0 and rec.rows_owed == 0  # the loss is LOCATED
+    assert hits == [rec]
+    assert led.imbalanced_total == 1
+    # the surviving split identifies which shard is short
+    assert rec.forward_split == {"a:1": 60}
+    assert rec.to_dict()["forward_split"]["owed"] == 40
+
+
+def test_strict_attributed_shard_loss_does_not_escalate():
+    """The same shard loss, ATTRIBUTED: rows the workers explicitly
+    refused (split drop) or that missed the deadline stay balanced —
+    strict mode escalates silent loss, not named drops."""
+    hits = []
+    led = Ledger(strict=True, node="test", on_imbalance=hits.append)
+    rec = led.close_interval(seq=1)
+    led.credit_rows(rec, {"staged_rows": 100, "forwarded_rows": 100})
+    led.credit_forward_split(rec, "a:1", 60)
+    led.credit_forward_split(rec, dropped=40)  # dead shard, named
+    led.credit_forward_timeout(rec, "b:1", 40)
+    led.credit_forward_wire(rec, errors=1)
+    led.seal(rec)
+    assert rec.balanced and rec.split_owed == 0
+    assert hits == []
+    assert led.imbalanced_total == 0
+    assert led.summary()["forward_timeout_dropped_total"] == 40
+
+
+def test_strict_shard_loss_across_reshard_still_escalates():
+    """A reshard credit must never paper over a real loss: moved-arc
+    accounting is informational and the split check still holds."""
+    hits = []
+    led = Ledger(strict=True, node="test", on_imbalance=hits.append)
+    rec = led.close_interval(seq=1)
+    led.credit_rows(rec, {"staged_rows": 90, "forwarded_rows": 90})
+    led.credit_reshard(rec, 2, ["c:1"], [], 30)
+    led.credit_forward_split(rec, "a:1", 30)
+    led.credit_forward_split(rec, "b:1", 30)
+    # the 30 rows moved to the new member were never credited there
+    led.seal(rec)
+    assert not rec.balanced and rec.split_owed == 30
+    assert hits == [rec]
+    d = rec.to_dict()
+    assert d["reshard"]["moved_rows"] == 30
+    assert led.summary()["reshards_total"] == 1

@@ -1,0 +1,252 @@
+"""Discovery-driven live resharding (ISSUE 11 tentpole 1).
+
+The ShardedForwarder's membership is live: a discovery refresh (or an
+explicit ``set_members``) swaps a new ConsistentRing epoch mid-stream,
+retires departed members' workers and cached clients, and leaves a
+pending reshard record carrying the pre-swap ring so the server can
+credit the moved arcs in the ledger.  A rebalance must be accounted,
+not mistaken for a loss: ~1/M of arcs move on a scale-out, no interval
+is lost, and the scalar-router fallback is never taken.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+pytest.importorskip("grpc")
+
+from veneur_tpu.core.config import read_config
+from veneur_tpu.core.server import Server
+from veneur_tpu.forward.discovery import DestinationRing
+from veneur_tpu.forward.shard import ShardedForwarder
+from veneur_tpu.sinks.simple import CaptureSink
+
+from tests.test_sharded_forward import _rows
+
+
+# ----------------------------------------------------------------------
+# forwarder-level swap mechanics (no sockets)
+
+
+def test_seed_membership_is_not_a_reshard():
+    fwd = ShardedForwarder(("a:1", "b:1"))
+    try:
+        assert fwd.take_reshard() is None
+        assert fwd.reshards == 0
+        assert fwd.discovery_stats()["reshards"] == 0
+    finally:
+        fwd.stop()
+
+
+def test_set_members_swaps_epoch_and_records_pending_reshard():
+    fwd = ShardedForwarder(("a:1", "b:1"))
+    try:
+        old_ring = fwd.ring
+        assert fwd.set_members(["a:1", "b:1", "c:1"]) is True
+        assert set(fwd.addresses) == {"a:1", "b:1", "c:1"}
+        assert fwd.ring is not old_ring
+        epoch, added, removed, prev = fwd.take_reshard()
+        assert added == ["c:1"] and removed == []
+        assert epoch == fwd.discovery_stats()["epoch"]
+        # the record carries the PRE-swap ring for moved-arc diffing
+        assert set(prev.members) == {"a:1", "b:1"}
+        # taken: membership unchanged since -> no pending record
+        assert fwd.take_reshard() is None
+        # unchanged membership is not a swap
+        assert fwd.set_members(["a:1", "b:1", "c:1"]) is False
+    finally:
+        fwd.stop()
+
+
+def test_reshard_burst_merges_keeping_oldest_prev():
+    """Two swaps before the server takes the record merge into ONE
+    pending reshard whose prev ring is the oldest — the diff then
+    spans the whole burst instead of double-counting."""
+    fwd = ShardedForwarder(("a:1", "b:1"))
+    try:
+        fwd.set_members(["a:1", "b:1", "c:1"])
+        fwd.set_members(["b:1", "c:1", "d:1"])
+        epoch, added, removed, prev = fwd.take_reshard()
+        assert set(added) == {"c:1", "d:1"}
+        assert removed == ["a:1"]
+        assert set(prev.members) == {"a:1", "b:1"}
+        assert fwd.reshards == 2
+    finally:
+        fwd.stop()
+
+
+def test_removed_member_worker_and_client_retired():
+    fwd = ShardedForwarder(("a:1", "b:1"))
+    try:
+        # fault a client+worker into existence for the doomed member
+        fwd.client("b:1")
+        fwd.send("b:1", b"x", 1)
+        assert "b:1" in fwd._clients
+        fwd.set_members(["a:1"])
+        assert "b:1" not in fwd._clients
+        assert set(fwd.pool.stats().keys()) <= {"a:1"}
+    finally:
+        fwd.stop()
+
+
+def test_moved_arc_fraction_is_about_one_over_m():
+    """Scale-out 2 -> 3: the columnar router's per-destination counts
+    against the pre- and post-swap rings must differ by roughly 1/3
+    of rows (consistent hashing), and every row stays owned."""
+    fwd = ShardedForwarder(("a:1", "b:1"))
+    try:
+        data = fwd.serialize(_rows(900))
+        fwd.set_members(["a:1", "b:1", "c:1"])
+        _e, _a, _r, prev = fwd.take_reshard()
+        new_routed = fwd.route(data)
+        old_routed = fwd.route(data, ring=prev)
+        assert new_routed is not None and old_routed is not None
+        assert new_routed.routed == old_routed.routed == 900
+        new = {new_routed.members[d]: n
+               for d, _b, n in new_routed.batches}
+        old = {old_routed.members[d]: n
+               for d, _b, n in old_routed.batches}
+        moved = sum(max(0, new.get(m, 0) - old.get(m, 0))
+                    for m in set(new) | set(old))
+        # everything the new member owns moved TO it; nothing else
+        # should shuffle between the surviving members
+        assert moved == new["c:1"]
+        assert 0.15 < moved / 900 < 0.55
+    finally:
+        fwd.stop()
+
+
+def test_refresh_keeps_last_good_on_discovery_failure():
+    class FlakyDiscoverer:
+        def __init__(self):
+            self.fail = False
+
+        def get_destinations_for_service(self, service):
+            if self.fail:
+                raise RuntimeError("consul 500")
+            return ["a:1", "b:1"]
+
+    disc = FlakyDiscoverer()
+    fwd = ShardedForwarder(discoverer=disc, service="forward")
+    try:
+        assert set(fwd.addresses) == {"a:1", "b:1"}
+        disc.fail = True
+        assert fwd.refresh() is False
+        # membership survives; the failure is counted with a reason
+        assert set(fwd.addresses) == {"a:1", "b:1"}
+        st = fwd.discovery_stats()
+        assert st["refresh_errors"].get("error", 0) >= 1
+        assert st["refresh_failures"] >= 1
+        assert "consul 500" in st["last_error"]
+        assert fwd.take_reshard() is None
+    finally:
+        fwd.stop()
+
+
+def test_empty_discovery_answer_is_counted_not_applied():
+    class EmptyDiscoverer:
+        def __init__(self):
+            self.empty = False
+
+        def get_destinations_for_service(self, service):
+            return [] if self.empty else ["a:1"]
+
+    disc = EmptyDiscoverer()
+    fwd = ShardedForwarder(discoverer=disc)
+    try:
+        disc.empty = True
+        assert fwd.refresh() is False
+        assert fwd.addresses == ("a:1",)
+        assert fwd.discovery_stats()["refresh_errors"].get(
+            "empty", 0) >= 1
+    finally:
+        fwd.stop()
+
+
+# ----------------------------------------------------------------------
+# scenario: scale-out 2 -> 3 real globals mid-stream, no interval lost
+
+
+def test_live_reshard_scale_out_conserves_every_interval():
+    caps = [CaptureSink() for _ in range(3)]
+    globals_ = []
+    for cap in caps:
+        g = Server(read_config(data={
+            "grpc_listen_addresses": ["tcp://127.0.0.1:0"],
+            "interval": "10s", "hostname": "g"}), extra_sinks=[cap])
+        g.start()
+        globals_.append(g)
+    try:
+        addrs = [f"127.0.0.1:{g.grpc_ports[0]}" for g in globals_]
+        local = Server(read_config(data={
+            "statsd_listen_addresses": [],
+            "forward_address": ",".join(addrs[:2]),
+            "forward_use_grpc": True,
+            "tpu_sharded_global": True,
+            "interval": "10s", "hostname": "l"}), extra_sinks=[])
+        local.start()
+        try:
+            n = 300
+
+            def stage_and_flush():
+                for i in range(n):
+                    local.handle_packet(
+                        f"resh.{i}:{i}|c|#veneurglobalonly".encode())
+                local.flush_once()
+
+            def intake():
+                return sum(g.stats.get("imports_received", 0)
+                           for g in globals_)
+
+            # interval 1: steady state across the original 2 members
+            stage_and_flush()
+            assert intake() == n
+            assert globals_[2].stats.get("imports_received", 0) == 0
+
+            # the third global joins; the NEXT flush crosses the swap
+            assert local._sharded_fwd is not None
+            local._sharded_fwd.set_members(addrs)
+            stage_and_flush()
+            assert intake() == 2 * n  # nothing lost across the swap
+            assert globals_[2].stats.get("imports_received", 0) >= 1
+
+            # moved arcs are credited, not mistaken for a loss
+            rec = local.ledger.last()
+            assert rec.sealed and rec.balanced
+            assert rec.reshard_epoch > 0
+            assert rec.reshard_added  # the new member, by address
+            assert 0 < rec.reshard_moved_rows < n
+            new_member_rows = rec.forward_split.get(addrs[2], 0)
+            assert rec.reshard_moved_rows == new_member_rows
+            assert 0.15 < new_member_rows / n < 0.55  # ~1/M arcs
+            assert local.stats.get("forward_reshards", 0) == 1
+            assert (local.stats.get("forward_reshard_moved_rows", 0)
+                    == new_member_rows)
+
+            # no fallback, no drops, anywhere in the scenario
+            assert local.stats.get("sharded_route_fallbacks", 0) == 0
+            assert local.stats.get("sharded_forward_fallbacks", 0) == 0
+            assert local.stats.get("forward_busy_dropped", 0) == 0
+            assert local.stats.get("forward_errors", 0) == 0
+
+            # each key owned exactly once per interval cluster-wide
+            for g in globals_:
+                g.flush_once()
+            per_key: dict[str, float] = {}
+            for cap in caps:
+                for m in cap.metrics:
+                    per_key[m.name] = per_key.get(m.name, 0.0) + m.value
+            assert len(per_key) == n
+            for i in range(n):
+                # two intervals of the same counters: 2x each value
+                assert per_key[f"resh.{i}"] == float(2 * i)
+
+            # discovery state is live in /debug/vars' source
+            st = local._sharded_fwd.discovery_stats()
+            assert st["reshards"] == 1
+            assert st["members"] == sorted(addrs)
+        finally:
+            local.shutdown()
+    finally:
+        for g in globals_:
+            g.shutdown()

@@ -1,0 +1,153 @@
+"""Full-jitter retry backoff + interval-budget caps (ISSUE 11).
+
+Retries on the forward/destination workers and the sink fanout use
+AWS-style full jitter (delay ~ U(0, base * 2^attempt)) so a flapping
+peer can't synchronize retry storms across workers, and total
+in-worker retry time is capped at the interval budget so retrying can
+never bleed one interval's sends into the next.  Forward sends also
+carry an absolute per-destination deadline derived from the remaining
+interval budget; misses are dropped and ledger-credited per
+destination.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from veneur_tpu.forward.destpool import DestinationPool, full_jitter_delay
+from veneur_tpu.observe.ledger import Ledger
+from veneur_tpu.sinks.fanout import SinkFanout
+
+
+def test_full_jitter_bounds_and_spread():
+    for attempt in range(5):
+        cap = 0.25 * (2 ** attempt)
+        samples = [full_jitter_delay(0.25, attempt)
+                   for _ in range(400)]
+        assert all(0.0 <= s <= cap for s in samples), attempt
+        # FULL jitter, not equal jitter: the low half is reachable
+        assert min(samples) < cap / 2, attempt
+        assert len(set(samples)) > 1, "jitter must be randomized"
+
+
+def test_destpool_retry_budget_caps_in_worker_retry_time():
+    """retries=8 with backoff=5.0 would sleep for minutes; the budget
+    must fail the batch fast and count it."""
+    pool = DestinationPool(queue_size=2, retries=8, backoff=5.0,
+                           retry_budget=0.2)
+    done = threading.Event()
+    seen = {}
+
+    def boom():
+        raise RuntimeError("peer down")
+
+    def on_result(dest, n_items, err, retries):
+        seen["err"] = err
+        seen["retries"] = retries
+        done.set()
+
+    t0 = time.perf_counter()
+    assert pool.submit("d:1", boom, n_items=7, on_result=on_result)
+    assert done.wait(10.0)
+    elapsed = time.perf_counter() - t0
+    try:
+        assert isinstance(seen["err"], RuntimeError)
+        assert elapsed < 2.0, "budget did not cap the retry sleeps"
+        st = pool.stats()["d:1"]
+        assert st["retry_budget_exhausted"] == 1
+        assert st["errors"] == 1 and st["error_items"] == 7
+        assert pool.totals()["retry_budget_exhausted"] == 1
+    finally:
+        pool.stop()
+
+
+def test_destpool_budget_still_allows_quick_retries():
+    pool = DestinationPool(queue_size=2, retries=2, backoff=0.001,
+                           retry_budget=5.0)
+    done = threading.Event()
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("blip")
+
+    pool.submit("d:1", flaky, n_items=1,
+                on_result=lambda *a: done.set())
+    assert done.wait(10.0)
+    try:
+        st = pool.stats()["d:1"]
+        assert st["sent_batches"] == 1 and st["errors"] == 0
+        assert st["retries"] == 2
+        assert st["retry_budget_exhausted"] == 0
+    finally:
+        pool.stop()
+
+
+def test_sink_fanout_retry_budget_caps_and_reports():
+    hits = []
+    fan = SinkFanout(["s1"], retries=8, backoff=5.0,
+                     on_error=lambda name, e: hits.append((name, e)),
+                     retry_budget=0.2)
+
+    def boom():
+        raise RuntimeError("sink down")
+
+    t0 = time.perf_counter()
+    task = fan.dispatch("s1", boom)
+    assert task is not None
+    assert task.done.wait(10.0)
+    elapsed = time.perf_counter() - t0
+    try:
+        assert elapsed < 2.0, "budget did not cap the retry sleeps"
+        st = fan.stats()["s1"]
+        assert st["errors"] == 1
+        assert st["retry_budget_exhausted"] == 1
+        assert hits and hits[0][0] == "s1"
+    finally:
+        fan.stop()
+
+
+def test_forward_send_deadline_exceeded_is_typed_and_attributed():
+    pytest.importorskip("grpc")
+    from veneur_tpu.core.server import _is_deadline_error
+    from veneur_tpu.forward.shard import (DeadlineExceeded,
+                                          ShardedForwarder)
+    fwd = ShardedForwarder(("127.0.0.1:1",), retries=0)
+    done = threading.Event()
+    seen = {}
+
+    def on_result(dest, n_items, err, retries):
+        seen["err"] = err
+        done.set()
+
+    try:
+        # deadline already passed when the worker picks it up
+        assert fwd.send("127.0.0.1:1", b"x", 5, on_result=on_result,
+                        deadline=time.monotonic() - 1.0)
+        assert done.wait(10.0)
+        assert isinstance(seen["err"], DeadlineExceeded)
+        assert _is_deadline_error(seen["err"])
+        assert not _is_deadline_error(ValueError("x"))
+    finally:
+        fwd.stop()
+
+
+def test_ledger_credits_forward_timeouts_per_destination():
+    led = Ledger(node="t")
+    rec = led.close_interval(seq=1)
+    led.credit_rows(rec, {"staged_rows": 10, "forwarded_rows": 10})
+    led.credit_forward_split(rec, "a:1", 6)
+    led.credit_forward_split(rec, "b:1", 4)
+    led.credit_forward_timeout(rec, "b:1", 4)
+    led.credit_forward_timeout(rec, "b:1", 2)
+    led.seal(rec)
+    # timeout drops are async wire outcomes: attributed per dest,
+    # never faking an imbalance on the synchronous split
+    assert rec.balanced
+    d = rec.to_dict()
+    assert d["forward_wire"]["timeout_dropped"] == {"b:1": 6}
+    assert led.summary()["forward_timeout_dropped_total"] == 6

@@ -364,6 +364,20 @@ class Config:
     # path fails open to the legacy POST.
     # VENEUR_TPU_SHARDED_GLOBAL=1 overrides.
     tpu_sharded_global: bool = False
+    # live membership for the sharded forward ring: instead of the
+    # static comma-separated forward_address list, poll Consul's
+    # health API for passing instances of this service and reshard
+    # the ring on membership change (same discovery surface the proxy
+    # uses, proxy.go:491 RefreshDestinations).  Requires
+    # tpu_sharded_global + forward_use_grpc.
+    consul_forward_service_name: str = ""
+    consul_url: str = "http://127.0.0.1:8500"
+    consul_refresh_interval: str = "30s"
+    # drain-and-handoff: on shutdown a local runs one final flush and
+    # forwards its staged planes flagged drain=true, so a rolling
+    # restart conserves the in-flight interval instead of losing it.
+    # VENEUR_TPU_DRAIN_ON_SHUTDOWN=0 disables (the pre-PR-11 exit).
+    tpu_drain_on_shutdown: bool = True
 
     def resolve_aliases(self) -> None:
         """Fold the reference's deprecated alias keys into their
@@ -409,9 +423,14 @@ class Config:
         return parse_duration(self.interval)
 
     def is_local(self) -> bool:
-        """A node with a forward address is a 'local' tier instance
-        (reference server.go:1609 IsLocal)."""
-        return bool(self.forward_address)
+        """A node with a forward destination — a static address or a
+        discovered service — is a 'local' tier instance (reference
+        server.go:1609 IsLocal)."""
+        return bool(self.forward_address
+                    or self.consul_forward_service_name)
+
+    def consul_refresh_interval_seconds(self) -> float:
+        return parse_duration(self.consul_refresh_interval)
 
     def validate(self) -> list[str]:
         problems = []
@@ -457,6 +476,21 @@ class Config:
             problems.append(
                 "multiple forward_address members need "
                 "tpu_sharded_global (the legacy path dials one)")
+        if self.consul_forward_service_name:
+            if not self.tpu_sharded_global:
+                problems.append(
+                    "consul_forward_service_name needs "
+                    "tpu_sharded_global (discovery drives the ring)")
+            if not self.forward_use_grpc:
+                problems.append(
+                    "consul_forward_service_name needs "
+                    "forward_use_grpc (the sharded ring is gRPC-only)")
+            try:
+                if self.consul_refresh_interval_seconds() <= 0:
+                    problems.append(
+                        "consul_refresh_interval must be positive")
+            except ValueError as e:
+                problems.append(str(e))
         if self.kafka_span_serialization_format not in ("protobuf",
                                                         "json"):
             problems.append(

@@ -253,6 +253,16 @@ class ProxyServer:
                         if proxy.ring is not None else 0,
                         "columnar": proxy.columnar,
                         "destpool": proxy.destpool.stats(),
+                        # per-ring membership + refresh health (the
+                        # reason-tagged refresh_errors feed
+                        # veneur.discovery.refresh_errors_total)
+                        "discovery": {
+                            label: ring.stats()
+                            for label, ring in (
+                                ("forward", proxy.ring),
+                                ("grpc", proxy.grpc_ring),
+                                ("trace", proxy.trace_ring))
+                            if ring is not None},
                     })
                 else:
                     self.send_error(404)
@@ -872,6 +882,21 @@ class ProxyServer:
                 lines.append(f"veneur.proxy.{key}:{d}|c")
         lines.append(
             f"veneur.proxy.destinations:{len(self.ring.ring)}|g")
+        # reason-tagged discovery refresh errors per ring: graceful
+        # degradation (keep-last-good) made visible as a counter
+        for label, ring in (("forward", self.ring),
+                            ("grpc", self.grpc_ring),
+                            ("trace", self.trace_ring)):
+            if ring is None:
+                continue
+            for reason, total in sorted(ring.refresh_errors.items()):
+                key = f"discovery_{label}_refresh_errors_{reason}"
+                d = total - self._stats_last.get(key, 0)
+                self._stats_last[key] = total
+                if d:
+                    lines.append(
+                        f"veneur.discovery.refresh_errors_total:{d}|c"
+                        f"|#reason:{reason},service:{label}")
         try:
             self._stats_sock.sendto("\n".join(lines).encode(),
                                     self._stats_dest)

@@ -70,6 +70,26 @@ def _decode_scratch_bytes() -> int:
         return 0
 
 
+def _is_deadline_error(err) -> bool:
+    """True when a forward wire failure was a deadline miss — either
+    our own pre-send cutoff (shard.DeadlineExceeded) or gRPC's
+    DEADLINE_EXCEEDED status — so timeout drops get their own
+    per-destination ledger attribution."""
+    try:
+        from veneur_tpu.forward.shard import DeadlineExceeded
+        if isinstance(err, DeadlineExceeded):
+            return True
+    except Exception:
+        pass
+    code = getattr(err, "code", None)
+    if callable(code):
+        try:
+            return getattr(code(), "name", "") == "DEADLINE_EXCEEDED"
+        except Exception:
+            return False
+    return False
+
+
 def _is_inline_pem(value: str) -> bool:
     """TLS config values are either PEM material inline (the
     reference's example.yaml style) or file paths."""
@@ -294,7 +314,8 @@ class Server:
             from veneur_tpu.sinks.fanout import SinkFanout
             self._fanout = SinkFanout(
                 [s.name for s in self.metric_sinks],
-                on_error=lambda name, exc: self.bump("flush_errors"))
+                on_error=lambda name, exc: self.bump("flush_errors"),
+                retry_budget=max(self.interval * 0.9, 1.0))
         self._tls_context = self._build_tls()
 
         # serializes whole flushes: the ticker thread and a manual
@@ -321,8 +342,17 @@ class Server:
         self._grpc_client = None
         # sharded global forward (tpu_sharded_global): consistent-hash
         # split of the forward wire across the comma-separated
-        # forward_address members, lazily built on first forward
+        # forward_address members (or a discovered Consul service),
+        # lazily built on first forward
         self._sharded_fwd = None
+        # discovery refresh throttle for the sharded ring (0 = static
+        # membership, never polls)
+        self._fwd_refresh_interval = 0.0
+        self._fwd_refresh_next = 0.0
+        # drain-and-handoff: True only inside _drain_handoff's final
+        # flush, which flags forward wires drain=true and widens the
+        # send deadline so the handoff lands before exit
+        self._draining = False
 
         if getattr(config, "tpu_warmup", False) and \
                 hasattr(self.table, "take_staged"):
@@ -1326,6 +1356,13 @@ class Server:
                             "decode_scratch_bytes":
                                 _decode_scratch_bytes(),
                         },
+                        # sharded-ring membership + refresh health
+                        # (refresh_errors is the reason-tagged source
+                        # of veneur.discovery.refresh_errors_total)
+                        "discovery": (
+                            server._sharded_fwd.discovery_stats()
+                            if server._sharded_fwd is not None
+                            else {}),
                         # conservation at a glance; full per-interval
                         # records live at /debug/ledger
                         "ledger": server.ledger.summary(),
@@ -1355,6 +1392,8 @@ class Server:
                             self.headers.get("Content-Encoding", ""))
                         tid, sid = http_import.decode_trace_header(
                             self.headers.get(http_import.TRACE_HEADER))
+                        drain = http_import.decode_drain_header(
+                            self.headers.get(http_import.DRAIN_HEADER))
                         with server.lock:
                             # split dropped into overflow vs invalid
                             # exactly: every overflow bump happens
@@ -1365,11 +1404,15 @@ class Server:
                                 server.table, items)
                             ov = server.table.overflow_total() - ov0
                             server.ledger.ingest(
-                                "http-import",
+                                "http-import-drain" if drain
+                                else "http-import",
                                 processed=acc + dropped, staged=acc,
                                 overflow=ov, invalid=dropped - ov)
                             work = server._maybe_device_step_locked()
                         server._apply_staged(work)
+                        if drain:
+                            server.bump("drain_wires_received")
+                            server.bump("drain_items_received", acc)
                         server.note_import_span(
                             "http", acc, dropped, tid, sid,
                             nbytes=len(body))
@@ -1785,9 +1828,22 @@ class Server:
             addrs = [a.strip()
                      for a in self.config.forward_address.split(",")
                      if a.strip()]
+            discoverer = None
+            service = "forward"
+            svc = getattr(self.config,
+                          "consul_forward_service_name", "")
+            if svc:
+                from veneur_tpu.forward.discovery import \
+                    ConsulDiscoverer
+                discoverer = ConsulDiscoverer(self.config.consul_url)
+                service = svc
+                self._fwd_refresh_interval = \
+                    self.config.consul_refresh_interval_seconds()
             self._sharded_fwd = ShardedForwarder(
                 addrs, compression=float(self.config.tpu_compression),
-                credentials=self._forward_grpc_credentials())
+                credentials=self._forward_grpc_credentials(),
+                discoverer=discoverer, service=service,
+                retry_budget=max(self.interval * 0.9, 1.0))
         return self._sharded_fwd
 
     def _forward_sharded(self, fwd, rows, trace_ctx, led, cyc,
@@ -1802,6 +1858,20 @@ class Server:
         send-within-the-flush semantics hold, but a wedged shard can
         only eat its slice of the budget, never stall the next tick.
         Returns {dest: rows} for the flush result's accounting."""
+        # discovery-driven live resharding: throttled membership poll
+        # on the forward path, so a scale-out/in reshards the ring
+        # BEFORE this flush routes (keep-last-good on failure — a
+        # flapping Consul degrades to the previous membership and a
+        # counted refresh error, never a lost interval)
+        if self._fwd_refresh_interval > 0 and not self._draining:
+            now = time.monotonic()
+            if now >= self._fwd_refresh_next:
+                self._fwd_refresh_next = (
+                    now + self._fwd_refresh_interval)
+                try:
+                    fwd.refresh()
+                except Exception:
+                    log.exception("forward discovery refresh failed")
         data = fwd.serialize(rows)
         routed = None
         try:
@@ -1820,6 +1890,45 @@ class Server:
         else:
             self.bump("sharded_route_fallbacks")
             batches = fwd.route_rows_scalar(rows)
+        # a membership change since the last flush: credit the moved
+        # arcs so the ledger names this interval's per-dest skew as a
+        # REBALANCE (re-route against the pre-swap ring and count the
+        # rows whose owner changed), not a loss
+        resh = fwd.take_reshard()
+        if resh is not None:
+            epoch, added, removed, prev_ring = resh
+            moved = 0
+            if routed is not None:
+                prev_routed = None
+                try:
+                    prev_routed = fwd.route(data, ring=prev_ring)
+                except Exception:
+                    log.exception("pre-reshard route diff failed")
+                if prev_routed is not None:
+                    old_counts: dict[str, int] = {}
+                    for d, _body, n in prev_routed.batches:
+                        m = prev_routed.members[d]
+                        old_counts[m] = old_counts.get(m, 0) + n
+                    new_counts: dict[str, int] = {}
+                    for d, _body, n in routed.batches:
+                        m = routed.members[d]
+                        new_counts[m] = new_counts.get(m, 0) + n
+                    moved = sum(
+                        max(0, new_counts.get(m, 0)
+                            - old_counts.get(m, 0))
+                        for m in set(new_counts) | set(old_counts))
+            if led is not None:
+                self.ledger.credit_reshard(
+                    led, epoch, added, removed, moved)
+            self.bump("forward_reshards")
+            self.bump("forward_reshard_moved_rows", moved)
+        # per-destination deadline from the remaining interval budget:
+        # no Forward call may block past it (a drain handoff gets a
+        # wider floor so the final wires land before exit)
+        budget = max(self.interval * 0.9, 1.0)
+        if self._draining:
+            budget = max(self.interval, 5.0)
+        deadline = time.monotonic() + budget
         split: dict[str, int] = {}
         done: list[threading.Event] = []
         for dest, body, n in batches:
@@ -1844,6 +1953,14 @@ class Server:
                 else:
                     self.bump("metrics_dropped", n_items)
                     self.bump("forward_errors")
+                    if _is_deadline_error(err):
+                        # deadline drops get their own per-dest
+                        # attribution: a slow shard is NAMED, not
+                        # folded into generic wire errors
+                        self.bump("forward_timeout_dropped", n_items)
+                        if led is not None:
+                            self.ledger.credit_forward_timeout(
+                                led, dest, n_items)
                     if led is not None:
                         self.ledger.credit_forward_wire(led, errors=1)
                 if ch is not None:
@@ -1856,8 +1973,12 @@ class Server:
                 landed.set()
 
             if fwd.send(dest, body, n, trace_context=wire_ctx,
-                        on_result=_result):
+                        on_result=_result, deadline=deadline,
+                        drain=self._draining):
                 self.bump("forward_shard_wires")
+                if self._draining:
+                    self.bump("drain_wires_sent")
+                    self.bump("drain_items_sent", n)
                 split[dest] = split.get(dest, 0) + n
                 done.append(landed)
                 if led is not None:
@@ -1874,7 +1995,6 @@ class Server:
                     ch.set_error(True)
                     if cyc is not None:
                         cyc.finish(ch)
-        deadline = time.monotonic() + max(self.interval * 0.9, 1.0)
         for landed in done:
             if not landed.wait(max(0.0, deadline - time.monotonic())):
                 self.bump("forward_shard_overruns")
@@ -1892,6 +2012,9 @@ class Server:
             headers = dict(headers)
             headers[http_import.TRACE_HEADER] = \
                 http_import.encode_trace_header(*trace_ctx)
+        if self._draining:
+            headers = dict(headers)
+            headers[http_import.DRAIN_HEADER] = "1"
         url = self.config.forward_address.rstrip("/") + "/import"
         if not url.startswith("http"):
             url = "http://" + url
@@ -1907,6 +2030,9 @@ class Server:
                 self.ledger.credit_forward_wire(led, errors=1)
             log.warning("forward failed: %s", e)
         else:
+            if self._draining:
+                self.bump("drain_wires_sent")
+                self.bump("drain_items_sent", len(rows))
             if led is not None:
                 self.ledger.credit_forward_wire(
                     led, rows=len(rows), nbytes=len(body))
@@ -1921,7 +2047,8 @@ class Server:
                 credentials=self._forward_grpc_credentials())
         try:
             nbytes = self._grpc_client.send(
-                rows, trace_context=trace_ctx)
+                rows, trace_context=trace_ctx,
+                drain=self._draining)
         except _grpc.RpcError as e:
             self.bump("metrics_dropped", len(rows))
             self.bump("forward_errors")
@@ -1929,6 +2056,9 @@ class Server:
                 self.ledger.credit_forward_wire(led, errors=1)
             log.warning("grpc forward failed: %s", e)
         else:
+            if self._draining:
+                self.bump("drain_wires_sent")
+                self.bump("drain_items_sent", len(rows))
             if led is not None:
                 self.ledger.credit_forward_wire(
                     led, rows=len(rows),
@@ -1966,7 +2096,26 @@ class Server:
                     self.sentry.flush(FLUSH_TIMEOUT)
                 os._exit(2)
 
+    def _drain_handoff(self) -> None:
+        """Final-interval handoff: one last flush whose forward wires
+        are flagged drain=true, so the receiving global books this
+        local's staged planes past its normal interval cutoff and a
+        rolling restart conserves every sample.  Runs BEFORE
+        ``_shutdown`` is set (flush_once no-ops after)."""
+        self._draining = True
+        try:
+            self.flush_once()
+            self.bump("drain_flushes")
+        except Exception:
+            log.exception("drain handoff flush failed")
+        finally:
+            self._draining = False
+
     def shutdown(self) -> None:
+        if (not self._shutdown.is_set()
+                and getattr(self.config, "tpu_drain_on_shutdown", True)
+                and self.config.is_local()):
+            self._drain_handoff()
         self._shutdown.set()
         if getattr(self, "_sentry_handler", None) is not None:
             # don't leave error logs mirroring to a dead client (and

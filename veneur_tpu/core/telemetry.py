@@ -194,6 +194,37 @@ class Telemetry:
         count("veneur.forward.shard.fallback_total",
               self._delta("sharded_forward_fallbacks"),
               ("reason:forward",))
+        # live-reshard + deadline accounting (zero-downtime ops):
+        # membership swaps, the rows they moved, and per-interval rows
+        # dropped because a send missed the interval deadline
+        count("veneur.forward.shard.reshards_total",
+              self._delta("forward_reshards"))
+        count("veneur.forward.shard.moved_rows_total",
+              self._delta("forward_reshard_moved_rows"))
+        count("veneur.forward.shard.timeout_dropped_total",
+              self._delta("forward_timeout_dropped"))
+        # drain-and-handoff traffic, both directions: wires this node
+        # flagged drain=true on its shutdown flush, and drained wires
+        # accepted from terminating peers
+        count("veneur.forward.drain.wires_total",
+              self._delta("drain_wires_sent"))
+        count("veneur.forward.drain.items_total",
+              self._delta("drain_items_sent"))
+        count("veneur.import.drain_wires_total",
+              self._delta("drain_wires_received"))
+        count("veneur.import.drain_items_total",
+              self._delta("drain_items_received"))
+        # discovery refresh health for the sharded forward ring:
+        # reason-tagged refresh errors (keep-last-good degradation)
+        fwd = getattr(self.server, "_sharded_fwd", None)
+        if fwd is not None:
+            disc = fwd.discovery_stats()
+            for reason, total in sorted(
+                    disc.get("refresh_errors", {}).items()):
+                key = f"discovery_refresh_errors_{reason}"
+                self.server.stats[key] = int(total)
+                count("veneur.discovery.refresh_errors_total",
+                      self._delta(key), (f"reason:{reason}",))
         sentry_client = getattr(self.server, "sentry", None)
         if sentry_client is not None:
             # reference sentry.go:61 reports sentry.errors_total per

@@ -10,8 +10,12 @@ destination here owns ONE worker thread and a bounded handoff queue:
   the others; once its queue fills, new batches for it are counted
   ``busy_drops`` instead of piling onto shared state (the reference's
   drop-don't-buffer stance, flusher.go:536-549)
-- transient send errors retry in-worker with exponential backoff,
-  so a blip doesn't drop a batch but a dead peer can't block routing
+- transient send errors retry in-worker with FULL-JITTER exponential
+  backoff (delay ~ U(0, base * 2^attempt)), so a blip doesn't drop a
+  batch, a dead peer can't block routing, and a flapping destination
+  can't synchronize retry storms across workers; total in-worker
+  retry time is capped at ``retry_budget`` (the interval budget) so
+  retrying can never bleed into the next interval's sends
 - per-destination sent/error/retry/busy-drop counters (in ITEMS as
   well as batches) feed ``/debug/vars`` and the proxy ledger
 
@@ -24,19 +28,30 @@ from __future__ import annotations
 
 import logging
 import queue
+import random
 import threading
 import time
 
 log = logging.getLogger("veneur_tpu.destpool")
 
 
+def full_jitter_delay(base: float, attempt: int) -> float:
+    """AWS-style full jitter: U(0, base * 2^attempt).  Decorrelated
+    enough that N workers retrying the same flapping peer spread out
+    instead of stampeding in lockstep."""
+    return random.uniform(0.0, base * (2 ** attempt))
+
+
 class _DestWorker:
     def __init__(self, dest: str, queue_size: int, retries: int,
-                 backoff: float, on_result=None):
+                 backoff: float, on_result=None,
+                 retry_budget: float | None = None):
         self.dest = dest
         self.retries = max(0, int(retries))
         self.backoff = backoff
+        self.retry_budget = retry_budget
         self.on_result = on_result
+        self.budget_exhausted = 0
         self.queue: queue.Queue = queue.Queue(
             maxsize=max(1, int(queue_size)))
         self.sent_batches = 0
@@ -69,9 +84,18 @@ class _DestWorker:
                 except Exception as e:
                     err = e
                     if attempt < self.retries and not self._stop:
+                        delay = full_jitter_delay(self.backoff, attempt)
+                        if self.retry_budget is not None and (
+                                time.perf_counter() - start + delay
+                                > self.retry_budget):
+                            # retrying would bleed past the interval
+                            # budget: fail the batch now so the error
+                            # is attributed THIS interval
+                            self.budget_exhausted += 1
+                            break
                         tries += 1
                         self.retry_count += 1
-                        time.sleep(self.backoff * (2 ** attempt))
+                        time.sleep(delay)
             self.last_duration = time.perf_counter() - start
             if err is None:
                 self.sent_batches += 1
@@ -96,6 +120,7 @@ class _DestWorker:
             "errors": self.errors,
             "error_items": self.error_items,
             "retries": self.retry_count,
+            "retry_budget_exhausted": self.budget_exhausted,
             "busy_drops": self.busy_drops,
             "busy_dropped_items": self.busy_dropped_items,
             "queued": self.queue.qsize(),
@@ -110,11 +135,13 @@ class DestinationPool:
     slow peer."""
 
     def __init__(self, queue_size: int = 8, retries: int = 2,
-                 backoff: float = 0.25, on_result=None):
+                 backoff: float = 0.25, on_result=None,
+                 retry_budget: float | None = None):
         self._queue_size = queue_size
         self._retries = retries
         self._backoff = backoff
         self._on_result = on_result
+        self._retry_budget = retry_budget
         self._workers: dict[str, _DestWorker] = {}
         self._lock = threading.Lock()
 
@@ -128,7 +155,8 @@ class DestinationPool:
             w = self._workers.get(dest)
             if w is None:
                 w = _DestWorker(dest, self._queue_size, self._retries,
-                                self._backoff, self._on_result)
+                                self._backoff, self._on_result,
+                                retry_budget=self._retry_budget)
                 self._workers[dest] = w
         try:
             w.queue.put_nowait((fn, n_items, on_result))
@@ -172,7 +200,8 @@ class DestinationPool:
 
     def totals(self) -> dict:
         out = {"sent_batches": 0, "sent_items": 0, "errors": 0,
-               "error_items": 0, "retries": 0, "busy_drops": 0,
+               "error_items": 0, "retries": 0,
+               "retry_budget_exhausted": 0, "busy_drops": 0,
                "busy_dropped_items": 0}
         for s in self.stats().values():
             for k in out:

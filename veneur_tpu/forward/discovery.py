@@ -115,7 +115,20 @@ class KubernetesDiscoverer:
 
 class DestinationRing:
     """Discovery-refreshed consistent ring with keep-last-good
-    semantics (proxy.go:491-521)."""
+    semantics (proxy.go:491-521).
+
+    Failures degrade gracefully: a poll that errors or returns empty
+    KEEPS the last-known-good membership and counts a reason-tagged
+    refresh error (``refresh_errors``: ``error`` = the discoverer
+    raised, ``empty`` = it answered with no destinations) — surfaced
+    as ``veneur.discovery.refresh_errors_total`` so a flapping Consul
+    is an alert, not an interval loss.
+
+    Membership swaps leave a pending-change record (``take_change``)
+    carrying the previous ring, so a live consumer (the sharded
+    forwarder) can retire workers for departed members and credit
+    moved-arc traffic against the pre-swap ownership.
+    """
 
     def __init__(self, discoverer: Discoverer, service: str):
         self.discoverer = discoverer
@@ -125,6 +138,18 @@ class DestinationRing:
         self.epoch = 0  # bumped on every membership swap
         self.refreshes = 0
         self.refresh_failures = 0
+        self.refresh_errors: dict[str, int] = {}
+        self.last_error: str | None = None
+        # (epoch, added, removed, prev_ring) accumulated across swaps
+        # since the last take_change — the oldest prev_ring survives a
+        # burst of swaps so moved-arc diffs span the whole burst
+        self._change: tuple | None = None
+
+    def _count_error(self, reason: str, detail: str) -> None:
+        self.refresh_failures += 1
+        self.refresh_errors[reason] = (
+            self.refresh_errors.get(reason, 0) + 1)
+        self.last_error = f"{reason}: {detail}"
 
     def refresh(self) -> bool:
         """Poll once; returns True if the ring was updated."""
@@ -132,23 +157,64 @@ class DestinationRing:
             dests = self.discoverer.get_destinations_for_service(
                 self.service)
         except Exception as e:
-            self.refresh_failures += 1
+            self._count_error("error", str(e))
             log.warning("discovery refresh failed (keeping %d "
                         "destinations): %s", len(self.ring), e)
             return False
         if not dests:
             # empty responses keep the last good set (proxy.go:505-515)
-            self.refresh_failures += 1
+            self._count_error("empty", "no destinations")
             log.warning("discovery returned no destinations; keeping "
                         "%d", len(self.ring))
             return False
-        with self._lock:
-            if tuple(sorted(dests)) != self.ring.members:
-                ring = ConsistentRing(dests)
-                self.ring = ring
-                self.epoch += 1
+        self.apply(dests)
         self.refreshes += 1
         return True
+
+    def apply(self, dests) -> bool:
+        """Swap in an explicit membership (discovery result, a drain
+        handoff, or a chaos injection); returns True when membership
+        actually changed."""
+        with self._lock:
+            new_members = tuple(sorted(set(dests)))
+            if new_members == self.ring.members:
+                return False
+            prev = self.ring
+            self.ring = ConsistentRing(new_members)
+            self.epoch += 1
+            added = sorted(set(new_members) - set(prev.members))
+            removed = sorted(set(prev.members) - set(new_members))
+            if self._change is None:
+                self._change = (self.epoch, added, removed, prev)
+            else:
+                _, a0, r0, prev0 = self._change
+                # merge: net adds/removes since the oldest un-taken
+                # swap, diffed against that swap's pre-ring
+                a = sorted((set(a0) | set(added)) - set(removed))
+                r = sorted((set(r0) | set(removed)) - set(added))
+                self._change = (self.epoch, a, r, prev0)
+            return True
+
+    def take_change(self) -> tuple | None:
+        """Pop the pending membership change as (epoch, added,
+        removed, prev_ring); None when membership is unchanged since
+        the last take."""
+        with self._lock:
+            change, self._change = self._change, None
+            return change
+
+    def stats(self) -> dict:
+        with self._lock:
+            members = list(self.ring.members)
+        return {
+            "service": self.service,
+            "members": members,
+            "epoch": self.epoch,
+            "refreshes": self.refreshes,
+            "refresh_failures": self.refresh_failures,
+            "refresh_errors": dict(self.refresh_errors),
+            "last_error": self.last_error,
+        }
 
     def get(self, key: str) -> str:
         with self._lock:

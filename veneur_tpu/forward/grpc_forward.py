@@ -48,6 +48,22 @@ _METHOD = "/forwardrpc.Forward/SendMetrics"
 TRACE_ID_KEY = "veneur-trace-id"
 SPAN_ID_KEY = "veneur-span-id"
 
+# drain-and-handoff: a terminating local flags its final interval's
+# wires so the receiving global accepts them past its normal interval
+# cutoff and books them under a drain protocol in the ledger.  Old
+# peers ignore the key — a drained wire degrades to a normal import.
+DRAIN_KEY = "veneur-drain"
+
+
+def decode_drain_metadata(metadata) -> bool:
+    """True when the wire is a shutdown drain handoff; False when the
+    key is absent/malformed — a bad flag never rejects an import."""
+    try:
+        md = {k: v for k, v in (metadata or ())}
+        return md.get(DRAIN_KEY, "") == "1"
+    except (TypeError, ValueError):
+        return False
+
 
 def decode_trace_metadata(metadata) -> tuple[int, int]:
     """(trace_id, span_id) from invocation metadata; (0, 0) when
@@ -749,7 +765,9 @@ class ImportServer:
 
     def _send_metrics(self, request, context):
         core = self._core
-        tid, sid = decode_trace_metadata(context.invocation_metadata())
+        md = context.invocation_metadata()
+        tid, sid = decode_trace_metadata(md)
+        drain = decode_drain_metadata(md)
         ledger = getattr(core, "ledger", None)
         # decode outside the ingest lock: while another handler's
         # interval fold holds it (or _apply_staged runs the device
@@ -769,13 +787,20 @@ class ImportServer:
                 # overflow (the table counted them) vs invalid
                 # (malformed/non-finite, dropped before the table)
                 ov = core.table.overflow_total() - ov0
-                ledger.ingest("grpc-import", processed=acc + dropped,
+                proto = "grpc-import-drain" if drain else "grpc-import"
+                ledger.ingest(proto, processed=acc + dropped,
                               staged=acc, overflow=ov,
                               invalid=dropped - ov)
             work = core._maybe_device_step_locked()
         core._apply_staged(work)
         core.bump("imports_received", acc)
         core.bump("received_grpc", acc + dropped)
+        if drain:
+            # a peer's shutdown handoff: accepted past the interval
+            # cutoff by construction (imports stage into the CURRENT
+            # interval under core.lock), surfaced for the runbook
+            core.bump("drain_wires_received")
+            core.bump("drain_items_received", acc)
         if dropped:
             core.bump("metrics_dropped", dropped)
         note = getattr(core, "note_import_span", None)
@@ -855,16 +880,20 @@ class ForwardClient:
                        metadata=metadata)
 
     def send(self, rows: list[ForwardRow],
-             trace_context: tuple[int, int] | None = None) -> None:
+             trace_context: tuple[int, int] | None = None,
+             drain: bool = False) -> None:
         """Raises grpc.RpcError on failure (caller drops-and-counts).
         ``trace_context`` = (trace_id, span_id) of the sending flush
-        cycle, stamped as invocation metadata when set."""
-        metadata = None
+        cycle, stamped as invocation metadata when set; ``drain``
+        flags the wire as a shutdown handoff."""
+        metadata = []
         if trace_context and trace_context[0] and trace_context[1]:
             metadata = [(TRACE_ID_KEY, str(trace_context[0])),
                         (SPAN_ID_KEY, str(trace_context[1]))]
+        if drain:
+            metadata.append((DRAIN_KEY, "1"))
         self._call(rows_to_metric_list(rows, self._compression),
-                   timeout=self._timeout, metadata=metadata)
+                   timeout=self._timeout, metadata=metadata or None)
 
     def close(self) -> None:
         self._channel.close()

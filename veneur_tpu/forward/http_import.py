@@ -47,6 +47,18 @@ from veneur_tpu.protocol import dogstatsd as dsd
 # metadata (grpc_forward.TRACE_METADATA_KEYS).
 TRACE_HEADER = "X-Veneur-Trace"
 
+# drain-and-handoff twin of grpc_forward.DRAIN_KEY: a terminating
+# local flags its final interval's /import POST so the receiving
+# global books it under a drain protocol.  Old peers ignore the
+# header — a drained wire degrades to a normal import.
+DRAIN_HEADER = "X-Veneur-Drain"
+
+
+def decode_drain_header(value: str | None) -> bool:
+    """True when the request is a shutdown drain handoff; False on
+    absent/malformed (fail-open: never rejects the import)."""
+    return value == "1"
+
 
 def encode_trace_header(trace_id: int, span_id: int) -> str:
     """``<trace_id>:<span_id>`` — both positive 63-bit decimal ints."""

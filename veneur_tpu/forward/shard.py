@@ -6,8 +6,8 @@ Every keyspace used to funnel into the ONE node named by
 flush pipeline and the proxy hop all went columnar/parallel.  Gated by
 ``tpu_sharded_global`` (``VENEUR_TPU_SHARDED_GLOBAL``), the flush's
 forward rows are serialized ONCE into a MetricList wire and split by
-route-key hash across the comma-separated ``forward_address`` members,
-reusing the proxy's vectorized routing machinery end to end:
+route-key hash across the global members, reusing the proxy's
+vectorized routing machinery end to end:
 
 - ``route_metric_list`` — native columnar decode + ``vtpu_proxy_keyhash``
   off-the-wire hashing + ``ConsistentRing.assign`` owner vectors +
@@ -17,6 +17,15 @@ reusing the proxy's vectorized routing machinery end to end:
   shard busy-drops its own wires instead of stalling the others
 - ``ForwardClient.send_wire`` — the pre-serialized bodies go out
   verbatim on cached per-destination channels
+
+Membership is LIVE: the forwarder owns a ``DestinationRing`` (static
+list when ``forward_address`` names the members, Consul/Kubernetes
+discovery otherwise), and ``refresh()``/``set_members()`` swap a new
+``ConsistentRing`` epoch mid-stream.  A swap retires departed members'
+workers and cached clients and leaves a pending reshard record
+(``take_reshard``) carrying the pre-swap ring, so the server can diff
+per-destination routed counts old-vs-new and credit the moved arcs in
+the ledger — a rebalance is accounted, not mistaken for a loss.
 
 With M=1 the routed body is the concatenation of every record span in
 wire order — byte-identical to the legacy single-global send (pinned
@@ -34,12 +43,20 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 
 from veneur_tpu.forward.destpool import DestinationPool
+from veneur_tpu.forward.discovery import DestinationRing, StaticDiscoverer
 from veneur_tpu.forward.ring import ConsistentRing
 from veneur_tpu.forward.route import _TYPE_NAMES, RoutedWire
 
 log = logging.getLogger("veneur_tpu.forward.shard")
+
+
+class DeadlineExceeded(Exception):
+    """A forward send reached its worker after the interval deadline
+    already passed — the batch is dropped (and ledger-credited as a
+    timeout) instead of blocking into the next interval."""
 
 
 def row_route_key(row) -> str:
@@ -56,28 +73,121 @@ def row_route_key(row) -> str:
 class ShardedForwarder:
     """Route one flush's forward wire across the M-member global ring.
 
-    Owns the ring over the destination set, the per-destination
-    bounded workers, and the cached gRPC clients; the server drives it
-    from the ``flush.forward`` stage and keeps all stats/ledger/trace
-    crediting to itself (callbacks), so this stays a pure routing +
-    shipping surface that tests can drive without a Server.
+    Owns the discovery-refreshed ring over the destination set, the
+    per-destination bounded workers, and the cached gRPC clients; the
+    server drives it from the ``flush.forward`` stage and keeps all
+    stats/ledger/trace crediting to itself (callbacks), so this stays
+    a pure routing + shipping surface that tests can drive without a
+    Server.
     """
 
-    def __init__(self, addresses, compression: float = 100.0,
+    def __init__(self, addresses=(), compression: float = 100.0,
                  credentials=None, timeout: float = 10.0,
                  queue_size: int = 8, retries: int = 2,
-                 backoff: float = 0.25):
-        self.addresses = tuple(addresses)
+                 backoff: float = 0.25, discoverer=None,
+                 service: str = "forward",
+                 retry_budget: float | None = None):
+        addresses = tuple(addresses)
+        if discoverer is None:
+            if not addresses:
+                raise ValueError(
+                    "sharded forward needs >= 1 destination")
+            discoverer = StaticDiscoverer(list(addresses))
+        self._disc_ring = DestinationRing(discoverer, service)
+        if addresses:
+            self._disc_ring.apply(addresses)
+        else:
+            self._disc_ring.refresh()
+        # seeding the initial membership is not a reshard
+        self._disc_ring.take_change()
+        self.addresses = self._disc_ring.snapshot().members
         if not self.addresses:
             raise ValueError("sharded forward needs >= 1 destination")
         self.compression = float(compression)
         self._credentials = credentials
         self._timeout = timeout
-        self.ring = ConsistentRing(self.addresses)
         self.pool = DestinationPool(queue_size=queue_size,
-                                    retries=retries, backoff=backoff)
+                                    retries=retries, backoff=backoff,
+                                    retry_budget=retry_budget)
         self._clients: dict[str, object] = {}
         self._clients_lock = threading.Lock()
+        self.reshards = 0
+        # (epoch, added, removed, prev_ring) merged across swaps since
+        # the server last took it — oldest prev_ring survives a burst
+        self._pending_reshard: tuple | None = None
+        self._reshard_lock = threading.Lock()
+        # chaos seam: called as fault_hook(dest, body) inside the
+        # worker before each send attempt; may raise (wire drop) or
+        # sleep (wire delay / stalled destination)
+        self.fault_hook = None
+
+    @property
+    def ring(self) -> ConsistentRing:
+        """The current membership epoch's immutable ring — one
+        lock-free snapshot per batch, so a whole flush hashes against
+        a single epoch even while discovery swaps underneath."""
+        return self._disc_ring.snapshot()
+
+    # -- live membership -----------------------------------------------
+
+    def refresh(self) -> bool:
+        """One discovery poll; on a membership change swaps the ring
+        epoch, retires departed workers/clients, and records the
+        pending reshard.  Keep-last-good on failure (the error is
+        counted in ``discovery_stats``)."""
+        changed = self._disc_ring.refresh()
+        if changed:
+            self._apply_change()
+        return changed
+
+    def set_members(self, members) -> bool:
+        """Explicit membership swap (config reload, drain handoff, or
+        chaos injection) — same rebalance path as :meth:`refresh`."""
+        changed = self._disc_ring.apply(members)
+        if changed:
+            self._apply_change()
+        return changed
+
+    def _apply_change(self) -> None:
+        change = self._disc_ring.take_change()
+        if change is None:
+            return
+        epoch, added, removed, prev = change
+        self.addresses = self._disc_ring.snapshot().members
+        # departed members: stop their bounded workers and close their
+        # cached channels — the leak a static member list never had
+        self.pool.retire(self.addresses)
+        evicted = []
+        with self._clients_lock:
+            for dest in removed:
+                cl = self._clients.pop(dest, None)
+                if cl is not None:
+                    evicted.append(cl)
+        for cl in evicted:
+            try:
+                cl.close()
+            except Exception:
+                pass
+        with self._reshard_lock:
+            self.reshards += 1
+            if self._pending_reshard is None:
+                self._pending_reshard = (epoch, added, removed, prev)
+            else:
+                _, a0, r0, prev0 = self._pending_reshard
+                a = sorted((set(a0) | set(added)) - set(removed))
+                r = sorted((set(r0) | set(removed)) - set(added))
+                self._pending_reshard = (epoch, a, r, prev0)
+        log.info("forward ring resharded (epoch %d): +%s -%s -> %d "
+                 "members", epoch, added, removed, len(self.addresses))
+
+    def take_reshard(self) -> tuple | None:
+        """Pop the pending membership change as (epoch, added,
+        removed, prev_ring); None when membership is unchanged since
+        the last take.  The server diffs routed counts against
+        ``prev_ring`` to credit moved arcs in the ledger."""
+        with self._reshard_lock:
+            resh, self._pending_reshard = self._pending_reshard, None
+            return resh
 
     # -- wire assembly + routing ---------------------------------------
 
@@ -89,12 +199,15 @@ class ShardedForwarder:
         return rows_to_metric_list(
             rows, self.compression).SerializeToString()
 
-    def route(self, data: bytes) -> RoutedWire | None:
-        """Columnar split of a serialized MetricList by route-key hash;
-        None when the native path can't run (caller falls back to
+    def route(self, data: bytes,
+              ring: ConsistentRing | None = None) -> RoutedWire | None:
+        """Columnar split of a serialized MetricList by route-key hash
+        against ``ring`` (default: the current epoch's snapshot); None
+        when the native path can't run (caller falls back to
         :meth:`route_rows_scalar`)."""
         from veneur_tpu.forward.route import route_metric_list
-        return route_metric_list(data, self.ring)
+        return route_metric_list(
+            data, ring if ring is not None else self.ring)
 
     def route_rows_scalar(self, rows) -> list[tuple[str, bytes, int]]:
         """Per-row oracle fallback: group rows by the ring owner of
@@ -102,10 +215,11 @@ class ShardedForwarder:
         destination.  Same ownership as :meth:`route`, kept as the
         fail-open path and the parity oracle."""
         from veneur_tpu.forward.grpc_forward import rows_to_metric_list
+        ring = self.ring
         groups: dict[str, list] = {}
         for row in rows:
             groups.setdefault(
-                self.ring.get(row_route_key(row)), []).append(row)
+                ring.get(row_route_key(row)), []).append(row)
         return [(dest,
                  rows_to_metric_list(
                      batch, self.compression).SerializeToString(),
@@ -127,25 +241,51 @@ class ShardedForwarder:
         return cl
 
     def send(self, dest: str, body: bytes, n_items: int,
-             trace_context=None, on_result=None) -> bool:
+             trace_context=None, on_result=None,
+             deadline: float | None = None,
+             drain: bool = False) -> bool:
         """Enqueue one destination's body on its worker; False is a
         busy-drop (bounded queue full — the wedged-shard isolation).
         ``on_result(dest, n_items, err, retries)`` fires after the
-        final attempt."""
-        from veneur_tpu.forward.grpc_forward import (SPAN_ID_KEY,
+        final attempt.  ``deadline`` is an absolute ``time.monotonic``
+        cutoff: a send whose turn comes after it raises
+        :class:`DeadlineExceeded` instead of blocking past the
+        interval.  ``drain`` flags the wire as a shutdown handoff so
+        the receiving global accepts it past its interval cutoff."""
+        from veneur_tpu.forward.grpc_forward import (DRAIN_KEY,
+                                                     SPAN_ID_KEY,
                                                      TRACE_ID_KEY)
-        metadata = None
+        md = []
         if trace_context and trace_context[0] and trace_context[1]:
-            metadata = ((TRACE_ID_KEY, str(trace_context[0])),
-                        (SPAN_ID_KEY, str(trace_context[1])))
+            md.append((TRACE_ID_KEY, str(trace_context[0])))
+            md.append((SPAN_ID_KEY, str(trace_context[1])))
+        if drain:
+            md.append((DRAIN_KEY, "1"))
+        metadata = tuple(md) if md else None
 
-        def _ship(dest=dest, body=body, metadata=metadata):
-            self.client(dest).send_wire(body, metadata=metadata)
+        def _ship(dest=dest, body=body, metadata=metadata,
+                  deadline=deadline):
+            if self.fault_hook is not None:
+                self.fault_hook(dest, body)
+            timeout = None
+            if deadline is not None:
+                timeout = deadline - time.monotonic()
+                if timeout <= 0.0:
+                    raise DeadlineExceeded(
+                        f"forward to {dest} missed the interval "
+                        f"deadline")
+            self.client(dest).send_wire(body, timeout=timeout,
+                                        metadata=metadata)
 
         return self.pool.submit(dest, _ship, n_items=n_items,
                                 on_result=on_result)
 
     # -- lifecycle / introspection -------------------------------------
+
+    def discovery_stats(self) -> dict:
+        st = self._disc_ring.stats()
+        st["reshards"] = self.reshards
+        return st
 
     def stats(self) -> dict:
         return self.pool.stats()

@@ -102,10 +102,22 @@ class LedgerRecord:
     #    ``forwarded == sum(dests) + dropped`` below
     forward_split: dict[str, int] = field(default_factory=dict)
     forward_split_dropped: int = 0
+    # -- membership change (live reshard): a discovery swap moved
+    #    these arcs, so a per-destination skew vs the previous interval
+    #    is a REBALANCE (attributed here), not a loss
+    reshard_epoch: int = 0
+    reshard_added: list[str] = field(default_factory=list)
+    reshard_removed: list[str] = field(default_factory=list)
+    reshard_moved_rows: int = 0
     # -- wire outcomes (async; informational, not balance inputs) ------
     forward_wire_rows: int = 0
     forward_wire_bytes: int = 0
     forward_errors: int = 0
+    # per-destination rows dropped because the send missed the
+    # interval deadline (async like forward_errors — the attempt
+    # resolves on the worker after route time)
+    forward_timeout_dropped: dict[str, int] = field(
+        default_factory=dict)
     fanout_busy_drops: int = 0
     fanout_retries: int = 0
     fanout_timeouts: int = 0
@@ -148,9 +160,15 @@ class LedgerRecord:
             "forward_split": {"per_dest": dict(self.forward_split),
                               "dropped": self.forward_split_dropped,
                               "owed": self.split_owed},
+            "reshard": {"epoch": self.reshard_epoch,
+                        "added": list(self.reshard_added),
+                        "removed": list(self.reshard_removed),
+                        "moved_rows": self.reshard_moved_rows},
             "forward_wire": {"rows": self.forward_wire_rows,
                              "bytes": self.forward_wire_bytes,
-                             "errors": self.forward_errors},
+                             "errors": self.forward_errors,
+                             "timeout_dropped": dict(
+                                 self.forward_timeout_dropped)},
             "fanout": {"busy_drops": self.fanout_busy_drops,
                        "retries": self.fanout_retries,
                        "timeouts": self.fanout_timeouts},
@@ -243,6 +261,23 @@ class Ledger:
                     rec.forward_split.get(dest, 0) + int(rows))
             rec.forward_split_dropped += int(dropped)
 
+    def credit_reshard(self, rec: LedgerRecord, epoch: int,
+                       added, removed, moved_rows: int) -> None:
+        """Attribute a live membership change to this interval: the
+        ring swapped to ``epoch`` (gaining ``added``, losing
+        ``removed``) and ``moved_rows`` of this flush's routed rows
+        landed on a different owner than the pre-swap ring would have
+        chosen — a rebalance the record names, so a reader comparing
+        per-destination splits across intervals sees a reshard, not a
+        loss."""
+        with self._lock:
+            rec.reshard_epoch = int(epoch)
+            rec.reshard_added = sorted(
+                set(rec.reshard_added) | set(added))
+            rec.reshard_removed = sorted(
+                set(rec.reshard_removed) | set(removed))
+            rec.reshard_moved_rows += int(moved_rows)
+
     def credit_sink(self, rec: LedgerRecord, name: str,
                     metrics: int) -> None:
         with self._lock:
@@ -256,6 +291,15 @@ class Ledger:
             rec.forward_wire_rows += int(rows)
             rec.forward_wire_bytes += int(nbytes)
             rec.forward_errors += int(errors)
+
+    def credit_forward_timeout(self, rec: LedgerRecord, dest: str,
+                               rows: int) -> None:
+        """Attribute rows whose forward send missed the interval
+        deadline to ``dest`` — async like the other wire outcomes, but
+        per-destination so a deadline-dropping shard is named."""
+        with self._lock:
+            rec.forward_timeout_dropped[dest] = (
+                rec.forward_timeout_dropped.get(dest, 0) + int(rows))
 
     def credit_fanout(self, rec: LedgerRecord, busy_drops: int = 0,
                       retries: int = 0, timeouts: int = 0) -> None:
@@ -361,6 +405,15 @@ class Ledger:
             out["forward_split_total"] = sum(per_dest.values())
             out["forward_split_dropped_total"] = sum(
                 r.forward_split_dropped for r in recs)
+        timeouts = sum(
+            sum(r.forward_timeout_dropped.values()) for r in recs)
+        if timeouts:
+            out["forward_timeout_dropped_total"] = timeouts
+        if any(r.reshard_epoch for r in recs):
+            out["reshards_total"] = sum(
+                1 for r in recs if r.reshard_epoch)
+            out["reshard_moved_rows_total"] = sum(
+                r.reshard_moved_rows for r in recs)
         return out
 
 

@@ -11,8 +11,12 @@ bounded handoff queue:
   its worker is still busy next interval, so the new flush is a
   counted ``busy_drop`` instead of a queue pile-up (mirroring the
   reference's drop-don't-buffer flush stance, flusher.go:536-549)
-- transient sink errors retry in-worker with exponential backoff,
-  bounded so retries can't bleed past the next interval
+- transient sink errors retry in-worker with FULL-JITTER exponential
+  backoff (destpool.full_jitter_delay — delay ~ U(0, base *
+  2^attempt)), so a flapping backend can't synchronize retry storms
+  across sink workers; total in-worker retry time is capped at
+  ``retry_budget`` (the interval budget) so retrying can't bleed past
+  the next interval
 - per-sink duration/error/timeout/drop counters feed ``/debug/vars``
   and the flush-cycle trace
 """
@@ -23,6 +27,8 @@ import logging
 import queue
 import threading
 import time
+
+from veneur_tpu.forward.destpool import full_jitter_delay
 
 log = logging.getLogger("veneur_tpu.sinks.fanout")
 
@@ -40,10 +46,12 @@ class FlushTask:
 
 class _SinkWorker:
     def __init__(self, name: str, retries: int, backoff: float,
-                 on_error=None):
+                 on_error=None, retry_budget: float | None = None):
         self.name = name
         self.retries = max(0, int(retries))
         self.backoff = backoff
+        self.retry_budget = retry_budget
+        self.budget_exhausted = 0
         self.on_error = on_error
         # one slot: at most one flush queued behind the running one
         self.queue: queue.Queue = queue.Queue(maxsize=1)
@@ -70,7 +78,20 @@ class _SinkWorker:
                         task.fn()
                         break
                     except Exception as e:
-                        if attempt == self.retries:
+                        retry = attempt < self.retries
+                        delay = 0.0
+                        if retry:
+                            delay = full_jitter_delay(self.backoff,
+                                                      attempt)
+                            if self.retry_budget is not None and (
+                                    time.perf_counter() - start + delay
+                                    > self.retry_budget):
+                                # retrying would bleed past the
+                                # interval budget: fail now so the
+                                # error lands THIS interval
+                                self.budget_exhausted += 1
+                                retry = False
+                        if not retry:
                             self.errors += 1
                             task.error = e
                             log.warning("sink %s flush failed after "
@@ -81,9 +102,9 @@ class _SinkWorker:
                                     self.on_error(self.name, e)
                                 except Exception:
                                     pass
-                        else:
-                            self.retry_count += 1
-                            time.sleep(self.backoff * (2 ** attempt))
+                            break
+                        self.retry_count += 1
+                        time.sleep(delay)
             finally:
                 task.duration = time.perf_counter() - start
                 self.flushes += 1
@@ -96,6 +117,7 @@ class _SinkWorker:
             "flushes": self.flushes,
             "errors": self.errors,
             "retries": self.retry_count,
+            "retry_budget_exhausted": self.budget_exhausted,
             "timeouts": self.timeouts,
             "busy_drops": self.busy_drops,
             "last_duration_s": round(self.last_duration, 6),
@@ -110,19 +132,23 @@ class SinkFanout:
     running on their own worker — isolation, not cancellation)."""
 
     def __init__(self, names, retries: int = 2, backoff: float = 0.25,
-                 on_error=None):
+                 on_error=None, retry_budget: float | None = None):
         self._retries = retries
         self._backoff = backoff
         self._on_error = on_error
-        self._workers = {n: _SinkWorker(n, retries, backoff, on_error)
-                         for n in names}
+        self._retry_budget = retry_budget
+        self._workers = {
+            n: _SinkWorker(n, retries, backoff, on_error,
+                           retry_budget=retry_budget)
+            for n in names}
         self._lock = threading.Lock()
 
     def ensure(self, name: str) -> None:
         with self._lock:
             if name not in self._workers:
                 self._workers[name] = _SinkWorker(
-                    name, self._retries, self._backoff, self._on_error)
+                    name, self._retries, self._backoff, self._on_error,
+                    retry_budget=self._retry_budget)
 
     def dispatch(self, name: str, fn) -> FlushTask | None:
         """Queue a flush on the sink's worker; returns None (and
