@@ -2101,7 +2101,7 @@ class _ModelGlobal:
     bookkeeping, outside the lock) is reported so the artifact can
     prove the floor dominated."""
 
-    def __init__(self, service_us: float):
+    def __init__(self, service_us: float, port: int = 0):
         import threading
         from concurrent import futures as cf
 
@@ -2115,13 +2115,16 @@ class _ModelGlobal:
         self.wires = 0
         self.accepted = 0
         self.dropped = 0
+        self.replay_wires = 0
+        self.replay_items = 0
         self.work_s = 0.0
         self.service_s = 0.0
         self.ledger = Ledger(node="model-global")
         self._grpc = grpc.server(
             cf.ThreadPoolExecutor(max_workers=8),
             options=[("grpc.max_receive_message_length",
-                      64 * 1024 * 1024)])
+                      64 * 1024 * 1024),
+                     ("grpc.so_reuseport", 1)])
         self._grpc.add_generic_rpc_handlers((
             grpc.method_handlers_generic_handler(
                 "forwardrpc.Forward",
@@ -2130,15 +2133,22 @@ class _ModelGlobal:
                     request_deserializer=lambda b: b,
                     response_serializer=(
                         empty_pb2.Empty.SerializeToString))}),))
-        self.port = self._grpc.add_insecure_port("127.0.0.1:0")
+        # port != 0 is the recovery leg's restart-on-the-same-address
+        # — the spooled wires' destination must come BACK, not move
+        self.port = self._grpc.add_insecure_port(
+            f"127.0.0.1:{int(port)}")
+        if self.port == 0:
+            raise RuntimeError(f"model global bind failed on {port}")
         self._grpc.start()
 
     def _recv(self, request, context):
         from google.protobuf import empty_pb2
 
         from veneur_tpu.forward.gen import forward_pb2
-        from veneur_tpu.forward.grpc_forward import decode_metric_list
+        from veneur_tpu.forward.grpc_forward import (
+            decode_metric_list, decode_replay_metadata)
         t0 = time.perf_counter()
+        replay = decode_replay_metadata(context.invocation_metadata())
         cols = decode_metric_list(request)
         if cols is not None:
             n = int(cols["n"])
@@ -2151,9 +2161,14 @@ class _ModelGlobal:
         with self._stats_lock:
             self.wires += 1
             self.accepted += n
+            if replay:
+                self.replay_wires += 1
+                self.replay_items += n
             self.work_s += work
             self.service_s += pad
-        self.ledger.ingest("grpc-import", processed=n, staged=n)
+        self.ledger.ingest(
+            "grpc-import-replay" if replay else "grpc-import",
+            processed=n, staged=n)
         return empty_pb2.Empty()
 
     def summary(self) -> dict:
@@ -2161,6 +2176,8 @@ class _ModelGlobal:
         self.ledger.seal(rec)
         return {"wires": self.wires, "accepted": self.accepted,
                 "dropped": self.dropped,
+                "replay_wires": self.replay_wires,
+                "replay_items": self.replay_items,
                 "work_s": self.work_s, "service_s": self.service_s,
                 "ledger": self.ledger.summary()}
 
@@ -2911,15 +2928,179 @@ def _chaos_e2e(n_histo: int, n_sets: int) -> dict:
     return out
 
 
+def _chaos_recovery(n_iters: int = 18, rows_per_iter: int = 400,
+                    kill_iter: int = 3, restart_iter: int = 9,
+                    iter_sleep: float = 0.1,
+                    cooldown: float = 0.4) -> dict:
+    """Outage-riding recovery leg of ``--chaos`` (ISSUE 12): kill one
+    of two model globals mid-drive, let its circuit breaker trip and
+    the bounded spool absorb every wire aimed at the corpse (route-time
+    when the breaker is open, async when a probe dies in flight),
+    restart the global on the SAME port, and let the half-open probe's
+    success drain the spool as replay-flagged wires.  The pass
+    criterion is strictly harder than the soak's: ``total_lost == 0``
+    — every routed item must LAND on a shard, not merely be attributed
+    to a drop counter — with the interval ledger and the spool's
+    cross-interval conservation ledger both sealed balanced."""
+    import threading
+
+    from veneur_tpu.forward.shard import ShardedForwarder
+    from veneur_tpu.forward.spool import Spooled, WireSpool
+    from veneur_tpu.observe.ledger import Ledger, SpoolLedger
+    globals_ = [_ModelGlobal(0.0) for _ in range(2)]
+    dead_port = globals_[1].port
+    spool = WireSpool(max_bytes=8 * 1024 * 1024, max_age=120.0)
+    fwd = ShardedForwarder(
+        [f"127.0.0.1:{g.port}" for g in globals_],
+        queue_size=8, retries=1, backoff=0.02,
+        breaker_threshold=2, breaker_cooldown=cooldown, spool=spool)
+    led = Ledger(node="recovery")
+    spool_led = SpoolLedger(node="recovery")
+    wires = _cluster_wire_pool("rcvy", 2, rows_per_iter)
+    attr_lock = threading.Lock()
+    r = {"n_iters": n_iters, "rows_per_iter": rows_per_iter,
+         "routed_total": 0, "error_items": 0, "busy_dropped": 0,
+         "spooled_route_items": 0, "spooled_async_items": 0,
+         "spool_rejected_items": 0, "pending_timeouts": 0,
+         "settle_iters": 0}
+    replay_credited = 0
+
+    def one_iter(seq: int) -> None:
+        nonlocal replay_credited
+        data = wires[seq % len(wires)]
+        rec = led.close_interval(seq=seq + 1)
+        routed = fwd.route(data)
+        assert routed is not None, "no scalar fallback in recovery"
+        led.credit_rows(rec, {"staged_rows": routed.routed,
+                              "forwarded_rows": routed.routed})
+        r["routed_total"] += routed.routed
+        landed = []
+        for d, body, n in routed.batches:
+            dest = routed.members[d]
+            if fwd.should_spool(dest):
+                # breaker open: the wire parks in the spool without
+                # ever occupying a queue slot — a synchronous balance
+                # input, so the interval still seals conserved
+                if spool.put(dest, body, n):
+                    led.credit_forward_spooled(rec, n)
+                    r["spooled_route_items"] += n
+                else:
+                    led.credit_forward_split(rec, dropped=n)
+                    r["spool_rejected_items"] += n
+                continue
+            ev = threading.Event()
+
+            def _res(dest_, n_items, err, tries, ev=ev,
+                     nbytes=len(body)):
+                if err is None:
+                    led.credit_forward_wire(rec, rows=n_items,
+                                            nbytes=nbytes)
+                elif isinstance(err, Spooled):
+                    # the send died in flight but the body was
+                    # absorbed — an outage ride, not a loss
+                    with attr_lock:
+                        r["spooled_async_items"] += n_items
+                    led.credit_spool_outcome(rec,
+                                             spooled_async=n_items)
+                    led.credit_forward_wire(rec, errors=1)
+                else:
+                    with attr_lock:
+                        r["error_items"] += n_items
+                    led.credit_forward_wire(rec, errors=1)
+                ev.set()
+
+            if fwd.send(dest, body, n, on_result=_res):
+                led.credit_forward_split(rec, dest, n)
+                landed.append(ev)
+            else:
+                with attr_lock:
+                    r["busy_dropped"] += n
+                led.credit_forward_split(rec, dropped=n)
+        for ev in landed:
+            if not ev.wait(20.0):
+                r["pending_timeouts"] += 1
+        delta = fwd.replayed_items - replay_credited
+        if delta:
+            led.credit_spool_outcome(rec, replayed=delta)
+            replay_credited += delta
+        spool_led.seal_snapshot(spool.stats(), seq=seq + 1)
+        led.seal(rec)
+
+    restarted = None
+    try:
+        for it in range(n_iters):
+            if it == kill_iter:
+                globals_[1].stop()
+            elif it == restart_iter:
+                # the outage ends where it began: same address, fresh
+                # process — the half-open probe finds it and the spool
+                # replays through
+                restarted = _ModelGlobal(0.0, port=dead_port)
+            one_iter(it)
+            time.sleep(iter_sleep)
+        # settle: replay only piggybacks on successful sends, so keep
+        # driving until the spool is fully drained (bounded)
+        seq = n_iters
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            st = spool.stats()
+            if st["queued_items"] + st["inflight_items"] == 0:
+                break
+            one_iter(seq)
+            seq += 1
+            r["settle_iters"] += 1
+            time.sleep(iter_sleep)
+        # one final sealed interval picks up any replay credited
+        # after the last drive iter
+        rec = led.close_interval(seq=seq + 1)
+        delta = fwd.replayed_items - replay_credited
+        if delta:
+            led.credit_spool_outcome(rec, replayed=delta)
+            replay_credited += delta
+        spool_led.seal_snapshot(spool.stats(), seq=seq + 1)
+        led.seal(rec)
+        r["breaker_opens"] = fwd.totals()["breaker_opens"]
+        r["replay_failures"] = fwd.replay_failures
+        r["spool"] = spool.stats()
+        r["spool_balance_owed"] = spool.check_balance()
+    finally:
+        fwd.stop()
+        for g in globals_:
+            g.stop()
+        if restarted is not None:
+            restarted.stop()
+    g_out = [g.summary() for g in globals_]
+    if restarted is not None:
+        g_out.append(restarted.summary())
+    accepted = sum(g["accepted"] for g in g_out)
+    r["items_accepted"] = accepted
+    r["replay_wires_received"] = sum(
+        g["replay_wires"] for g in g_out)
+    r["replay_items_received"] = sum(
+        g["replay_items"] for g in g_out)
+    # the zero-LOSS identity (not the soak's attribution identity):
+    # a kill mid-RPC or a replay retry can double-deliver
+    # (at-least-once, reported), but nothing may go missing
+    r["total_lost"] = max(r["routed_total"] - accepted, 0)
+    r["overdelivered"] = max(accepted - r["routed_total"], 0)
+    r["ledger"] = led.summary()
+    r["spool_ledger"] = spool_led.summary()
+    r["globals"] = g_out
+    return r
+
+
 def chaos_bench() -> dict:
     """``--chaos``: the fault-injection chaos soak — the ISSUE 11
-    deliverable.  Kills a global shard mid-soak, stalls a destination
-    worker, flaps a discovery member, and drops/delays forward wires,
-    then passes ONLY on accounting: every routed item lands on a
-    shard or is attributed to a named drop counter, every tier's
-    conservation ledger balances, the live reshard and the
-    rolling-restart drain lose nothing, and the cross-process trace
-    tree stays stitched."""
+    deliverable plus the ISSUE 12 recovery leg.  Kills a global shard
+    mid-soak, stalls a destination worker, flaps a discovery member,
+    and drops/delays forward wires, then passes ONLY on accounting:
+    every routed item lands on a shard or is attributed to a named
+    drop counter, every tier's conservation ledger balances, the live
+    reshard and the rolling-restart drain lose nothing, and the
+    cross-process trace tree stays stitched.  The recovery leg is
+    stricter still: a killed-and-restarted shard must cost NOTHING —
+    the breaker trips, the spool absorbs, the replay drains, and
+    ``total_lost == 0`` exactly."""
     if QUICK:
         rows_per_iter, n_histo, n_sets = 200, 32, 8
     else:
@@ -2928,6 +3109,8 @@ def chaos_bench() -> dict:
     out["model_soak"] = _chaos_model_soak(
         n_iters=20, rows_per_iter=rows_per_iter, pool_wires=3)
     out["e2e"] = _chaos_e2e(n_histo, n_sets)
+    out["recovery"] = _chaos_recovery(
+        n_iters=18, rows_per_iter=rows_per_iter)
     ms, e2e = out["model_soak"], out["e2e"]
     required = {"wire_drop_retry", "wire_drop_fatal", "wire_delay",
                 "dest_stall", "discovery_flap", "shard_kill",
@@ -2946,6 +3129,25 @@ def chaos_bench() -> dict:
         "drain_conserved": bool(e2e.get("drain_conserved")),
         "e2e_ledgers_balanced": bool(e2e.get("ledgers_balanced")),
     }
+    rcv = out["recovery"]
+    gates.update({
+        # zero LOSS, not zero unattributed: every routed item landed
+        "recovery_total_lost_zero": rcv["total_lost"] == 0,
+        "recovery_breaker_opened": rcv["breaker_opens"] >= 1,
+        "recovery_spooled": rcv["spool"]["spooled_items"] > 0,
+        "recovery_replay_flagged": rcv["replay_wires_received"] >= 1,
+        "recovery_spool_drained": (
+            rcv["spool"]["queued_items"] == 0
+            and rcv["spool"]["inflight_items"] == 0
+            and rcv["spool"]["expired_items"] == 0),
+        "recovery_spool_balanced": (
+            rcv["spool_balance_owed"] == 0
+            and rcv["spool_ledger"]["imbalanced"] == 0),
+        "recovery_ledgers_balanced": (
+            rcv["ledger"]["imbalanced"] == 0
+            and all(g["ledger"]["imbalanced"] == 0
+                    for g in rcv["globals"])),
+    })
     out["chaos_gates"] = gates
     out["chaos_pass"] = all(gates.values())
     out.update(_backend_info())

@@ -92,3 +92,43 @@ def dsn_server():
     s = FakeDSNServer()
     yield s
     s.close()
+
+
+# ----------------------------------------------------------------------
+# worker thread-leak guard (ISSUE 12): destination-pool and sink-fanout
+# workers are named ("proxy-dest-<dest>" / "sink-flush-<name>") so a
+# pool whose close()/retire()/stop() forgets to join is a visible test
+# failure here, not a slow accumulation across the suite
+
+_WORKER_PREFIXES = ("proxy-dest-", "sink-flush-")
+
+_GUARDED_MODULES = ("test_breaker", "test_spool", "test_retry_budget",
+                    "test_proxy_columnar", "test_sink_fanout",
+                    "test_sharded_forward", "test_drain_handoff",
+                    "test_live_reshard")
+
+
+def _worker_threads():
+    return {t for t in _threading.enumerate()
+            if t.name.startswith(_WORKER_PREFIXES) and t.is_alive()}
+
+
+@pytest.fixture(autouse=True)
+def _no_worker_thread_leak(request):
+    if request.module.__name__.split(".")[-1] not in _GUARDED_MODULES:
+        yield
+        return
+    before = _worker_threads()
+    yield
+    # grace poll: stop()/retire() join with a timeout, and a worker
+    # that just popped its poison pill may still be mid-return
+    import time as _time
+    deadline = _time.monotonic() + 5.0
+    leaked = _worker_threads() - before
+    while leaked and _time.monotonic() < deadline:
+        _time.sleep(0.02)
+        leaked = _worker_threads() - before
+    assert not leaked, (
+        f"{request.node.nodeid} leaked worker threads: "
+        f"{sorted(t.name for t in leaked)} — every DestinationPool / "
+        f"SinkFanout / ShardedForwarder must be stop()'d or retire()'d")

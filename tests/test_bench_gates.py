@@ -416,6 +416,23 @@ def test_chaos_soak_artifact_committed():
     assert e["drain_wires_received"] >= 1
     assert e["drain_flushes"] >= 1
     assert e["ledgers_balanced"] is True
+
+    # the ISSUE 12 recovery leg: kill -> spool -> restart -> replay,
+    # ZERO loss (every routed item landed, not merely attributed)
+    rcv = d["recovery"]
+    assert rcv["total_lost"] == 0
+    assert rcv["error_items"] == 0 and rcv["busy_dropped"] == 0
+    assert rcv["breaker_opens"] >= 1
+    assert rcv["spool"]["spooled_items"] > 0
+    assert rcv["spooled_route_items"] > 0
+    assert rcv["replay_wires_received"] >= 1
+    assert rcv["spool"]["queued_items"] == 0
+    assert rcv["spool"]["expired_items"] == 0
+    assert rcv["spool"]["replayed_items"] == \
+        rcv["spool"]["spooled_items"]
+    assert rcv["spool_balance_owed"] == 0
+    assert rcv["ledger"]["imbalanced"] == 0
+    assert rcv["spool_ledger"]["imbalanced"] == 0
     assert "platform" in d and "gates" in d
 
 

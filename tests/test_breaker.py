@@ -1,0 +1,246 @@
+"""Per-destination circuit breaker state machine (ISSUE 12).
+
+closed -> open -> half-open, consecutive-failure threshold, cooldown,
+single-probe exclusivity — property-tested against a reference model
+on an injected clock (no real sleeps), raced under real threads, and
+pinned against the retry budget: an OPEN breaker must cost a queued
+batch ZERO send attempts and ZERO retry-budget burn.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from veneur_tpu.forward.breaker import (CLOSED, HALF_OPEN, OPEN,
+                                        STATE_CODES, BreakerOpen,
+                                        CircuitBreaker)
+from veneur_tpu.forward.destpool import DestinationPool
+from veneur_tpu.sinks.fanout import SinkFanout
+
+
+# ----------------------------------------------------------------------
+# basic transitions on an injected clock
+
+
+def test_breaker_trip_cooldown_probe_recover():
+    t = [0.0]
+    br = CircuitBreaker(threshold=3, cooldown=2.0, clock=lambda: t[0])
+    assert br.state == CLOSED and br.would_allow()
+    # two failures + a success: the streak resets, still closed
+    br.record_failure()
+    br.record_failure()
+    br.record_success()
+    for _ in range(2):
+        br.record_failure()
+    assert br.state == CLOSED
+    br.record_failure()  # third consecutive: trips
+    assert br.state == OPEN and br.stats()["opens"] == 1
+    # open, cooldown running: no peek, no claim
+    assert not br.would_allow()
+    assert not br.allow()
+    assert br.stats()["short_circuits"] == 1
+    # cooldown elapsed: peeks stay non-consuming...
+    t[0] = 2.0
+    assert br.would_allow() and br.would_allow()
+    assert br.state == OPEN
+    # ...until allow() claims THE probe
+    assert br.allow()
+    assert br.state == HALF_OPEN
+    assert not br.would_allow() and not br.allow()
+    br.record_success()
+    assert br.state == CLOSED
+    assert br.state_code() == STATE_CODES[CLOSED]
+
+
+def test_breaker_probe_failure_reopens_with_fresh_cooldown():
+    t = [0.0]
+    br = CircuitBreaker(threshold=1, cooldown=5.0, clock=lambda: t[0])
+    br.record_failure()
+    assert br.state == OPEN
+    t[0] = 5.0
+    assert br.allow()
+    br.record_failure()  # the probe died
+    assert br.state == OPEN and br.stats()["opens"] == 2
+    # the cooldown restarted AT the probe failure, not the first trip
+    t[0] = 9.0
+    assert not br.would_allow()
+    t[0] = 10.0
+    assert br.would_allow()
+
+
+def test_breaker_threshold_zero_disables():
+    br = CircuitBreaker(threshold=0, cooldown=0.0)
+    for _ in range(50):
+        br.record_failure()
+        assert br.allow() and br.would_allow()
+    assert br.state == CLOSED and br.stats()["opens"] == 0
+
+
+# ----------------------------------------------------------------------
+# property test: random op walk vs. a reference model
+
+
+def test_breaker_random_walk_matches_reference_model():
+    rng = random.Random(0xB12)
+    for trial in range(40):
+        t = [0.0]
+        threshold = rng.randint(1, 4)
+        cooldown = rng.uniform(0.5, 5.0)
+        br = CircuitBreaker(threshold, cooldown, clock=lambda: t[0])
+        state, fails, opened_at = CLOSED, 0, 0.0
+        for step in range(200):
+            op = rng.choice(("allow", "would_allow", "success",
+                             "failure", "tick"))
+            if op == "tick":
+                t[0] += rng.uniform(0.0, cooldown)
+            elif op == "would_allow":
+                expect = state == CLOSED or (
+                    state == OPEN
+                    and t[0] - opened_at >= cooldown)
+                assert br.would_allow() == expect, (trial, step)
+            elif op == "allow":
+                got = br.allow()
+                if state == CLOSED:
+                    assert got
+                elif (state == OPEN
+                      and t[0] - opened_at >= cooldown):
+                    assert got
+                    state = HALF_OPEN
+                else:
+                    assert not got
+            elif op == "success":
+                br.record_success()
+                state, fails = CLOSED, 0
+            else:
+                br.record_failure()
+                if state == HALF_OPEN:
+                    state, opened_at = OPEN, t[0]
+                elif state == CLOSED:
+                    fails += 1
+                    if fails >= threshold:
+                        state, opened_at = OPEN, t[0]
+                # a straggler failure while OPEN leaves it open
+            assert br.state == state, (trial, step, op)
+
+
+# ----------------------------------------------------------------------
+# single-probe exclusivity under real concurrency
+
+
+def test_half_open_single_probe_exclusivity_under_threads():
+    t = [0.0]
+    br = CircuitBreaker(threshold=1, cooldown=1.0, clock=lambda: t[0])
+    for round_ in range(5):
+        br.record_failure()
+        assert br.state == OPEN
+        t[0] += 1.5
+        n = 16
+        barrier = threading.Barrier(n)
+        grants = []
+
+        def claim():
+            barrier.wait()
+            grants.append(br.allow())
+
+        threads = [threading.Thread(target=claim) for _ in range(n)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(5.0)
+        assert sum(grants) == 1, f"round {round_}: {sum(grants)} probes"
+        assert br.state == HALF_OPEN
+        # fail the probe so the next round re-races from OPEN
+        br.record_failure()
+    assert br.stats()["short_circuits"] == 5 * 15
+
+
+# ----------------------------------------------------------------------
+# breaker x retry budget: an open breaker burns NOTHING
+
+
+def test_open_breaker_stops_consuming_retry_budget():
+    """With retries=8 and backoff=5.0 a dead peer would cost minutes
+    of retry sleeps per batch; once the breaker trips, every further
+    batch must fail in microseconds with zero attempts and zero
+    retry-budget burn — within the same interval, not the next one."""
+    pool = DestinationPool(queue_size=4, retries=8, backoff=5.0,
+                           retry_budget=60.0, breaker_threshold=1,
+                           breaker_cooldown=60.0)
+    calls = []
+    results = []
+
+    def boom():
+        calls.append(1)
+        raise RuntimeError("peer down")
+
+    def submit(fn, n):
+        done = threading.Event()
+        assert pool.submit("d:1", fn, n_items=n,
+                           on_result=lambda d, ni, err, tr:
+                           (results.append((err, tr)), done.set()))
+        assert done.wait(10.0)
+
+    t0 = time.perf_counter()
+    try:
+        # batch 1: the first failure trips the breaker, and the
+        # worker stops BEFORE its first backoff sleep — one attempt,
+        # not a nine-rung retry ladder
+        submit(boom, 5)
+        assert len(calls) == 1
+        assert isinstance(results[0][0], RuntimeError)
+        # batches 2+3: short-circuited, fn NEVER called
+        submit(boom, 3)
+        submit(boom, 4)
+        assert len(calls) == 1
+        assert all(isinstance(e, BreakerOpen)
+                   for e, _t in results[1:])
+        assert all(tr == 0 for _e, tr in results[1:])
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 2.0, \
+            f"open breaker still burned retry time ({elapsed:.1f}s)"
+        st = pool.stats()["d:1"]
+        assert st["short_circuit_batches"] == 2
+        assert st["short_circuit_items"] == 7
+        assert st["retries"] == 0
+        assert st["retry_budget_exhausted"] == 0
+        assert st["breaker"]["state"] == OPEN
+        assert pool.totals()["breaker_opens"] == 1
+        assert pool.totals()["short_circuit_items"] == 7
+    finally:
+        pool.stop()
+
+
+# ----------------------------------------------------------------------
+# sink fanout: same breaker, same semantics
+
+
+def test_sink_fanout_breaker_short_circuits_and_recovers():
+    fan = SinkFanout(["s1"], retries=0, backoff=0.001,
+                     breaker_threshold=1, breaker_cooldown=0.2)
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise RuntimeError("sink down")
+
+    try:
+        task = fan.dispatch("s1", boom)
+        assert task.done.wait(5.0)
+        assert fan.breaker_states()["s1"]["state"] == OPEN
+        # while open: short-circuit, flush fn never runs
+        task2 = fan.dispatch("s1", boom)
+        assert task2.done.wait(5.0)
+        assert isinstance(task2.error, BreakerOpen)
+        assert len(calls) == 1
+        assert fan.stats()["s1"]["short_circuits"] == 1
+        # cooldown elapsed: the half-open probe recovers the sink
+        time.sleep(0.25)
+        ok = []
+        task3 = fan.dispatch("s1", lambda: ok.append(1))
+        assert task3.done.wait(5.0)
+        assert ok and task3.error is None
+        assert fan.breaker_states()["s1"]["state"] == CLOSED
+    finally:
+        fan.stop()

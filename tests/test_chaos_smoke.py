@@ -3,10 +3,12 @@ sharded forward path, passing on ACCOUNTING.
 
 The full four-fault soak lives behind ``bench.py --chaos`` (committed
 artifact ``bench_results/chaos_soak.json``, re-run under ``-m slow``);
-this smoke keeps the core property in the tier-1 loop: a global shard
-killed mid-stream costs only attributed wire errors until discovery
-reshards around the corpse, the ledger balances every interval, and
-the moved arcs are credited.
+this smoke keeps the core properties in the tier-1 loop: a global
+shard killed mid-stream costs only attributed wire errors until
+discovery reshards around the corpse (the ledger balances every
+interval, the moved arcs are credited), and — the ISSUE 12 recovery
+leg — a killed-and-RESTARTED shard costs nothing at all: the breaker
+trips, the spool absorbs, the replay drains, ``total_lost == 0``.
 """
 
 from __future__ import annotations
@@ -168,3 +170,41 @@ def test_shard_kill_single_fault_smoke():
             fwd.stop()
         for g in globals_:
             g.stop()
+
+
+# ----------------------------------------------------------------------
+# outage-riding recovery smoke: kill, spool, restart, replay, zero loss
+
+
+def test_outage_recovery_zero_loss_smoke():
+    """The recovery leg at smoke scale: a global dies, its breaker
+    opens, wires spool (both route-time and mid-flight), the global
+    restarts on the same port, and the spool replays flagged wires
+    until every routed item has LANDED — zero loss, not merely zero
+    unattributed, with the spool's conservation ledger sealed
+    balanced."""
+    m = _bench()
+    out = m._chaos_recovery(n_iters=10, rows_per_iter=150,
+                            kill_iter=2, restart_iter=5,
+                            iter_sleep=0.05, cooldown=0.3)
+    # the outage actually bit and the spool actually absorbed
+    assert out["breaker_opens"] >= 1
+    assert out["spool"]["spooled_items"] > 0
+    assert out["spooled_route_items"] > 0, \
+        "breaker-open wires must spool at route time"
+    # recovery: replay-flagged wires landed and the spool drained dry
+    assert out["replay_wires_received"] >= 1
+    assert out["spool"]["queued_items"] == 0
+    assert out["spool"]["inflight_items"] == 0
+    assert out["spool"]["expired_items"] == 0
+    assert out["spool"]["replayed_items"] == \
+        out["spool"]["spooled_items"]
+    # the headline: nothing was lost, and nothing was even dropped
+    assert out["total_lost"] == 0
+    assert out["error_items"] == 0
+    assert out["busy_dropped"] == 0
+    # conservation ledgers: interval AND cross-interval spool
+    assert out["spool_balance_owed"] == 0
+    assert out["ledger"]["imbalanced"] == 0
+    assert out["spool_ledger"]["imbalanced"] == 0
+    assert out["spool_ledger"]["snapshots"] >= out["n_iters"]

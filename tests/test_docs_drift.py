@@ -68,6 +68,20 @@ def test_ledger_and_sink_counters_present():
             "veneur.import.drain_wires_total",
             "veneur.import.drain_items_total",
             "veneur.discovery.refresh_errors_total",
+            "veneur.forward.breaker.state",
+            "veneur.forward.breaker.opens_total",
+            "veneur.forward.breaker.short_circuit_total",
+            "veneur.forward.spool.spooled_items_total",
+            "veneur.forward.spool.replayed_items_total",
+            "veneur.forward.spool.expired_items_total",
+            "veneur.forward.spool.rejected_items_total",
+            "veneur.forward.spool.queued_items",
+            "veneur.forward.spool.queued_bytes",
+            "veneur.forward.replay.wires_total",
+            "veneur.forward.replay.items_total",
+            "veneur.import.replay_wires_total",
+            "veneur.import.replay_items_total",
+            "veneur.ledger.spool_imbalance_total",
     ):
         assert name in DOCS, name
         # and the emitting source actually still carries it
@@ -91,6 +105,22 @@ def test_env_vars_documented_in_readme():
         assert var in DOCS, var
 
 
+def test_outage_env_vars_documented():
+    """ISSUE 12 knobs: breaker + spool env vars must appear in the
+    README env table AND in the operations runbook that explains how
+    to size them."""
+    readme = (ROOT / "README.md").read_text()
+    ops = (ROOT / "docs" / "operations.md").read_text()
+    for var in ("VENEUR_TPU_BREAKER_THRESHOLD",
+                "VENEUR_TPU_BREAKER_COOLDOWN",
+                "VENEUR_TPU_FORWARD_SPOOL",
+                "VENEUR_TPU_FORWARD_SPOOL_MAX_BYTES",
+                "VENEUR_TPU_FORWARD_SPOOL_MAX_AGE",
+                "VENEUR_TPU_FORWARD_SPOOL_DIR"):
+        assert var in readme, var
+        assert var in ops, var
+
+
 def test_operations_runbook_covers_zero_downtime_surface():
     """docs/operations.md is the ISSUE 11 runbook: rolling restarts,
     scale-out/in, and reading the ledger/trace surfaces during an
@@ -109,5 +139,27 @@ def test_operations_runbook_covers_zero_downtime_surface():
             "chaos_soak.json",
             "drain",
             "reshard",
+    ):
+        assert needle in ops, needle
+
+
+def test_operations_runbook_covers_outage_riding():
+    """The ISSUE 12 runbook section: riding out a destination outage
+    with breakers + spool-and-replay, naming the real surfaces."""
+    ops = (ROOT / "docs" / "operations.md").read_text()
+    for needle in (
+            "Riding out a destination outage",
+            "veneur.forward.breaker.state",
+            "veneur.forward.breaker.short_circuit_total",
+            "veneur.forward.spool.expired_items_total",
+            "veneur.forward.replay.wires_total",
+            "veneur-replay",
+            "X-Veneur-Replay",
+            "grpc-import-replay",
+            "reason:cap",
+            "reason:age",
+            "reason:retired",
+            "spooled == replayed + expired + still_queued",
+            "total_lost == 0",
     ):
         assert needle in ops, needle

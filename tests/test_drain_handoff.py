@@ -51,6 +51,77 @@ def test_drain_header_codec_fail_open():
 
 
 # ----------------------------------------------------------------------
+# drain vs. circuit breaker: the final handoff outranks circuit hygiene
+
+
+def test_drain_wire_bypasses_open_breaker_and_never_spools():
+    """ISSUE 12 pin: a shutdown drain is the LAST chance to ship, so
+    a drain-flagged wire rides through an OPEN breaker (and is never
+    parked in the spool), while a normal wire short-circuits into the
+    spool without one send attempt.  The drain's success then drains
+    the spooled wires as replays."""
+    import threading
+
+    from veneur_tpu.forward.shard import ShardedForwarder
+    from veneur_tpu.forward.spool import Spooled, WireSpool
+
+    class FakeClient:
+        def __init__(self):
+            self.fail = True
+            self.calls = 0
+            self.sent = []
+
+        def send_wire(self, body, timeout=None, metadata=None):
+            self.calls += 1
+            if self.fail:
+                raise RuntimeError("peer down")
+            self.sent.append((body, dict(metadata or ())))
+
+        def close(self):
+            pass
+
+    spool = WireSpool()
+    fwd = ShardedForwarder(("d:1",), retries=0, breaker_threshold=1,
+                           breaker_cooldown=60.0, spool=spool)
+    fwd._clients["d:1"] = fake = FakeClient()
+    results = []
+
+    def send(body, drain=False):
+        done = threading.Event()
+        assert fwd.send("d:1", body, 1, drain=drain,
+                        on_result=lambda d, n, err, t:
+                        (results.append(err), done.set()))
+        assert done.wait(5.0)
+
+    try:
+        # one failure trips the threshold=1 breaker; the spool
+        # absorbs the body (Spooled, not a bare error)
+        send(b"w1")
+        assert isinstance(results[0], Spooled)
+        assert fwd.breaker_states()["d:1"]["state"] == "open"
+        # normal wire while open: short-circuits into the spool with
+        # ZERO send attempts (the 60s cooldown never elapses here)
+        send(b"w2")
+        assert isinstance(results[1], Spooled)
+        assert fake.calls == 1 and spool.queued("d:1") == 2
+        # drain wire: bypasses the open breaker, carries the drain
+        # flag, succeeds — and its success replays the spool
+        fake.fail = False
+        send(b"w3", drain=True)
+        assert results[2] is None
+        assert fake.sent[0][1].get(grpc_forward.DRAIN_KEY) == "1"
+        assert grpc_forward.REPLAY_KEY not in fake.sent[0][1]
+        assert _wait(lambda: spool.queued("d:1") == 0)
+        replayed = [m for _b, m in fake.sent
+                    if m.get(grpc_forward.REPLAY_KEY) == "1"]
+        assert len(replayed) == 2
+        assert spool.check_balance() == 0
+        assert fwd.replayed_wires == 2
+    finally:
+        fwd.stop()
+
+
+# ----------------------------------------------------------------------
 # rolling restart over sharded gRPC: exact cluster-wide conservation
 
 

@@ -394,6 +394,45 @@ def test_destpool_retire_stops_workers():
     pool.stop()
 
 
+def test_destpool_retire_credits_queued_batches():
+    """ISSUE 12 audit: batches still queued when their destination
+    leaves the ring fire ``on_result`` with
+    :class:`RetiredDestination` and count into the named
+    ``retired_dropped_*`` totals — a membership swap attributes its
+    casualties, never silently discards them."""
+    from veneur_tpu.forward.destpool import RetiredDestination
+    pool = DestinationPool(queue_size=4, retries=0)
+    release = threading.Event()
+    seen = []
+
+    def on_result(dest, n_items, err, retries):
+        seen.append((dest, n_items, err))
+
+    # pin the worker on batch 1 so batches 2+3 stay queued when the
+    # destination retires out from under them
+    assert pool.submit("a", lambda: release.wait(5.0), n_items=1)
+    assert pool.submit("a", lambda: None, n_items=3,
+                       on_result=on_result)
+    assert pool.submit("a", lambda: None, n_items=4,
+                       on_result=on_result)
+    threading.Timer(0.2, release.set).start()
+    gone = pool.retire(keep=set())
+    try:
+        assert gone == ["a"]
+        assert [(d, n) for d, n, _e in seen] == [("a", 3), ("a", 4)]
+        assert all(isinstance(e, RetiredDestination)
+                   for _d, _n, e in seen)
+        assert pool.retired_dropped_batches == 2
+        assert pool.retired_dropped_items == 7
+        t = pool.totals()
+        assert t["retired_dropped_batches"] == 2
+        assert t["retired_dropped_items"] == 7
+        assert pool.destinations() == []
+    finally:
+        release.set()
+        pool.stop()
+
+
 def test_proxy_ledger_balance_and_summary():
     led = ProxyLedger()
     led.credit_route(routed=100, dropped=5, enqueued=90,

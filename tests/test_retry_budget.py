@@ -33,6 +33,25 @@ def test_full_jitter_bounds_and_spread():
         assert len(set(samples)) > 1, "jitter must be randomized"
 
 
+def test_full_jitter_delay_is_capped():
+    """A long outage drives the attempt count up; without a ceiling
+    the exponential cap grows without bound (0.25 * 2^30 is years).
+    The clamp pins every delay at ``MAX_RETRY_DELAY`` no matter the
+    attempt, and an explicit ``max_delay`` override wins."""
+    from veneur_tpu.forward.destpool import MAX_RETRY_DELAY
+    assert MAX_RETRY_DELAY == pytest.approx(10.0)
+    for attempt in (6, 10, 30, 64):
+        samples = [full_jitter_delay(0.25, attempt)
+                   for _ in range(200)]
+        assert all(0.0 <= s <= MAX_RETRY_DELAY
+                   for s in samples), attempt
+    assert all(full_jitter_delay(4.0, 8, max_delay=0.5) <= 0.5
+               for _ in range(100))
+    # the clamp never bites below the cap: small attempts keep the
+    # plain full-jitter ceiling
+    assert all(full_jitter_delay(0.1, 0) <= 0.1 for _ in range(50))
+
+
 def test_destpool_retry_budget_caps_in_worker_retry_time():
     """retries=8 with backoff=5.0 would sleep for minutes; the budget
     must fail the batch fast and count it."""

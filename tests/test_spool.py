@@ -1,0 +1,199 @@
+"""Bounded spool-and-replay (ISSUE 12): byte cap, age cap, expiry
+attribution, and the birth-to-death conservation identity.
+
+Every wire that enters the spool must leave it NAMED — replayed,
+expired (reason ``age``/``cap``/``retired``), or still queued — so
+
+    spooled == replayed + expired + queued + inflight
+
+holds at any instant (``check_balance``), and the cross-interval
+:class:`SpoolLedger` can seal the same identity per flush.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from veneur_tpu.forward.spool import EXPIRE_REASONS, Spooled, WireSpool
+from veneur_tpu.observe.ledger import SpoolLedger
+
+
+def _spool(**kw):
+    t = [0.0]
+    kw.setdefault("clock", lambda: t[0])
+    return WireSpool(**kw), t
+
+
+def test_spooled_marker_carries_cause():
+    cause = RuntimeError("peer down")
+    err = Spooled(cause)
+    assert err.cause is cause
+    assert "peer down" in str(err)
+
+
+def test_put_take_replay_requeue_balance():
+    sp, t = _spool(max_bytes=1024, max_age=100.0)
+    assert sp.put("d:1", b"aaaa", 10)
+    assert sp.put("d:1", b"bbbb", 20)
+    assert sp.queued("d:1") == 2 and sp.queued_items() == 30
+    assert sp.check_balance() == 0
+    # FIFO, and take marks inflight (still accounted)
+    e1 = sp.take("d:1")
+    assert e1.read() == b"aaaa" and e1.n_items == 10
+    assert sp.stats()["inflight_items"] == 10
+    assert sp.check_balance() == 0
+    # failed replay: requeue puts it back at the FRONT untouched
+    sp.requeue(e1)
+    assert sp.take("d:1").read() == b"aaaa"
+    sp.mark_replayed(sp.take("d:1"))  # bbbb
+    assert sp.check_balance() == 0
+    st = sp.stats()
+    assert st["replayed_items"] == 20
+    assert st["spooled_items"] == 30  # requeue never re-counts
+
+
+def test_byte_cap_evicts_oldest_credited_as_cap():
+    sp, t = _spool(max_bytes=10, max_age=100.0)
+    assert sp.put("d:1", b"aaaa", 1)
+    t[0] = 1.0
+    assert sp.put("d:2", b"bbbb", 2)
+    t[0] = 2.0
+    # 4 + 4 + 4 > 10: the OLDEST wire (d:1, across destinations) is
+    # evicted to make room — ring semantics, newest data wins
+    assert sp.put("d:1", b"cccc", 3)
+    st = sp.stats()
+    assert st["expired_items"] == 1
+    assert st["expired_by_reason"] == {"age": 0, "cap": 1,
+                                       "retired": 0}
+    assert sp.queued("d:1") == 1 and sp.queued("d:2") == 1
+    assert sp.take("d:1").read() == b"cccc"
+    assert sp.check_balance() == 0
+
+
+def test_single_body_over_cap_rejected_not_spooled():
+    sp, _t = _spool(max_bytes=8)
+    assert sp.put("d:1", b"aa", 1)
+    assert not sp.put("d:1", b"x" * 9, 5)
+    st = sp.stats()
+    # rejection is the CALLER's drop to attribute; the conservation
+    # identity never saw the wire
+    assert st["rejected_wires"] == 1 and st["rejected_items"] == 5
+    assert st["spooled_items"] == 1 and st["queued_items"] == 1
+    assert sp.check_balance() == 0
+
+
+def test_age_cap_expires_on_sweep_put_and_take():
+    sp, t = _spool(max_bytes=1024, max_age=10.0)
+    sp.put("d:1", b"old1", 1)
+    t[0] = 5.0
+    sp.put("d:1", b"old2", 2)
+    t[0] = 10.5  # old1 over age, old2 not
+    assert sp.sweep() == 1
+    assert sp.stats()["expired_by_reason"]["age"] == 1
+    t[0] = 16.0  # old2 over age: take() expires it on the way
+    assert sp.take("d:1") is None
+    assert sp.stats()["expired_by_reason"]["age"] == 3
+    # put() also expires stale wires before admitting new ones
+    sp.put("d:1", b"old3", 4)
+    t[0] = 27.0
+    sp.put("d:1", b"new1", 8)
+    assert sp.stats()["expired_by_reason"]["age"] == 7
+    assert sp.queued_items() == 8
+    assert sp.check_balance() == 0
+
+
+def test_drop_dest_expires_as_retired():
+    sp, _t = _spool()
+    sp.put("d:1", b"aaaa", 3)
+    sp.put("d:1", b"bbbb", 4)
+    sp.put("d:2", b"cccc", 5)
+    assert sp.drop_dest("d:1") == (2, 7)
+    assert sp.drop_dest("d:1") == (0, 0)
+    st = sp.stats()
+    assert st["expired_by_reason"]["retired"] == 7
+    assert st["queued_items"] == 5
+    assert sp.check_balance() == 0
+
+
+def test_discard_resolves_inflight_as_expired():
+    sp, _t = _spool()
+    sp.put("d:1", b"aaaa", 6)
+    entry = sp.take("d:1")
+    sp.discard(entry, "age")
+    st = sp.stats()
+    assert st["inflight_items"] == 0
+    assert st["expired_by_reason"]["age"] == 6
+    assert sp.check_balance() == 0
+
+
+def test_disk_segments_write_replay_unlink(tmp_path):
+    sp, _t = _spool(dir=str(tmp_path))
+    sp.put("127.0.0.1:8128", b"wirebody", 2)
+    files = [os.path.join(r, f) for r, _d, fs in os.walk(tmp_path)
+             for f in fs]
+    assert len(files) == 1
+    with open(files[0], "rb") as f:
+        assert f.read() == b"wirebody"
+    entry = sp.take("127.0.0.1:8128")
+    assert entry.body is None  # body lives on disk, not in RSS
+    assert entry.read() == b"wirebody"
+    sp.mark_replayed(entry)
+    assert not os.path.exists(files[0])  # segment unlinked on replay
+    assert sp.check_balance() == 0
+
+
+def test_disk_segment_vanished_reads_none():
+    sp, _t = _spool()
+    sp.put("d:1", b"aaaa", 1)
+    entry = sp.take("d:1")
+    entry.body, entry.path = None, "/nonexistent/gone.wire"
+    assert entry.read() is None
+    sp.discard(entry, "age")
+    assert sp.check_balance() == 0
+
+
+def test_expire_reasons_are_the_closed_set():
+    # every expiry must land in a NAMED bucket the docs + telemetry
+    # enumerate — a new reason is an API change, not a drive-by
+    assert EXPIRE_REASONS == ("age", "cap", "retired")
+
+
+# ----------------------------------------------------------------------
+# the cross-interval spool ledger
+
+
+def test_spool_ledger_seals_balanced_snapshots():
+    sp, t = _spool(max_bytes=100, max_age=50.0)
+    led = SpoolLedger(node="t")
+    sp.put("d:1", b"aaaa", 10)
+    rec1 = led.seal_snapshot(sp.stats(), seq=1)
+    assert rec1.balanced and rec1.owed == 0
+    sp.mark_replayed(sp.take("d:1"))
+    t[0] = 60.0
+    sp.put("d:1", b"bbbb", 5)
+    t[0] = 120.0
+    sp.sweep()  # bbbb ages out
+    rec2 = led.seal_snapshot(sp.stats(), seq=2)
+    assert rec2.balanced
+    s = led.summary()
+    assert s["snapshots"] == 2 and s["imbalanced"] == 0
+    # cumulative lifetime account comes from the LAST snapshot
+    assert s["spooled_items"] == 15
+    assert s["replayed_items"] == 10
+    assert s["expired_items"] == 5
+    assert s["expired_by_reason"]["age"] == 5
+
+
+def test_spool_ledger_escalates_imbalance():
+    hits = []
+    led = SpoolLedger(node="t", strict=True,
+                      on_imbalance=lambda rec: hits.append(rec))
+    rec = led.seal_snapshot({"spooled_items": 10, "replayed_items": 3,
+                             "expired_items": 2, "queued_items": 1,
+                             "inflight_items": 0}, seq=7)
+    assert not rec.balanced and rec.owed == 4
+    assert hits and hits[0].seq == 7
+    assert led.summary()["imbalanced"] == 1
+    assert led.summary()["owed_total"] == 4
+    assert 7 in json.loads(led.to_json())["imbalanced"]

@@ -378,6 +378,36 @@ class Config:
     # restart conserves the in-flight interval instead of losing it.
     # VENEUR_TPU_DRAIN_ON_SHUTDOWN=0 disables (the pre-PR-11 exit).
     tpu_drain_on_shutdown: bool = True
+    # per-destination circuit breaker on the sharded forward workers
+    # (and sink flush workers): this many CONSECUTIVE send failures
+    # trip the destination open — sends short-circuit instantly,
+    # consuming no retry budget — until tpu_breaker_cooldown elapses
+    # and a single half-open probe tests recovery.  0 disables the
+    # breaker.  VENEUR_TPU_BREAKER_THRESHOLD overrides.
+    tpu_breaker_threshold: int = 5
+    # how long an open breaker rejects before allowing one probe.
+    # VENEUR_TPU_BREAKER_COOLDOWN overrides.
+    tpu_breaker_cooldown: str = "5s"
+    # outage spool on the sharded forward path: wire batches that
+    # can't ship (breaker open, retry budget exhausted, deadline
+    # missed) park in a bounded per-destination spool and replay —
+    # flagged veneur-replay — when the destination recovers, so an
+    # outage shorter than the spool's caps loses ZERO samples instead
+    # of merely attributing the loss.  VENEUR_TPU_FORWARD_SPOOL=0
+    # disables (pre-PR-12 drop-and-attribute behavior).
+    tpu_forward_spool: bool = True
+    # total spooled wire bytes across all destinations; adding past
+    # the cap evicts oldest-first (credited spool_expired, reason
+    # "cap").  VENEUR_TPU_FORWARD_SPOOL_MAX_BYTES overrides.
+    tpu_forward_spool_max_bytes: int = 32 * 1024 * 1024
+    # spooled wires older than this expire (credited spool_expired,
+    # reason "age") — the bound on how stale a replayed sample can
+    # be.  VENEUR_TPU_FORWARD_SPOOL_MAX_AGE overrides.
+    tpu_forward_spool_max_age: str = "300s"
+    # optional disk spool directory (s3-sink-style segment files,
+    # <dir>/<dest>/<seq>.wire); empty = in-memory only.
+    # VENEUR_TPU_FORWARD_SPOOL_DIR overrides.
+    tpu_forward_spool_dir: str = ""
 
     def resolve_aliases(self) -> None:
         """Fold the reference's deprecated alias keys into their
@@ -431,6 +461,12 @@ class Config:
 
     def consul_refresh_interval_seconds(self) -> float:
         return parse_duration(self.consul_refresh_interval)
+
+    def breaker_cooldown_seconds(self) -> float:
+        return parse_duration(self.tpu_breaker_cooldown)
+
+    def forward_spool_max_age_seconds(self) -> float:
+        return parse_duration(self.tpu_forward_spool_max_age)
 
     def validate(self) -> list[str]:
         problems = []
@@ -491,6 +527,22 @@ class Config:
                         "consul_refresh_interval must be positive")
             except ValueError as e:
                 problems.append(str(e))
+        if self.tpu_breaker_threshold < 0:
+            problems.append("tpu_breaker_threshold must be >= 0")
+        try:
+            if self.breaker_cooldown_seconds() <= 0:
+                problems.append("tpu_breaker_cooldown must be positive")
+        except ValueError as e:
+            problems.append(str(e))
+        if self.tpu_forward_spool_max_bytes <= 0:
+            problems.append(
+                "tpu_forward_spool_max_bytes must be positive")
+        try:
+            if self.forward_spool_max_age_seconds() <= 0:
+                problems.append(
+                    "tpu_forward_spool_max_age must be positive")
+        except ValueError as e:
+            problems.append(str(e))
         if self.kafka_span_serialization_format not in ("protobuf",
                                                         "json"):
             problems.append(

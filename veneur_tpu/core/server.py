@@ -287,6 +287,18 @@ class Server:
             node="local" if self.is_local else "global",
             on_imbalance=lambda rec: self.bump("ledger_imbalance"))
         self._ledger_fanout_last = (0, 0, 0)
+        # cross-interval conservation for the outage spool: one
+        # snapshot sealed per flush from WireSpool.stats(); strict
+        # mode escalates a leaking spool exactly like an interval
+        # imbalance
+        self._spool_ledger = observe.SpoolLedger(
+            strict=bool(getattr(config, "tpu_ledger_strict", False)),
+            node="local" if self.is_local else "global",
+            on_imbalance=lambda rec: self.bump(
+                "spool_ledger_imbalance"))
+        # replayed items already credited to a ledger record (the
+        # replay counter on the forwarder is cumulative)
+        self._replayed_credited = 0
 
         self.events: list[dsd.Event] = []
         self.checks: list[dsd.ServiceCheck] = []
@@ -1363,9 +1375,26 @@ class Server:
                             server._sharded_fwd.discovery_stats()
                             if server._sharded_fwd is not None
                             else {}),
+                        # per-destination circuit breaker state
+                        # (closed/half_open/open + trip counts) for
+                        # the sharded forward workers
+                        "breakers": (
+                            server._sharded_fwd.breaker_states()
+                            if server._sharded_fwd is not None
+                            else {}),
+                        # outage spool: queued/replayed/expired wire
+                        # accounting; None when disabled or the
+                        # sharded forwarder never built
+                        "spool": (
+                            server._sharded_fwd.spool_stats()
+                            if server._sharded_fwd is not None
+                            else None),
                         # conservation at a glance; full per-interval
                         # records live at /debug/ledger
                         "ledger": server.ledger.summary(),
+                        # cross-interval spool conservation (spooled
+                        # == replayed + expired + queued + inflight)
+                        "spool_ledger": server._spool_ledger.summary(),
                     })
                 elif (self.path == "/quitquitquit" and
                       server.config.http_quit):
@@ -1394,6 +1423,9 @@ class Server:
                             self.headers.get(http_import.TRACE_HEADER))
                         drain = http_import.decode_drain_header(
                             self.headers.get(http_import.DRAIN_HEADER))
+                        replay = http_import.decode_replay_header(
+                            self.headers.get(
+                                http_import.REPLAY_HEADER))
                         with server.lock:
                             # split dropped into overflow vs invalid
                             # exactly: every overflow bump happens
@@ -1405,6 +1437,7 @@ class Server:
                             ov = server.table.overflow_total() - ov0
                             server.ledger.ingest(
                                 "http-import-drain" if drain
+                                else "http-import-replay" if replay
                                 else "http-import",
                                 processed=acc + dropped, staged=acc,
                                 overflow=ov, invalid=dropped - ov)
@@ -1413,6 +1446,9 @@ class Server:
                         if drain:
                             server.bump("drain_wires_received")
                             server.bump("drain_items_received", acc)
+                        if replay:
+                            server.bump("replay_wires_received")
+                            server.bump("replay_items_received", acc)
                         server.note_import_span(
                             "http", acc, dropped, tid, sid,
                             nbytes=len(body))
@@ -1839,12 +1875,34 @@ class Server:
                 service = svc
                 self._fwd_refresh_interval = \
                     self.config.consul_refresh_interval_seconds()
+            spool = None
+            if getattr(self.config, "tpu_forward_spool", True):
+                from veneur_tpu.forward.spool import WireSpool
+                spool = WireSpool(
+                    max_bytes=int(getattr(
+                        self.config, "tpu_forward_spool_max_bytes",
+                        32 << 20)),
+                    max_age=self.config.forward_spool_max_age_seconds(),
+                    dir=(getattr(self.config,
+                                 "tpu_forward_spool_dir", "") or None))
             self._sharded_fwd = ShardedForwarder(
                 addrs, compression=float(self.config.tpu_compression),
                 credentials=self._forward_grpc_credentials(),
                 discoverer=discoverer, service=service,
-                retry_budget=max(self.interval * 0.9, 1.0))
+                retry_budget=max(self.interval * 0.9, 1.0),
+                breaker_threshold=int(getattr(
+                    self.config, "tpu_breaker_threshold", 5)),
+                breaker_cooldown=self.config.breaker_cooldown_seconds(),
+                spool=spool, on_replay=self._on_spool_replay)
         return self._sharded_fwd
+
+    def _on_spool_replay(self, dest: str, n_items: int) -> None:
+        """Worker-thread callback: one spooled wire replayed to a
+        recovered destination (ledger crediting happens by cumulative
+        delta at the next flush — this just surfaces the live
+        counters)."""
+        self.bump("replay_wires_sent")
+        self.bump("replay_items_sent", n_items)
 
     def _forward_sharded(self, fwd, rows, trace_ctx, led, cyc,
                          span) -> dict:
@@ -1929,9 +1987,31 @@ class Server:
         if self._draining:
             budget = max(self.interval, 5.0)
         deadline = time.monotonic() + budget
+        from veneur_tpu.forward.spool import Spooled
         split: dict[str, int] = {}
         done: list[threading.Event] = []
         for dest, body, n in batches:
+            # outage absorption at route time: a destination whose
+            # breaker is open (cooldown running) gets its wire parked
+            # in the spool without occupying a queue slot; once the
+            # cooldown elapses should_spool turns False and exactly
+            # one wire rides through as the half-open probe.  Drain
+            # flushes never spool — shutdown ships or drops, now.
+            if not self._draining and fwd.should_spool(dest):
+                if fwd.spool.put(dest, body, n):
+                    self.bump("forward_spooled_wires")
+                    self.bump("forward_spooled_items", n)
+                    if led is not None:
+                        self.ledger.credit_forward_spooled(led, n)
+                else:
+                    # single body over the spool's byte cap: an
+                    # attributed drop, same bucket as a busy-drop
+                    self.bump("forward_spool_rejected_items", n)
+                    self.bump("metrics_dropped", n)
+                    if led is not None:
+                        self.ledger.credit_forward_split(
+                            led, dropped=n)
+                continue
             ch = None
             if cyc is not None and span is not None:
                 ch = cyc.child(span, "forward.shard",
@@ -1950,6 +2030,17 @@ class Server:
                     if led is not None:
                         self.ledger.credit_forward_wire(
                             led, rows=n_items, nbytes=nbytes)
+                elif isinstance(err, Spooled):
+                    # the failed wire was absorbed into the spool,
+                    # not dropped: its rows stay split-credited (the
+                    # spool ledger owns them from here), so no
+                    # metrics_dropped
+                    self.bump("forward_spooled_async_items", n_items)
+                    self.bump("forward_errors")
+                    if led is not None:
+                        self.ledger.credit_spool_outcome(
+                            led, spooled_async=n_items)
+                        self.ledger.credit_forward_wire(led, errors=1)
                 else:
                     self.bump("metrics_dropped", n_items)
                     self.bump("forward_errors")
@@ -1998,6 +2089,24 @@ class Server:
         for landed in done:
             if not landed.wait(max(0.0, deadline - time.monotonic())):
                 self.bump("forward_shard_overruns")
+        if fwd.spool is not None:
+            # age out over-cap wires, credit replays since the last
+            # flush to this interval's record, and seal one spool
+            # conservation snapshot — the cross-interval proof that
+            # spooled == replayed + expired + queued + inflight
+            expired = fwd.spool.sweep()
+            if expired:
+                self.bump("spool_expired_swept_items", expired)
+            replayed_now = fwd.replayed_items
+            delta = replayed_now - self._replayed_credited
+            if delta > 0:
+                self._replayed_credited = replayed_now
+                if led is not None:
+                    self.ledger.credit_spool_outcome(
+                        led, replayed=delta)
+            self._spool_ledger.seal_snapshot(
+                fwd.spool.stats(),
+                seq=led.seq if led is not None else 0)
         return split
 
     def _forward_http(self, rows, trace_ctx=None, led=None) -> None:

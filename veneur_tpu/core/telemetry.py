@@ -214,6 +214,18 @@ class Telemetry:
               self._delta("drain_wires_received"))
         count("veneur.import.drain_items_total",
               self._delta("drain_items_received"))
+        # spool-and-replay traffic, both directions: wires this node
+        # replayed out of its outage spool after a destination
+        # recovered, and replay-flagged wires accepted from peers
+        # that rode out OUR outage
+        count("veneur.forward.replay.wires_total",
+              self._delta("replay_wires_sent"))
+        count("veneur.forward.replay.items_total",
+              self._delta("replay_items_sent"))
+        count("veneur.import.replay_wires_total",
+              self._delta("replay_wires_received"))
+        count("veneur.import.replay_items_total",
+              self._delta("replay_items_received"))
         # discovery refresh health for the sharded forward ring:
         # reason-tagged refresh errors (keep-last-good degradation)
         fwd = getattr(self.server, "_sharded_fwd", None)
@@ -225,6 +237,49 @@ class Telemetry:
                 self.server.stats[key] = int(total)
                 count("veneur.discovery.refresh_errors_total",
                       self._delta(key), (f"reason:{reason}",))
+            # per-destination circuit breakers on the forward
+            # workers: live state gauge (0=closed 1=half_open
+            # 2=open) + cumulative trips and short-circuited sends
+            for dest, bs in sorted(fwd.breaker_states().items()):
+                gauge("veneur.forward.breaker.state",
+                      bs["state_code"], (f"destination:{dest}",))
+                key = f"breaker_opens_{dest}"
+                self.server.stats[key] = int(bs["opens"])
+                count("veneur.forward.breaker.opens_total",
+                      self._delta(key), (f"destination:{dest}",))
+                key = f"breaker_short_circuits_{dest}"
+                self.server.stats[key] = int(bs["short_circuits"])
+                count("veneur.forward.breaker.short_circuit_total",
+                      self._delta(key), (f"destination:{dest}",))
+            # outage spool accounting: lifetime intake/replay totals,
+            # reason-tagged expiry (the attributed-loss path), and
+            # the live backlog gauges an operator sizes the spool by
+            sp = fwd.spool_stats()
+            if sp is not None:
+                for skey, metric in (
+                        ("spooled_items",
+                         "veneur.forward.spool.spooled_items_total"),
+                        ("replayed_items",
+                         "veneur.forward.spool.replayed_items_total"),
+                        ("rejected_items",
+                         "veneur.forward.spool.rejected_items_total")):
+                    key = f"spool_{skey}"
+                    self.server.stats[key] = int(sp[skey])
+                    count(metric, self._delta(key))
+                for reason, n in sorted(
+                        sp["expired_by_reason"].items()):
+                    key = f"spool_expired_{reason}"
+                    self.server.stats[key] = int(n)
+                    count("veneur.forward.spool.expired_items_total",
+                          self._delta(key), (f"reason:{reason}",))
+                gauge("veneur.forward.spool.queued_items",
+                      sp["queued_items"])
+                gauge("veneur.forward.spool.queued_bytes",
+                      sp["queued_bytes"])
+        # cross-interval spool-ledger verdict (spooled == replayed +
+        # expired + queued + inflight; see docs/observability.md)
+        count("veneur.ledger.spool_imbalance_total",
+              self._delta("spool_ledger_imbalance"))
         sentry_client = getattr(self.server, "sentry", None)
         if sentry_client is not None:
             # reference sentry.go:61 reports sentry.errors_total per

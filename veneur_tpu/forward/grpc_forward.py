@@ -54,6 +54,13 @@ SPAN_ID_KEY = "veneur-span-id"
 # peers ignore the key — a drained wire degrades to a normal import.
 DRAIN_KEY = "veneur-drain"
 
+# spool-and-replay: a local that rode out a destination outage flags
+# the replayed wires so the recovered global accepts them past its
+# interval cutoff and books them under a replay protocol in the
+# ledger.  Old peers ignore the key — a replayed wire degrades to a
+# normal import.
+REPLAY_KEY = "veneur-replay"
+
 
 def decode_drain_metadata(metadata) -> bool:
     """True when the wire is a shutdown drain handoff; False when the
@@ -61,6 +68,17 @@ def decode_drain_metadata(metadata) -> bool:
     try:
         md = {k: v for k, v in (metadata or ())}
         return md.get(DRAIN_KEY, "") == "1"
+    except (TypeError, ValueError):
+        return False
+
+
+def decode_replay_metadata(metadata) -> bool:
+    """True when the wire is a spool replay after an outage; False
+    when the key is absent/malformed — a bad flag never rejects an
+    import (fail-open, same stance as the drain flag)."""
+    try:
+        md = {k: v for k, v in (metadata or ())}
+        return md.get(REPLAY_KEY, "") == "1"
     except (TypeError, ValueError):
         return False
 
@@ -768,6 +786,7 @@ class ImportServer:
         md = context.invocation_metadata()
         tid, sid = decode_trace_metadata(md)
         drain = decode_drain_metadata(md)
+        replay = decode_replay_metadata(md)
         ledger = getattr(core, "ledger", None)
         # decode outside the ingest lock: while another handler's
         # interval fold holds it (or _apply_staged runs the device
@@ -787,7 +806,9 @@ class ImportServer:
                 # overflow (the table counted them) vs invalid
                 # (malformed/non-finite, dropped before the table)
                 ov = core.table.overflow_total() - ov0
-                proto = "grpc-import-drain" if drain else "grpc-import"
+                proto = ("grpc-import-drain" if drain
+                         else "grpc-import-replay" if replay
+                         else "grpc-import")
                 ledger.ingest(proto, processed=acc + dropped,
                               staged=acc, overflow=ov,
                               invalid=dropped - ov)
@@ -801,6 +822,13 @@ class ImportServer:
             # interval under core.lock), surfaced for the runbook
             core.bump("drain_wires_received")
             core.bump("drain_items_received", acc)
+        if replay:
+            # a peer rode out OUR outage in its spool: these samples
+            # belong to an earlier interval but stage into the current
+            # one (late-but-counted beats lost), surfaced for the
+            # runbook
+            core.bump("replay_wires_received")
+            core.bump("replay_items_received", acc)
         if dropped:
             core.bump("metrics_dropped", dropped)
         note = getattr(core, "note_import_span", None)

@@ -53,10 +53,22 @@ TRACE_HEADER = "X-Veneur-Trace"
 # header — a drained wire degrades to a normal import.
 DRAIN_HEADER = "X-Veneur-Drain"
 
+# spool-and-replay twin of grpc_forward.REPLAY_KEY: a local that rode
+# out this global's outage flags the replayed /import POST so the
+# global books it under a replay protocol.  Old peers ignore the
+# header — a replayed wire degrades to a normal import.
+REPLAY_HEADER = "X-Veneur-Replay"
+
 
 def decode_drain_header(value: str | None) -> bool:
     """True when the request is a shutdown drain handoff; False on
     absent/malformed (fail-open: never rejects the import)."""
+    return value == "1"
+
+
+def decode_replay_header(value: str | None) -> bool:
+    """True when the request is a spool replay after an outage; False
+    on absent/malformed (fail-open: never rejects the import)."""
     return value == "1"
 
 

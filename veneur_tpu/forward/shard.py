@@ -49,6 +49,7 @@ from veneur_tpu.forward.destpool import DestinationPool
 from veneur_tpu.forward.discovery import DestinationRing, StaticDiscoverer
 from veneur_tpu.forward.ring import ConsistentRing
 from veneur_tpu.forward.route import _TYPE_NAMES, RoutedWire
+from veneur_tpu.forward.spool import Spooled, WireSpool
 
 log = logging.getLogger("veneur_tpu.forward.shard")
 
@@ -86,7 +87,11 @@ class ShardedForwarder:
                  queue_size: int = 8, retries: int = 2,
                  backoff: float = 0.25, discoverer=None,
                  service: str = "forward",
-                 retry_budget: float | None = None):
+                 retry_budget: float | None = None,
+                 breaker_threshold: int = 5,
+                 breaker_cooldown: float = 5.0,
+                 spool: WireSpool | None = None,
+                 on_replay=None):
         addresses = tuple(addresses)
         if discoverer is None:
             if not addresses:
@@ -106,9 +111,17 @@ class ShardedForwarder:
         self.compression = float(compression)
         self._credentials = credentials
         self._timeout = timeout
+        self.spool = spool
+        self.on_replay = on_replay
+        self.replayed_wires = 0
+        self.replayed_items = 0
+        self.replay_failures = 0
         self.pool = DestinationPool(queue_size=queue_size,
                                     retries=retries, backoff=backoff,
-                                    retry_budget=retry_budget)
+                                    retry_budget=retry_budget,
+                                    breaker_threshold=breaker_threshold,
+                                    breaker_cooldown=breaker_cooldown,
+                                    on_sent=self._maybe_replay)
         self._clients: dict[str, object] = {}
         self._clients_lock = threading.Lock()
         self.reshards = 0
@@ -157,6 +170,12 @@ class ShardedForwarder:
         # departed members: stop their bounded workers and close their
         # cached channels — the leak a static member list never had
         self.pool.retire(self.addresses)
+        if self.spool is not None:
+            # wires spooled for a member that left the ring for good
+            # will never replay there — expire them (reason
+            # ``retired``) so the spool ledger stays sealed
+            for dest in removed:
+                self.spool.drop_dest(dest)
         evicted = []
         with self._clients_lock:
             for dest in removed:
@@ -251,7 +270,17 @@ class ShardedForwarder:
         cutoff: a send whose turn comes after it raises
         :class:`DeadlineExceeded` instead of blocking past the
         interval.  ``drain`` flags the wire as a shutdown handoff so
-        the receiving global accepts it past its interval cutoff."""
+        the receiving global accepts it past its interval cutoff —
+        and bypasses an open breaker (the final handoff is attempted
+        even to a flapping peer).
+
+        When a :class:`WireSpool` is attached, a send that fails for
+        any reason (breaker open, retry budget exhausted, deadline
+        missed) parks its body in the spool instead of dropping;
+        ``on_result`` then fires with :class:`Spooled` wrapping the
+        original error so the caller books an absorbed wire, not a
+        loss.  Drain wires never spool — shutdown is the last chance
+        to ship, not to buffer."""
         from veneur_tpu.forward.grpc_forward import (DRAIN_KEY,
                                                      SPAN_ID_KEY,
                                                      TRACE_ID_KEY)
@@ -277,8 +306,73 @@ class ShardedForwarder:
             self.client(dest).send_wire(body, timeout=timeout,
                                         metadata=metadata)
 
+        spool = self.spool
+        if spool is not None and not drain:
+            orig_cb = on_result
+
+            def _absorb(dest_, n, err, tries, body=body,
+                        orig_cb=orig_cb):
+                if err is not None and spool.put(dest_, body, n):
+                    err = Spooled(err)
+                if orig_cb is not None:
+                    orig_cb(dest_, n, err, tries)
+
+            on_result = _absorb
+
         return self.pool.submit(dest, _ship, n_items=n_items,
-                                on_result=on_result)
+                                on_result=on_result,
+                                bypass_breaker=drain)
+
+    def should_spool(self, dest: str) -> bool:
+        """Route-time decision: True when ``dest``'s breaker is open
+        (cooldown still running) and a spool is attached — the wire
+        goes straight to the spool without occupying a queue slot.
+        Returns False once the cooldown elapses so exactly one routed
+        wire rides through as the half-open probe."""
+        return self.spool is not None \
+            and not self.pool.would_allow(dest)
+
+    def _maybe_replay(self, dest: str) -> None:
+        """Drain the spool for a destination that just took a
+        successful send (runs ON its worker thread, so replay
+        serializes with normal sends).  Stops on the first failure:
+        the entry goes back to the front of the queue and the
+        breaker books the failure."""
+        spool = self.spool
+        if spool is None:
+            return
+        from veneur_tpu.forward.grpc_forward import REPLAY_KEY
+        while True:
+            entry = spool.take(dest)
+            if entry is None:
+                return
+            body = entry.read()
+            if body is None:
+                # disk segment vanished underneath us: expired, never
+                # unattributed
+                spool.discard(entry, "age")
+                continue
+            try:
+                self.client(dest).send_wire(
+                    body, timeout=self._timeout,
+                    metadata=((REPLAY_KEY, "1"),))
+            except Exception as e:
+                spool.requeue(entry)
+                self.replay_failures += 1
+                br = self.pool.breaker(dest)
+                if br is not None:
+                    br.record_failure()
+                log.warning("spool replay to %s failed; requeued "
+                            "(%s)", dest, e)
+                return
+            spool.mark_replayed(entry)
+            self.replayed_wires += 1
+            self.replayed_items += entry.n_items
+            if self.on_replay is not None:
+                try:
+                    self.on_replay(dest, entry.n_items)
+                except Exception:
+                    pass
 
     # -- lifecycle / introspection -------------------------------------
 
@@ -291,7 +385,17 @@ class ShardedForwarder:
         return self.pool.stats()
 
     def totals(self) -> dict:
-        return self.pool.totals()
+        out = self.pool.totals()
+        out["replayed_wires"] = self.replayed_wires
+        out["replayed_items"] = self.replayed_items
+        out["replay_failures"] = self.replay_failures
+        return out
+
+    def breaker_states(self) -> dict:
+        return self.pool.breaker_states()
+
+    def spool_stats(self) -> dict | None:
+        return None if self.spool is None else self.spool.stats()
 
     def stop(self) -> None:
         self.pool.stop()

@@ -32,7 +32,8 @@ from veneur_tpu.observe.devicecost import (DeviceCostRegistry, REGISTRY,
                                            instrument)
 from veneur_tpu.observe.flushring import FlushRecord, FlushRing
 from veneur_tpu.observe.ledger import (ClassDropTally, Ledger,
-                                       LedgerRecord)
+                                       LedgerRecord, SpoolLedger,
+                                       SpoolLedgerRecord)
 from veneur_tpu.observe.tracer import (FlushCycle, FlushTracer,
                                        NULL_CYCLE, NullCycle)
 from veneur_tpu.observe.traceindex import TraceIndex, span_to_dict
@@ -42,4 +43,5 @@ __all__ = ["DeviceCostRegistry", "REGISTRY", "instrument",
            "FlushRecord", "FlushRing", "FlushCycle", "FlushTracer",
            "NullCycle", "NULL_CYCLE", "capture_device_profile",
            "ClassDropTally", "Ledger", "LedgerRecord",
+           "SpoolLedger", "SpoolLedgerRecord",
            "TraceIndex", "span_to_dict"]

@@ -102,6 +102,11 @@ class LedgerRecord:
     #    ``forwarded == sum(dests) + dropped`` below
     forward_split: dict[str, int] = field(default_factory=dict)
     forward_split_dropped: int = 0
+    # rows whose wire went to the outage spool INSTEAD of a worker
+    # (breaker open at route time) — synchronous like the split, so
+    # the seal extends to ``forwarded == sum(dests) + spooled +
+    # dropped``: an absorbed outage balances, it doesn't owe
+    forward_spooled: int = 0
     # -- membership change (live reshard): a discovery swap moved
     #    these arcs, so a per-destination skew vs the previous interval
     #    is a REBALANCE (attributed here), not a loss
@@ -113,6 +118,15 @@ class LedgerRecord:
     forward_wire_rows: int = 0
     forward_wire_bytes: int = 0
     forward_errors: int = 0
+    # rows spooled AFTER their wire failed on the worker (retry budget
+    # exhausted / deadline missed / breaker tripped mid-queue): their
+    # rows were already credited to forward_split at route time, so
+    # this is a wire OUTCOME, not a second balance input — the
+    # cross-interval SpoolLedger owns their conservation from here
+    forward_spooled_async: int = 0
+    # rows replayed out of the spool this interval (theirs was an
+    # EARLIER interval's balance; informational by construction)
+    forward_replayed: int = 0
     # per-destination rows dropped because the send missed the
     # interval deadline (async like forward_errors — the attempt
     # resolves on the worker after route time)
@@ -159,7 +173,10 @@ class LedgerRecord:
             "emitted_per_sink": dict(self.emitted_per_sink),
             "forward_split": {"per_dest": dict(self.forward_split),
                               "dropped": self.forward_split_dropped,
+                              "spooled": self.forward_spooled,
                               "owed": self.split_owed},
+            "spool": {"spooled_async": self.forward_spooled_async,
+                      "replayed": self.forward_replayed},
             "reshard": {"epoch": self.reshard_epoch,
                         "added": list(self.reshard_added),
                         "removed": list(self.reshard_removed),
@@ -261,6 +278,28 @@ class Ledger:
                     rec.forward_split.get(dest, 0) + int(rows))
             rec.forward_split_dropped += int(dropped)
 
+    def credit_forward_spooled(self, rec: LedgerRecord,
+                               rows: int = 0) -> None:
+        """Credit rows routed INTO the outage spool at route time
+        (destination breaker open — no worker ever saw them).  A
+        synchronous balance input alongside the per-destination split:
+        the interval's forwarded rows are conserved as sent + spooled
+        + attributed drops.  The spool's own cross-interval ledger
+        (:class:`SpoolLedger`) takes over from here."""
+        with self._lock:
+            rec.forward_spooled += int(rows)
+
+    def credit_spool_outcome(self, rec: LedgerRecord,
+                             spooled_async: int = 0,
+                             replayed: int = 0) -> None:
+        """Async spool traffic: rows absorbed after their send failed
+        on a worker (already split-credited at route time) and rows
+        replayed out of the spool this interval.  Informational wire
+        outcomes, not balance inputs."""
+        with self._lock:
+            rec.forward_spooled_async += int(spooled_async)
+            rec.forward_replayed += int(replayed)
+
     def credit_reshard(self, rec: LedgerRecord, epoch: int,
                        added, removed, moved_rows: int) -> None:
         """Attribute a live membership change to this interval: the
@@ -326,10 +365,14 @@ class Ledger:
             # sharded-forward conservation: only checked when the
             # router credited a split this interval (the legacy
             # single-destination path never does), so a forward that
-            # overran the interval budget can't fake an imbalance
-            if rec.forward_split or rec.forward_split_dropped:
+            # overran the interval budget can't fake an imbalance.
+            # Spooled rows are a full-fledged split outcome: an
+            # outage the spool absorbed balances instead of owing.
+            if (rec.forward_split or rec.forward_split_dropped
+                    or rec.forward_spooled):
                 rec.split_owed = rec.forwarded_rows - (
                     sum(rec.forward_split.values())
+                    + rec.forward_spooled
                     + rec.forward_split_dropped)
             rec.balanced = (rec.owed == 0 and rec.staged_drift == 0
                             and rec.overflow_drift == 0
@@ -405,6 +448,13 @@ class Ledger:
             out["forward_split_total"] = sum(per_dest.values())
             out["forward_split_dropped_total"] = sum(
                 r.forward_split_dropped for r in recs)
+        spooled = sum(r.forward_spooled for r in recs)
+        spooled_async = sum(r.forward_spooled_async for r in recs)
+        replayed = sum(r.forward_replayed for r in recs)
+        if spooled or spooled_async or replayed:
+            out["forward_spooled_total"] = spooled
+            out["forward_spooled_async_total"] = spooled_async
+            out["forward_replayed_total"] = replayed
         timeouts = sum(
             sum(r.forward_timeout_dropped.values()) for r in recs)
         if timeouts:
@@ -415,6 +465,149 @@ class Ledger:
             out["reshard_moved_rows_total"] = sum(
                 r.reshard_moved_rows for r in recs)
         return out
+
+
+@dataclass
+class SpoolLedgerRecord:
+    """One sealed snapshot of the outage spool's lifetime account.
+
+    The spool's counters are CUMULATIVE (a wire spooled in interval N
+    may replay in interval N+40), so conservation is checked on the
+    running totals, not per-interval deltas:
+
+        spooled == replayed + expired + still_queued + inflight
+
+    ``expired_by_reason`` names every expiry (age cap, byte cap,
+    destination retired) — an expired wire is an attributed loss,
+    never an unaccounted one.
+    """
+
+    seq: int = 0
+    start_unix: float = 0.0
+    spooled_items: int = 0
+    replayed_items: int = 0
+    expired_items: int = 0
+    queued_items: int = 0
+    inflight_items: int = 0
+    queued_bytes: int = 0
+    expired_by_reason: dict[str, int] = field(default_factory=dict)
+    sealed: bool = False
+    balanced: bool = True
+    owed: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "seq": self.seq,
+            "start_unix": self.start_unix,
+            "spooled_items": self.spooled_items,
+            "replayed_items": self.replayed_items,
+            "expired_items": self.expired_items,
+            "queued_items": self.queued_items,
+            "inflight_items": self.inflight_items,
+            "queued_bytes": self.queued_bytes,
+            "expired_by_reason": dict(self.expired_by_reason),
+            "balanced": self.balanced,
+            "owed": self.owed,
+        }
+
+
+class SpoolLedger:
+    """Cross-interval conservation ledger for the outage spool.
+
+    The server seals one snapshot per flush interval from the
+    ``WireSpool``'s stats (``seal_snapshot``); any instant where
+    ``spooled != replayed + expired + queued + inflight`` is an
+    imbalance — strict mode escalates it exactly like the interval
+    ledger (error log + ``on_imbalance``), because a spool that
+    leaks items silently would turn the zero-loss story back into a
+    detector.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 node: str = "veneur", strict: bool = False,
+                 on_imbalance=None):
+        self.node = node
+        self.strict = strict
+        self.on_imbalance = on_imbalance
+        self._lock = threading.Lock()
+        self._ring: deque[SpoolLedgerRecord] = deque(maxlen=capacity)
+        self._seq = 0
+        self.imbalanced_total = 0
+
+    def seal_snapshot(self, stats: dict,
+                      seq: int = 0) -> SpoolLedgerRecord:
+        """Seal one conservation snapshot from ``WireSpool.stats()``
+        output (cumulative counters + current queue state)."""
+        rec = SpoolLedgerRecord(
+            start_unix=time.time(),
+            spooled_items=int(stats.get("spooled_items", 0)),
+            replayed_items=int(stats.get("replayed_items", 0)),
+            expired_items=int(stats.get("expired_items", 0)),
+            queued_items=int(stats.get("queued_items", 0)),
+            inflight_items=int(stats.get("inflight_items", 0)),
+            queued_bytes=int(stats.get("queued_bytes", 0)),
+            expired_by_reason=dict(
+                stats.get("expired_by_reason", {})),
+        )
+        rec.owed = rec.spooled_items - (
+            rec.replayed_items + rec.expired_items
+            + rec.queued_items + rec.inflight_items)
+        rec.balanced = rec.owed == 0
+        rec.sealed = True
+        with self._lock:
+            self._seq += 1
+            rec.seq = int(seq) or self._seq
+            self._ring.append(rec)
+            if not rec.balanced:
+                self.imbalanced_total += 1
+        if not rec.balanced:
+            msg = ("spool ledger imbalance node=%s seq=%d: owed=%d "
+                   "items (spooled=%d replayed=%d expired=%d "
+                   "queued=%d inflight=%d)")
+            args = (self.node, rec.seq, rec.owed, rec.spooled_items,
+                    rec.replayed_items, rec.expired_items,
+                    rec.queued_items, rec.inflight_items)
+            if self.strict:
+                log.error(msg, *args)
+            else:
+                log.warning(msg, *args)
+            if self.on_imbalance is not None:
+                self.on_imbalance(rec)
+        return rec
+
+    def records(self) -> list[SpoolLedgerRecord]:
+        with self._lock:
+            return list(self._ring)
+
+    def to_json(self) -> bytes:
+        recs = self.records()
+        out = {
+            "node": self.node,
+            "strict": self.strict,
+            "snapshots": len(recs),
+            "imbalanced": [r.seq for r in recs if not r.balanced],
+            "records": [r.to_dict() for r in recs],
+        }
+        return json.dumps(out, indent=1).encode()
+
+    def summary(self) -> dict:
+        """The cumulative counters are monotone, so the LAST snapshot
+        is the lifetime account (summing across snapshots would
+        double-count); balanced/imbalanced tally every snapshot."""
+        recs = self.records()
+        last = recs[-1] if recs else SpoolLedgerRecord()
+        return {
+            "snapshots": len(recs),
+            "balanced": sum(1 for r in recs if r.balanced),
+            "imbalanced": sum(1 for r in recs if not r.balanced),
+            "owed_total": sum(abs(r.owed) for r in recs),
+            "spooled_items": last.spooled_items,
+            "replayed_items": last.replayed_items,
+            "expired_items": last.expired_items,
+            "queued_items": last.queued_items,
+            "inflight_items": last.inflight_items,
+            "expired_by_reason": dict(last.expired_by_reason),
+        }
 
 
 @dataclass

@@ -12,13 +12,17 @@ bounded handoff queue:
   counted ``busy_drop`` instead of a queue pile-up (mirroring the
   reference's drop-don't-buffer flush stance, flusher.go:536-549)
 - transient sink errors retry in-worker with FULL-JITTER exponential
-  backoff (destpool.full_jitter_delay — delay ~ U(0, base *
-  2^attempt)), so a flapping backend can't synchronize retry storms
-  across sink workers; total in-worker retry time is capped at
-  ``retry_budget`` (the interval budget) so retrying can't bleed past
-  the next interval
-- per-sink duration/error/timeout/drop counters feed ``/debug/vars``
-  and the flush-cycle trace
+  backoff (destpool.full_jitter_delay — delay ~ U(0, min(base *
+  2^attempt, max_delay))), so a flapping backend can't synchronize
+  retry storms across sink workers; total in-worker retry time is
+  capped at ``retry_budget`` (the interval budget) so retrying can't
+  bleed past the next interval
+- each sink worker owns a circuit breaker (same machine as the
+  forward path's — forward/breaker.py): a backend that fails
+  ``threshold`` consecutive flushes stops consuming retries entirely;
+  one probe flush per cooldown tests recovery
+- per-sink duration/error/timeout/drop/short-circuit counters feed
+  ``/debug/vars`` and the flush-cycle trace
 """
 
 from __future__ import annotations
@@ -28,6 +32,7 @@ import queue
 import threading
 import time
 
+from veneur_tpu.forward.breaker import OPEN, BreakerOpen, CircuitBreaker
 from veneur_tpu.forward.destpool import full_jitter_delay
 
 log = logging.getLogger("veneur_tpu.sinks.fanout")
@@ -46,13 +51,17 @@ class FlushTask:
 
 class _SinkWorker:
     def __init__(self, name: str, retries: int, backoff: float,
-                 on_error=None, retry_budget: float | None = None):
+                 on_error=None, retry_budget: float | None = None,
+                 breaker: CircuitBreaker | None = None):
         self.name = name
         self.retries = max(0, int(retries))
         self.backoff = backoff
         self.retry_budget = retry_budget
         self.budget_exhausted = 0
         self.on_error = on_error
+        self.breaker = breaker
+        self.short_circuits = 0
+        self._stop = False
         # one slot: at most one flush queued behind the running one
         self.queue: queue.Queue = queue.Queue(maxsize=1)
         self.flushes = 0
@@ -66,19 +75,51 @@ class _SinkWorker:
             target=self._run, daemon=True, name=f"sink-flush-{name}")
         self._thread.start()
 
+    def _fail(self, task: FlushTask, e: BaseException,
+              attempts: int) -> None:
+        self.errors += 1
+        task.error = e
+        if isinstance(e, BreakerOpen):
+            log.debug("sink %s flush short-circuited: breaker open",
+                      self.name)
+        else:
+            log.warning("sink %s flush failed after %d attempts: %s",
+                        self.name, attempts, e)
+        if self.on_error is not None:
+            try:
+                self.on_error(self.name, e)
+            except Exception:
+                pass
+
     def _run(self) -> None:
         while True:
             task = self.queue.get()
             if task is None:
                 return
             start = time.perf_counter()
+            br = self.breaker
             try:
+                if br is not None and not br.allow():
+                    # dead backend: fail the flush instantly instead
+                    # of burning the whole retry ladder against it
+                    self.short_circuits += 1
+                    self._fail(task, BreakerOpen(self.name), 0)
+                    continue
                 for attempt in range(self.retries + 1):
                     try:
                         task.fn()
+                        if br is not None:
+                            br.record_success()
                         break
                     except Exception as e:
-                        retry = attempt < self.retries
+                        retry = (attempt < self.retries
+                                 and not self._stop)
+                        if br is not None:
+                            br.record_failure()
+                            if br.state == OPEN:
+                                # breaker tripped (or the probe
+                                # failed): stop retrying now
+                                retry = False
                         delay = 0.0
                         if retry:
                             delay = full_jitter_delay(self.backoff,
@@ -92,16 +133,7 @@ class _SinkWorker:
                                 self.budget_exhausted += 1
                                 retry = False
                         if not retry:
-                            self.errors += 1
-                            task.error = e
-                            log.warning("sink %s flush failed after "
-                                        "%d attempts: %s", self.name,
-                                        attempt + 1, e)
-                            if self.on_error is not None:
-                                try:
-                                    self.on_error(self.name, e)
-                                except Exception:
-                                    pass
+                            self._fail(task, e, attempt + 1)
                             break
                         self.retry_count += 1
                         time.sleep(delay)
@@ -113,16 +145,20 @@ class _SinkWorker:
                 task.done.set()
 
     def stats(self) -> dict:
-        return {
+        out = {
             "flushes": self.flushes,
             "errors": self.errors,
             "retries": self.retry_count,
             "retry_budget_exhausted": self.budget_exhausted,
+            "short_circuits": self.short_circuits,
             "timeouts": self.timeouts,
             "busy_drops": self.busy_drops,
             "last_duration_s": round(self.last_duration, 6),
             "total_duration_s": round(self.total_duration, 6),
         }
+        if self.breaker is not None:
+            out["breaker"] = self.breaker.stats()
+        return out
 
 
 class SinkFanout:
@@ -132,23 +168,31 @@ class SinkFanout:
     running on their own worker — isolation, not cancellation)."""
 
     def __init__(self, names, retries: int = 2, backoff: float = 0.25,
-                 on_error=None, retry_budget: float | None = None):
+                 on_error=None, retry_budget: float | None = None,
+                 breaker_threshold: int = 5,
+                 breaker_cooldown: float = 5.0):
         self._retries = retries
         self._backoff = backoff
         self._on_error = on_error
         self._retry_budget = retry_budget
-        self._workers = {
-            n: _SinkWorker(n, retries, backoff, on_error,
-                           retry_budget=retry_budget)
-            for n in names}
+        self._breaker_threshold = int(breaker_threshold)
+        self._breaker_cooldown = float(breaker_cooldown)
         self._lock = threading.Lock()
+        self._workers = {}
+        for n in names:
+            self.ensure(n)
+
+    def _new_worker(self, name: str) -> _SinkWorker:
+        return _SinkWorker(
+            name, self._retries, self._backoff, self._on_error,
+            retry_budget=self._retry_budget,
+            breaker=CircuitBreaker(self._breaker_threshold,
+                                   self._breaker_cooldown))
 
     def ensure(self, name: str) -> None:
         with self._lock:
             if name not in self._workers:
-                self._workers[name] = _SinkWorker(
-                    name, self._retries, self._backoff, self._on_error,
-                    retry_budget=self._retry_budget)
+                self._workers[name] = self._new_worker(name)
 
     def dispatch(self, name: str, fn) -> FlushTask | None:
         """Queue a flush on the sink's worker; returns None (and
@@ -182,11 +226,27 @@ class SinkFanout:
         with self._lock:
             return {n: w.stats() for n, w in self._workers.items()}
 
+    def breaker_states(self) -> dict:
+        with self._lock:
+            workers = dict(self._workers)
+        return {n: w.breaker.stats() for n, w in workers.items()
+                if w.breaker is not None}
+
     def stop(self) -> None:
         with self._lock:
             workers = list(self._workers.values())
         for w in workers:
-            try:
-                w.queue.put_nowait(None)
-            except queue.Full:
-                pass
+            w._stop = True
+            for _ in range(2):
+                try:
+                    w.queue.put_nowait(None)
+                    break
+                except queue.Full:
+                    try:  # discard the queued flush to make room
+                        dropped = w.queue.get_nowait()
+                        if dropped is not None:
+                            dropped.done.set()
+                    except queue.Empty:
+                        pass
+        for w in workers:
+            w._thread.join(timeout=5.0)
