@@ -3156,6 +3156,197 @@ def chaos_bench() -> dict:
     return out
 
 
+def overload_bench() -> dict:
+    """``--overload``: the overload-riding soak — ISSUE 14's
+    deliverable.  Blasts a real Server with >= 2x its admitted
+    capacity (Zipf-distributed tenants against per-tenant token
+    buckets), engages the pressure tiers (new-series freeze +
+    class-ordered sampling + histogram width ladder), and forces a
+    flush overrun so the watchdog coalesces a tick.  Passes on
+    ACCOUNTING ONLY: every interval's ledger balances with
+    ``unattributed_lost == 0``, every shed sample is named by
+    tenant+reason (``shed_owed == 0``), counter increments are
+    conserved EXACTLY through the overload and the coalesced window,
+    and the coalesce is named in the ledger record."""
+    from veneur_tpu.core.config import read_config
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.protocol import columnar
+
+    if QUICK:
+        n_offered, n_counters, tenants = 8_000, 2_000, 12
+    else:
+        n_offered, n_counters, tenants = 40_000, 10_000, 20
+    interval_s = 1.0
+    srv = Server(read_config(data={
+        "interval": "1s", "hostname": "bench-overload",
+        # budgets small enough that >= half the offered load sheds
+        "tpu_overload_tenant_rate": 50.0,
+        "tpu_overload_tenant_burst": 50.0,
+        "tpu_overload_max_tenants": 64,
+        # phase A's gauge cardinality crosses this ceiling, so the
+        # post-flush tick engages pressure for phase B
+        "tpu_overload_occupancy_hi": 0.05,
+        "tpu_gauge_rows": 4096,
+    }))
+    parser = columnar.ColumnarParser()
+    if not parser.available:
+        parser = None
+    rng = np.random.default_rng(20260806)
+
+    def feed(lines):
+        for i in range(0, len(lines), 128):
+            chunk = list(lines[i:i + 128])
+            if parser is not None:
+                srv.handle_packet_batch([b"\n".join(chunk)], parser)
+            else:
+                for ln in chunk:
+                    srv.handle_packet(ln)
+
+    flushed_counter_sum = 0.0
+
+    def flush():
+        nonlocal flushed_counter_sum
+        res = srv.flush_once()
+        for m in res.metrics:
+            if m.name.startswith("ovl.count."):
+                flushed_counter_sum += m.value
+        return srv.ledger.last()
+
+    out: dict = {"mode": "overload_soak", "quick": QUICK,
+                 "offered_noncounter": n_offered,
+                 "offered_counters": 0, "tenants": tenants,
+                 "native_parser": parser is not None}
+
+    # ---- phase A: tenant budgets vs >= 2x offered load --------------
+    z = np.minimum(rng.zipf(1.5, size=n_offered), tenants)
+    lines = []
+    for i, t in enumerate(z):
+        c = i % 3
+        if c == 0:
+            lines.append(b"ovl.timer.%d:%d|ms|#tenant:t%d"
+                         % (i % 50, i % 997, t))
+        elif c == 1:
+            lines.append(b"ovl.gauge.%d:%d|g|#tenant:t%d"
+                         % (i % 50, i, t))
+        else:
+            lines.append(b"ovl.set.%d:m%d|s|#tenant:t%d"
+                         % (i % 20, i, t))
+    counters_a = [b"ovl.count.%d:1|c|#tenant:t%d"
+                  % (i % 16, (i % tenants) + 1)
+                  for i in range(n_counters)]
+    out["offered_counters"] += n_counters
+    t0 = time.perf_counter()
+    feed(lines)
+    feed(counters_a)
+    out["ingest_seconds_a"] = round(time.perf_counter() - t0, 3)
+    rec_a = flush()
+    da = rec_a.to_dict()
+    out["phase_a"] = {"ledger": da, "shed": rec_a.shed,
+                      "admitted_noncounter": n_offered - rec_a.shed}
+    pressure_after_a = srv.overload.pressure.engaged
+
+    # ---- phase B: pressure tiers (freeze + class sampling + ladder) -
+    width_base = srv.table._eff_histo_slots_base
+    lines_b = [b"ovl.fresh.%d:1|g|#tenant:t%d"
+               % (i, (i % tenants) + 1)
+               for i in range(n_offered // 8)]          # NEW series
+    lines_b += [b"ovl.timer.%d:%d|ms|#tenant:t%d"       # known series
+                % (i % 50, i, (i % tenants) + 1)
+                for i in range(n_offered // 8)]
+    counters_b = [b"ovl.count.%d:1|c|#tenant:t%d"
+                  % (i % 16, (i % tenants) + 1)
+                  for i in range(n_counters // 4)]
+    out["offered_counters"] += n_counters // 4
+    feed(lines_b)
+    feed(counters_b)
+    rec_b = flush()
+    out["phase_b"] = {"ledger": rec_b.to_dict(),
+                      "pressure_engaged_entering": pressure_after_a,
+                      "pressure": srv.overload.pressure.to_dict(),
+                      "histo_width_base": int(width_base),
+                      "histo_width_now": int(
+                          srv.table._eff_histo_slots)}
+
+    # ---- phase C: flush-overrun watchdog -> coalesced tick ----------
+    # slow the SYNCHRONOUS pipeline (device flush + emit), not a sink:
+    # the budget-bounded sink waits are excluded from the watchdog by
+    # design (a wedged sink can never delay the next tick), so the
+    # overrun must come from the part that actually backs up staging
+    _orig_flusher_flush = srv.flusher.flush
+
+    def _slow_flush(*a, **k):
+        time.sleep(max(interval_s * 0.9, 1.0) + 0.6)
+        return _orig_flusher_flush(*a, **k)
+
+    srv.flusher.flush = _slow_flush
+    flush()                      # overruns its budget -> arms coalesce
+    srv.flusher.flush = _orig_flusher_flush
+    counters_c = [b"ovl.count.%d:1|c|#tenant:t1" % (i % 16,)
+                  for i in range(n_counters // 4)]
+    out["offered_counters"] += n_counters // 4
+    feed(counters_c)
+    flush()                      # coalesced: no swap this tick
+    coalesce_skipped = srv.stats.get("flush_coalesced", 0)
+    rec_cover = flush()          # ONE swap covering both intervals
+    out["phase_c"] = {
+        "flush_overruns": srv.overload.flush_overruns,
+        "coalesced_ticks": coalesce_skipped,
+        "cover_record": rec_cover.to_dict(),
+    }
+
+    ledsum = srv.ledger.summary()
+    ovl_snap = srv.overload.snapshot()
+    srv.shutdown()
+
+    shed_by = ledsum.get("shed_by", {})
+    reasons = {r for t in shed_by.values() for r in t}
+    admitted = n_offered - rec_a.shed
+    unattributed = (ledsum["imbalanced"] + ledsum["owed_total"]
+                    + ledsum.get("shed_owed_total", 0))
+    counter_drift = abs(flushed_counter_sum
+                        - out["offered_counters"])
+    out["ledger"] = ledsum
+    out["overload"] = ovl_snap
+    out["flushed_counter_sum"] = flushed_counter_sum
+    out["unattributed_lost"] = int(unattributed)
+    gates = {
+        # conservation: nothing lost without a name on it
+        "unattributed_zero": unattributed == 0,
+        "ledgers_balanced": ledsum["imbalanced"] == 0,
+        # the soak genuinely overloaded the server (>= 2x admission)
+        "overloaded_2x": n_offered >= 2 * max(admitted, 1),
+        "shed_nonempty": ledsum.get("shed_total", 0) > 0,
+        # every shed sample named by tenant AND reason
+        "shed_fully_attributed":
+            ledsum.get("shed_owed_total", 1) == 0
+            and all(t and r for t in shed_by
+                    for r in shed_by[t]),
+        # counters NEVER shed, and their increments conserve exactly
+        # through both the overload and the coalesced window
+        "counters_never_shed": not any(
+            "count" in r for t in shed_by.values() for r in t),
+        "counters_conserved_exactly": counter_drift == 0.0,
+        # pressure engaged and the tiers actually fired
+        "pressure_engaged": pressure_after_a,
+        "series_freeze_fired": "series_freeze" in reasons,
+        "pressure_class_shed_fired": any(
+            r.startswith("pressure:") for r in reasons),
+        "width_ladder_engaged": (
+            out["phase_b"]["histo_width_now"] < width_base),
+        # the watchdog saw the overrun and the coalesce is NAMED
+        "flush_overrun_observed":
+            out["phase_c"]["flush_overruns"] >= 1,
+        "coalesce_named_in_ledger": rec_cover.coalesced >= 1,
+        "coalesced_tick_counted": coalesce_skipped >= 1,
+    }
+    out["overload_gates"] = gates
+    out["overload_pass"] = all(gates.values())
+    out.update(_backend_info())
+    out["captured_unix"] = round(time.time(), 1)
+    _save_artifact("overload_soak", out)
+    return out
+
+
 CONFIGS = (
     ("0_counters_1k_names", bench_counters),
     ("1_cardinality_100k", bench_cardinality),
@@ -3329,6 +3520,13 @@ def _summary_line(out: dict) -> str:
     if out.get("cluster_items_per_sec") is not None:
         line["cluster_items_per_sec"] = out["cluster_items_per_sec"]
         line["global_shards"] = out.get("global_shards")
+    # overload soak verdict: present only for --overload artifacts
+    if out.get("overload_pass") is not None:
+        line["overload_pass"] = out["overload_pass"]
+        line["overload_shed_total"] = out.get("ledger", {}).get(
+            "shed_total")
+        line["overload_unattributed_lost"] = out.get(
+            "unattributed_lost")
     return json.dumps(line, separators=(",", ":"))
 
 
@@ -3439,6 +3637,17 @@ if __name__ == "__main__":
         print(json.dumps({"chaos_summary": True,
                           "chaos_pass": out.get("chaos_pass"),
                           "gates": out.get("chaos_gates")},
+                         separators=(",", ":")))
+    elif "--overload" in sys.argv:
+        out = overload_bench()
+        print(json.dumps(out))
+        print(json.dumps({"overload_summary": True,
+                          "overload_pass": out.get("overload_pass"),
+                          "shed_total": out.get("ledger", {}).get(
+                              "shed_total"),
+                          "unattributed_lost": out.get(
+                              "unattributed_lost"),
+                          "gates": out.get("overload_gates")},
                          separators=(",", ":")))
     elif "--config" in sys.argv:
         _run_one_config(sys.argv[sys.argv.index("--config") + 1])

@@ -581,3 +581,85 @@ def test_soak_artifact_committed_and_stable():
         assert d["control_pure_dispatch_leak_kb"] >= 0.5
         assert "rss_attribution" in d
     assert d["platform"]  # stamped
+
+
+def test_overload_soak_artifact_committed():
+    """bench.py --overload: the overload soak (ISSUE 14).  >=2x the
+    admitted load offered through Zipf-skewed tenants, then a
+    cardinality burst under engaged pressure, then an injected slow
+    flush — and the artifact passes on ACCOUNTING, not throughput:
+    zero unattributed loss, every shed sample named tenant+reason,
+    counters conserved EXACTLY, and each degradation mechanism
+    (freeze, class shed, width ladder, coalesce) observed firing."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench_results", "overload_soak.json")
+    with open(path) as f:
+        d = json.load(f)
+    assert d["mode"] == "overload_soak" and d["quick"] is False
+    assert d["overload_pass"] is True
+    for gate, ok in d["overload_gates"].items():
+        assert ok is True, gate
+
+    led = d["ledger"]
+    assert d["unattributed_lost"] == 0
+    assert led["imbalanced"] == 0
+    assert led["shed_owed_total"] == 0
+    # the attribution map re-sums to the shed arm exactly
+    attributed = sum(n for reasons in led["shed_by"].values()
+                     for n in reasons.values())
+    assert attributed == led["shed_total"] > 0
+    # genuinely overloaded: >=2x what admission let through
+    assert d["phase_a"]["shed"] >= d["phase_a"]["admitted_noncounter"]
+    # counters: never shed, conserved exactly through the flush
+    assert d["flushed_counter_sum"] == d["offered_counters"]
+    reasons = {r for by in led["shed_by"].values() for r in by}
+    assert "tenant_budget" in reasons
+    assert "series_freeze" in reasons
+    assert any(r.startswith("pressure:") for r in reasons)
+    # degradation mechanisms all observed
+    assert d["phase_b"]["pressure"]["engaged"] is True
+    assert d["phase_b"]["histo_width_now"] < \
+        d["phase_b"]["histo_width_base"]
+    assert d["phase_c"]["flush_overruns"] >= 1
+    assert d["phase_c"]["coalesced_ticks"] >= 1
+    assert led["coalesced_total"] >= 1
+    assert "platform" in d and "gates" in d
+
+
+@pytest.mark.slow
+def test_overload_soak_quick_rerun():
+    """Re-run the overload soak end to end (quick scale) — the
+    committed artifact's gates must be reproducible, not a lucky
+    capture."""
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--overload", "--quick"],
+        env={**_ENV, "VENEUR_BENCH_PLATFORM": "cpu"},
+        capture_output=True, text=True, timeout=560,
+        cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr[-2000:]
+    d = json.loads(out.stdout.strip().splitlines()[-1])
+    assert d["overload_summary"] is True
+    assert d["overload_pass"] is True, d["gates"]
+
+
+def test_summary_line_overload_fields():
+    """The --overload summary line carries exactly its verdict (and
+    the normal line never grows the overload fields)."""
+    m = _bench_module()
+    oline = m._summary_line({
+        "overload_pass": True,
+        "ledger": {"shed_total": 44792},
+        "unattributed_lost": 0,
+        "platform": "cpu"})
+    assert len(oline) < 1024
+    od = json.loads(oline)
+    assert od["overload_pass"] is True
+    assert od["overload_shed_total"] == 44792
+    assert od["overload_unattributed_lost"] == 0
+
+    nline = m._summary_line({"platform": "cpu"})
+    nd = json.loads(nline)
+    assert "overload_pass" not in nd
+    assert "overload_shed_total" not in nd

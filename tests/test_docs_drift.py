@@ -163,3 +163,66 @@ def test_operations_runbook_covers_outage_riding():
             "total_lost == 0",
     ):
         assert needle in ops, needle
+
+
+def test_overload_metrics_documented():
+    """ISSUE 14 names, pinned explicitly: the overload controller's
+    shed/pressure/coalesce series, the kernel-drop observation, and
+    the kafka other-sample drop counter."""
+    for name in (
+            "veneur.ledger.shed_total",
+            "veneur.overload.shed_total",
+            "veneur.overload.pressure_level",
+            "veneur.overload.pressure_score",
+            "veneur.flush.overrun_total",
+            "veneur.flush.coalesced_total",
+            "veneur.socket.kernel_drops_total",
+            "veneur.sink.kafka.other_dropped_total",
+    ):
+        assert name in DOCS, name
+        assert any(name in (ROOT / m).read_text() for m in SCANNED), \
+            name
+
+
+def test_overload_env_vars_documented():
+    """ISSUE 14 knobs: the overload env vars must appear in the
+    README env table AND in the operations runbook that explains how
+    to tune them."""
+    readme = (ROOT / "README.md").read_text()
+    ops = (ROOT / "docs" / "operations.md").read_text()
+    for var in ("VENEUR_TPU_OVERLOAD",
+                "VENEUR_TPU_OVERLOAD_TENANT_RATE",
+                "VENEUR_TPU_OVERLOAD_TENANT_BURST",
+                "VENEUR_TPU_OVERLOAD_TENANT_TAG",
+                "VENEUR_TPU_OVERLOAD_MAX_TENANTS",
+                "VENEUR_TPU_OVERLOAD_STAGING_HI",
+                "VENEUR_TPU_OVERLOAD_OCCUPANCY_HI",
+                "VENEUR_TPU_OVERLOAD_LAG_HI",
+                "VENEUR_TPU_OVERLOAD_EXIT_RATIO",
+                "VENEUR_TPU_OVERLOAD_COALESCE"):
+        assert var in readme, var
+        assert var in ops, var
+
+
+def test_operations_runbook_covers_overload_riding():
+    """The ISSUE 14 runbook section: riding out ingest overload,
+    naming the real mechanisms and the accounting identities."""
+    ops = (ROOT / "docs" / "operations.md").read_text()
+    for needle in (
+            "Riding out ingest overload",
+            "/debug/overload",
+            "reason:tenant_budget",
+            "reason:series_freeze",
+            "reason:pressure:",
+            "Counters are never shed",
+            "received == staged + status + shed + overflow + invalid",
+            "veneur.flush.coalesced_total",
+            "veneur.socket.kernel_drops_total",
+            "bench.py --overload",
+            "overload_soak.json",
+    ):
+        assert needle in ops, needle
+
+
+def test_overload_debug_endpoint_documented():
+    assert "/debug/overload" in DOCS

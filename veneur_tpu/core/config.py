@@ -408,6 +408,44 @@ class Config:
     # <dir>/<dest>/<seq>.wire); empty = in-memory only.
     # VENEUR_TPU_FORWARD_SPOOL_DIR overrides.
     tpu_forward_spool_dir: str = ""
+    # overload control (core/overload.py): admission buckets,
+    # priority-tiered shedding, and the flush-overrun coalesce
+    # watchdog.  With the subsystem on but no tenant rate configured
+    # and pressure disengaged, the ingest hot path is untouched (one
+    # boolean per batch).  VENEUR_TPU_OVERLOAD=0 removes it entirely.
+    tpu_overload: bool = True
+    # tag key whose value names the tenant for admission buckets and
+    # shed attribution; series without the tag account to tenant
+    # "default".  VENEUR_TPU_OVERLOAD_TENANT_TAG overrides.
+    tpu_overload_tenant_tag: str = "tenant"
+    # per-tenant admitted samples/second (token-bucket rate) for
+    # non-counter classes; 0 = no tenant budget (counters always
+    # land: their increments fold exactly regardless of load).
+    # VENEUR_TPU_OVERLOAD_TENANT_RATE overrides.
+    tpu_overload_tenant_rate: float = 0.0
+    # bucket burst depth in samples; 0 = 2x the rate.
+    # VENEUR_TPU_OVERLOAD_TENANT_BURST overrides.
+    tpu_overload_tenant_burst: float = 0.0
+    # distinct tenants tracked before the rest aggregate into the
+    # "other" bucket.  VENEUR_TPU_OVERLOAD_MAX_TENANTS overrides.
+    tpu_overload_max_tenants: int = 256
+    # pressure-signal ceilings ("1.0 = saturated" per dimension):
+    # host staging depth in samples, class-index occupancy fraction,
+    # and flush duration as a fraction of the interval (EWMA).  The
+    # overall score is the max, entry at >= 1.0, exit below
+    # tpu_overload_exit_ratio — the hysteresis band.
+    # VENEUR_TPU_OVERLOAD_STAGING_HI / _OCCUPANCY_HI / _LAG_HI /
+    # _EXIT_RATIO override.
+    tpu_overload_staging_hi: int = 1_000_000
+    tpu_overload_occupancy_hi: float = 0.95
+    tpu_overload_lag_hi: float = 1.0
+    tpu_overload_exit_ratio: float = 0.7
+    # flush-overrun watchdog: a flush past its interval budget makes
+    # the next tick coalesce (one swap covering two intervals, named
+    # in the ledger + veneur.flush.coalesced_total) so staging stays
+    # bounded.  VENEUR_TPU_OVERLOAD_COALESCE=0 keeps the old
+    # warn-and-continue behavior.
+    tpu_overload_coalesce: bool = True
 
     def resolve_aliases(self) -> None:
         """Fold the reference's deprecated alias keys into their
@@ -543,6 +581,22 @@ class Config:
                     "tpu_forward_spool_max_age must be positive")
         except ValueError as e:
             problems.append(str(e))
+        if self.tpu_overload_tenant_rate < 0:
+            problems.append("tpu_overload_tenant_rate must be >= 0")
+        if self.tpu_overload_tenant_burst < 0:
+            problems.append("tpu_overload_tenant_burst must be >= 0")
+        if self.tpu_overload_max_tenants <= 0:
+            problems.append("tpu_overload_max_tenants must be positive")
+        if self.tpu_overload_staging_hi <= 0:
+            problems.append("tpu_overload_staging_hi must be positive")
+        if not (0.0 < self.tpu_overload_occupancy_hi <= 1.0):
+            problems.append(
+                "tpu_overload_occupancy_hi must be in (0, 1]")
+        if self.tpu_overload_lag_hi <= 0:
+            problems.append("tpu_overload_lag_hi must be positive")
+        if not (0.0 < self.tpu_overload_exit_ratio <= 1.0):
+            problems.append(
+                "tpu_overload_exit_ratio must be in (0, 1]")
         if self.kafka_span_serialization_format not in ("protobuf",
                                                         "json"):
             problems.append(

@@ -299,6 +299,36 @@ class Server:
         # replayed items already credited to a ledger record (the
         # replay counter on the forwarder is cumulative)
         self._replayed_credited = 0
+        # overload control: admission buckets + priority shedding +
+        # flush-overrun coalesce (core/overload.py).  None when
+        # disabled — every call site guards, so VENEUR_TPU_OVERLOAD=0
+        # removes the subsystem entirely
+        self.overload = None
+        if bool(getattr(config, "tpu_overload", True)):
+            from veneur_tpu.core.overload import Overload
+            self.overload = Overload(
+                tenant_tag=str(getattr(
+                    config, "tpu_overload_tenant_tag", "tenant")),
+                tenant_rate=float(getattr(
+                    config, "tpu_overload_tenant_rate", 0.0)),
+                tenant_burst=float(getattr(
+                    config, "tpu_overload_tenant_burst", 0.0)),
+                max_tenants=int(getattr(
+                    config, "tpu_overload_max_tenants", 256)),
+                staging_hi=int(getattr(
+                    config, "tpu_overload_staging_hi", 1_000_000)),
+                occupancy_hi=float(getattr(
+                    config, "tpu_overload_occupancy_hi", 0.95)),
+                lag_hi=float(getattr(
+                    config, "tpu_overload_lag_hi", 1.0)),
+                exit_ratio=float(getattr(
+                    config, "tpu_overload_exit_ratio", 0.7)),
+                coalesce=bool(getattr(
+                    config, "tpu_overload_coalesce", True)))
+        # kernel-side UDP receive drops observed per flush: inode ->
+        # cumulative drop count from /proc/net/udp at the previous
+        # sample, so each interval records only the delta
+        self._kernel_drops_last: dict[int, int] = {}
 
         self.events: list[dsd.Event] = []
         self.checks: list[dsd.ServiceCheck] = []
@@ -582,6 +612,27 @@ class Server:
         with self._stats_lock:
             self.stats[key] = self.stats.get(key, 0) + n
 
+    def _sample_kernel_drops(self) -> int:
+        """Per-flush delta of kernel-side UDP receive drops across
+        this server's reader sockets (the ``drops`` column of
+        /proc/net/udp{,6}).  These packets were lost BEFORE the
+        process saw them — observed-unattributed in the interval
+        record, cumulative in stats[socket_kernel_drops], and a
+        saturation input to the overload pressure signal."""
+        from veneur_tpu.core import overload as _ovl
+        try:
+            cur = _ovl.read_kernel_drops(self._sockets)
+        except Exception:
+            return 0
+        delta = 0
+        for inode, drops in cur.items():
+            delta += max(
+                0, drops - self._kernel_drops_last.get(inode, 0))
+        self._kernel_drops_last = cur
+        if delta:
+            self.bump("socket_kernel_drops", delta)
+        return delta
+
     def handle_packet(self, data: bytes) -> None:
         """Parse one datagram (possibly multi-line) into the table
         (reference server.go:1253 processMetricPacket -> :1103
@@ -614,6 +665,13 @@ class Server:
                 checks.append(parsed)
         work = None
         n_status = 0
+        shed = 0
+        shed_by: dict = {}
+        # overload admission gate: one boolean when the subsystem is
+        # idle; the per-sample check only runs with tenant budgets
+        # configured or pressure engaged
+        adm = (self.overload is not None
+               and self.overload.admission_active)
         if samples or events or checks:
             with self.lock:
                 for s in samples:
@@ -621,7 +679,16 @@ class Server:
                     if s.type == dsd.STATUS:
                         n_status += 1
                         self.table.ingest(s)
-                    elif not self.table.ingest(s):
+                        continue
+                    if adm:
+                        ok, tenant, reason = \
+                            self.overload.admit_sample(s, self.table)
+                        if not ok:
+                            shed += 1
+                            k = (tenant, reason)
+                            shed_by[k] = shed_by.get(k, 0) + 1
+                            continue
+                    if not self.table.ingest(s):
                         dropped += 1
                 for chk in checks:
                     processed += 1
@@ -640,9 +707,11 @@ class Server:
                 # ledger entry
                 self.ledger.ingest(
                     "dogstatsd", processed=processed,
-                    staged=processed - dropped - n_status,
-                    overflow=dropped, status=n_status,
+                    staged=processed - dropped - n_status - shed,
+                    overflow=dropped, status=n_status, shed=shed,
                     parse_errors=errors)
+                if shed:
+                    self.ledger.credit_shed(shed_by)
                 work = self._maybe_device_step_locked()
         elif errors:
             self.ledger.ingest("dogstatsd", parse_errors=errors)
@@ -654,12 +723,16 @@ class Server:
             self.bump("metrics_processed", processed)
         if dropped:
             self.bump("metrics_dropped", dropped)
+        if shed:
+            self.bump("metrics_shed", shed)
 
     def ingest_parsed(self, parsed, bump: bool = True) -> tuple[int, int]:
         """Ingest one parsed object; returns (processed, dropped) so
         batch callers can tally stats once per batch."""
-        processed = dropped = 0
+        processed = dropped = shed = 0
         if isinstance(parsed, dsd.Sample):
+            adm = (self.overload is not None
+                   and self.overload.admission_active)
             with self.lock:
                 if parsed.type == dsd.STATUS:
                     ok = True
@@ -667,11 +740,23 @@ class Server:
                     self.ledger.ingest("dogstatsd", processed=1,
                                        status=1)
                 else:
-                    ok = self.table.ingest(parsed)
-                    self.ledger.ingest(
-                        "dogstatsd", processed=1,
-                        staged=1 if ok else 0,
-                        overflow=0 if ok else 1)
+                    ok = True
+                    if adm:
+                        ok_adm, tenant, reason = \
+                            self.overload.admit_sample(
+                                parsed, self.table)
+                        if not ok_adm:
+                            shed = 1
+                            self.ledger.ingest("dogstatsd",
+                                               processed=1, shed=1)
+                            self.ledger.credit_shed(
+                                {(tenant, reason): 1})
+                    if not shed:
+                        ok = self.table.ingest(parsed)
+                        self.ledger.ingest(
+                            "dogstatsd", processed=1,
+                            staged=1 if ok else 0,
+                            overflow=0 if ok else 1)
                 work = self._maybe_device_step_locked()
             self._apply_staged(work)
             processed = 1
@@ -694,6 +779,8 @@ class Server:
                 self.bump("metrics_processed", processed)
             if dropped:
                 self.bump("metrics_dropped", dropped)
+            if shed:
+                self.bump("metrics_shed", shed)
         return processed, dropped
 
     def note_import_span(self, protocol: str, accepted: int,
@@ -1157,7 +1244,15 @@ class Server:
         self.bump("packets_received", len(good) + drained_pkts)
         if drained is not None:
             good.append(drained)
-        if shard is not None:
+        # overload admission: when active (tenant budgets configured
+        # or pressure engaged) the batch routes through the columnar
+        # branch below, whose vectorized admission check rewrites shed
+        # lines to CODE_SHED before the table sees them.  The fused
+        # native branches have no admission hook — diverting them is
+        # what keeps the idle-path cost at this single boolean.
+        adm = (self.overload is not None
+               and self.overload.admission_active)
+        if shard is not None and not adm:
             buf = b"\n".join(good)
             shard.parse(buf)  # lock-free fused pass (NO ledger work)
             with self.lock:
@@ -1178,7 +1273,7 @@ class Server:
                 p, d = self.ingest_parsed(parsed, bump=False)
                 processed += p
                 dropped += d
-        elif self.config.num_readers <= 1 and \
+        elif not adm and self.config.num_readers <= 1 and \
                 getattr(self.table, "_lib", None) is not None:
             # single reader: nothing contends for the table lock, so
             # the fused native parse+probe+combine pass (no column
@@ -1209,17 +1304,32 @@ class Server:
             # fully (ingest + slow-path sweep) before this reader
             # parses again
             pb = parser.parse(b"\n".join(good), copy=False)
+            shed = 0
             with self.lock:
+                if adm:
+                    # vectorized admission under the same lock round
+                    # that credits the ledger: shed lines leave this
+                    # critical section already attributed
+                    shed, shed_by = self.overload.admit_columns(
+                        pb, self.table)
                 processed, dropped = self.table.ingest_columns(pb)
                 self.ledger.ingest("dogstatsd",
-                                   processed=processed,
+                                   processed=processed + shed,
                                    staged=processed - dropped,
-                                   overflow=dropped)
+                                   overflow=dropped, shed=shed)
+                if shed:
+                    self.ledger.credit_shed(shed_by)
                 work = self._maybe_device_step_locked()
             self._apply_staged(work)
+            processed += shed
+            if shed:
+                self.bump("metrics_shed", shed)
             # events / service checks / malformed lines: per-line
-            # slow path
-            slow = np.nonzero(pb.type_code > columnar.CODE_SET)[0]
+            # slow path (CODE_SHED lines are already fully accounted
+            # above — not errors, not events)
+            slow = np.nonzero(
+                (pb.type_code > columnar.CODE_SET)
+                & (pb.type_code != columnar.CODE_SHED))[0]
             for i in slow:
                 line = pb.line(int(i))
                 try:
@@ -1340,6 +1450,21 @@ class Server:
                     from veneur_tpu.core import debughttp
                     debughttp.trace_dump(self, server.trace_index,
                                          self.path)
+                elif self.path.startswith("/debug/overload"):
+                    # the overload-control surface on its own: is
+                    # pressure engaged, at what level, who is being
+                    # shed and why (same block as /debug/vars
+                    # "overload", for operators riding out a surge)
+                    from veneur_tpu.core import debughttp
+                    import json as _json
+                    debughttp.respond_ok(
+                        self,
+                        _json.dumps(
+                            server.overload.snapshot()
+                            if server.overload is not None
+                            else {"enabled": False},
+                            indent=2).encode(),
+                        "application/json")
                 elif self.path.startswith("/debug/vars"):
                     from veneur_tpu.core import debughttp
                     with server._stats_lock:
@@ -1395,6 +1520,23 @@ class Server:
                         # cross-interval spool conservation (spooled
                         # == replayed + expired + queued + inflight)
                         "spool_ledger": server._spool_ledger.summary(),
+                        # overload control: pressure signals, tenant
+                        # buckets, shed attribution, coalesce state
+                        # (full view at /debug/overload)
+                        "overload": (
+                            server.overload.snapshot()
+                            if server.overload is not None
+                            else None),
+                        # kernel-boundary receive accounting per
+                        # reader socket: cumulative drops observed in
+                        # /proc/net/udp{,6} (loss the process never
+                        # saw; also stats[socket_kernel_drops])
+                        "sockets": {
+                            "kernel_drops_total": stats.get(
+                                "socket_kernel_drops", 0),
+                            "by_inode": dict(
+                                server._kernel_drops_last),
+                        },
                     })
                 elif (self.path == "/quitquitquit" and
                       server.config.http_quit):
@@ -1541,6 +1683,23 @@ class Server:
     def _flush_once_locked(self) -> FlushResult:
         if self._shutdown.is_set():
             return FlushResult()
+        # flush-overrun watchdog: the previous flush blew its interval
+        # budget, so this tick coalesces — no swap, no sink fan-out;
+        # the NEXT flush covers both intervals in one swap.  Staging
+        # stays bounded via the mid-interval device steps, counters
+        # keep folding exactly (two intervals of increments report
+        # once: reduced temporal resolution, zero lost increments),
+        # and the skip is named in the ledger + stats instead of
+        # letting the ticker silently fall behind.  Drain/handoff
+        # flushes never coalesce: they must land now.
+        if (self.overload is not None and not self._draining
+                and self.overload.take_coalesce()):
+            self.bump("flush_coalesced")
+            self.ledger.note_coalesced()
+            log.warning(
+                "flush overran its budget last interval; coalescing "
+                "this tick (one swap will cover two intervals)")
+            return FlushResult()
         t_flush0 = time.monotonic_ns()
         # self-trace the flush through the loopback client (reference
         # flusher.go:29 StartSpan("flush")): the cycle's root span plus
@@ -1552,6 +1711,12 @@ class Server:
         return res
 
     def _flush_stages(self, cyc, t_flush0: int) -> FlushResult:
+        # kernel-side receive-drop delta for the closing interval:
+        # loss BEFORE the process saw a packet, so it is observed but
+        # unattributable — recorded on the interval (not a balance
+        # input) and fed to the pressure signal
+        kdrops = self._sample_kernel_drops()
+        compiles0 = self.device_costs.totals()["compile_total"]
         if self.pipeline:
             # pipelined swap: only the O(µs) buffer detach + metadata
             # capture happens under the ingest lock; the final combine
@@ -1572,7 +1737,8 @@ class Server:
                         seq=cyc.record.seq,
                         trace_id=cyc.record.trace_id,
                         table_staged=pend.ingested,
-                        table_overflow=pend.overflow)
+                        table_overflow=pend.overflow,
+                        kernel_drops=kdrops)
             with cyc.stage("swap_apply"):
                 snap = self.table.complete_swap(pend)
         else:
@@ -1587,7 +1753,8 @@ class Server:
                         seq=cyc.record.seq,
                         trace_id=cyc.record.trace_id,
                         table_staged=snap.ingested,
-                        table_overflow=snap.overflow)
+                        table_overflow=snap.overflow,
+                        kernel_drops=kdrops)
         # dispatch / device_wait / host_emit stages happen inside the
         # flusher, against the same cycle; retain_frame keeps the
         # columnar MetricFrame alive for frame-aware sinks instead of
@@ -1678,6 +1845,7 @@ class Server:
             # healthy sinks a moment to land — a wedged sink only ever
             # eats one wait (its next dispatch busy-drops un-awaited)
             deadline = t_flush0 / 1e9 + max(self.interval * 0.9, 1.0)
+            t_wait0 = time.monotonic_ns()
             if fanout_tasks:
                 for name in self._fanout.wait(fanout_tasks, deadline):
                     self.bump("flush_slow_tasks")
@@ -1697,6 +1865,7 @@ class Server:
                 except Exception:
                     self.bump("flush_errors")
                     log.exception("flush task failed")
+            sink_wait_ns = time.monotonic_ns() - t_wait0
         with self._stats_lock:
             sink_durs = dict(self._sink_durations)
             self._sink_durations.clear()
@@ -1717,6 +1886,40 @@ class Server:
                 led, busy_drops=busy - last[0],
                 retries=rets - last[1], timeouts=touts - last[2])
             self._ledger_fanout_last = (busy, rets, touts)
+        if self.overload is not None:
+            # pressure tick + overrun watchdog, once per flush: the
+            # same budget the sink waits use above defines "overrun".
+            # The bounded sink/forward waits are EXCLUDED — they can
+            # never delay the next tick (a wedged sink eats one wait
+            # and is then busy-dropped), so only the synchronous
+            # pipeline blowing the budget threatens staging memory
+            # and warrants coalescing
+            dur_s = max(
+                0.0, time.monotonic_ns() - t_flush0 - sink_wait_ns
+            ) / 1e9
+            compiled = (self.device_costs.totals()["compile_total"]
+                        - compiles0) > 0
+            self.overload.note_flush(
+                dur_s, max(self.interval * 0.9, 1.0),
+                compiled=compiled)
+            occ = 0.0
+            for name in ("counter_idx", "gauge_idx", "histo_idx",
+                         "set_idx"):
+                idx = getattr(self.table, name, None)
+                if idx is not None and getattr(idx, "capacity", 0):
+                    occ = max(occ, idx.occupancy() / idx.capacity)
+            self.overload.tick(
+                staging_depth=int(self.table.staged()),
+                occupancy=occ,
+                flush_lag_ratio=dur_s / max(self.interval, 1e-9),
+                socket_drop_delta=kdrops)
+            # histogram width ladder follows the pressure level: the
+            # expensive class loses precision before anyone loses
+            # data; level 0 restores the configured width
+            setp = getattr(self.table, "set_pressure_level", None)
+            if setp is not None:
+                with self.lock:
+                    setp(self.overload.pressure.level)
         self.ledger.seal(led)
         try:
             self.telemetry.flush_tick(

@@ -588,6 +588,12 @@ class MetricTable:
         # conservation ledger cross-checks against site-credited sums
         self._staged_n = 0
         self._interval_ingested = 0
+        # overload pressure: set_pressure_level walks histogram merge
+        # width down the ladder so the expensive class loses precision
+        # (more collapse per merge) before anyone loses samples; the
+        # base value restores exactly on release
+        self._eff_histo_slots_base = self._eff_histo_slots
+        self._pressure_level = 0
 
         # fused global merge staging: one part per decoded wire list
         # (rows, means, weights), stacked at apply time into one
@@ -855,10 +861,12 @@ class MetricTable:
                 self._fallback_parser = parser
             pb = parser.parse(bytes(buf), copy=False)
             processed, dropped = self.ingest_columns(pb)
+            tc = pb.type_code[:pb.n]
             others = [(int(pb.line_off[i]), int(pb.line_len[i]),
-                       int(pb.type_code[i]))
+                       int(tc[i]))
                       for i in np.nonzero(
-                          pb.type_code[:pb.n] > columnar.CODE_SET)[0]]
+                          (tc > columnar.CODE_SET)
+                          & (tc != columnar.CODE_SHED))[0]]
             return processed, dropped, others
         import ctypes as ct
         buf_b = bytes(buf) if not isinstance(buf, bytes) else buf
@@ -1071,6 +1079,26 @@ class MetricTable:
         conservation ledger."""
         return (self.counter_idx.overflow + self.gauge_idx.overflow +
                 self.histo_idx.overflow + self.set_idx.overflow)
+
+    def set_pressure_level(self, level: int) -> None:
+        """Overload pressure hook (core/overload.py): level > 0 steps
+        the effective histogram merge width down the pad ladder (one
+        halving per level, floored at the ladder minimum) so deep
+        batches collapse earlier — reduced sketch resolution instead
+        of dropped samples, per the SALSA tradeoff.  Level 0 restores
+        the configured width.  Takes effect on the next merge call;
+        every width is a ladder value, so the compile cache stays
+        bounded."""
+        level = max(0, int(level))
+        if level == self._pressure_level:
+            return
+        self._pressure_level = level
+        base = self._eff_histo_slots_base
+        if level == 0:
+            self._eff_histo_slots = base
+        else:
+            self._eff_histo_slots = _ladder_floor(
+                max(base >> level, 1))
 
     def _note_staged(self, n: int) -> None:
         """Staged-sample bookkeeping shared by every DSD ingest path:

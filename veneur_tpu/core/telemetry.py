@@ -397,6 +397,44 @@ class Telemetry:
                   rec.forward_split_dropped)
             count("veneur.ledger.imbalance_total",
                   self._delta("ledger_imbalance"))
+            count("veneur.ledger.shed_total", rec.shed)
+
+        # overload control: shed attribution (the metric twin of the
+        # ledger's shed block — every turned-away sample named by
+        # tenant and reason), pressure state, the flush-overrun
+        # watchdog, and kernel-boundary receive drops
+        ovl = getattr(self.server, "overload", None)
+        if ovl is not None:
+            for (tenant, reason), total in sorted(
+                    ovl.shed_by_total.items()):
+                key = f"overload_shed_{tenant}_{reason}"
+                self.server.stats[key] = int(total)
+                count("veneur.overload.shed_total", self._delta(key),
+                      (f"tenant:{tenant}", f"reason:{reason}"))
+            gauge("veneur.overload.pressure_level",
+                  ovl.pressure.level)
+            gauge("veneur.overload.pressure_score",
+                  ovl.pressure.score)
+            self.server.stats["flush_overruns"] = int(
+                ovl.flush_overruns)
+            count("veneur.flush.overrun_total",
+                  self._delta("flush_overruns"))
+        count("veneur.flush.coalesced_total",
+              self._delta("flush_coalesced"))
+        count("veneur.socket.kernel_drops_total",
+              self._delta("socket_kernel_drops"))
+        # "other"-sample drops at sinks that only speak samples they
+        # understand (kafka's FlushOtherSamples contract): counted,
+        # never silent
+        for sink in getattr(self.server, "metric_sinks", []):
+            cur = getattr(sink, "other_dropped", None)
+            if cur is None:
+                continue
+            sname = getattr(sink, "name", type(sink).__name__)
+            key = f"sink_{sname}_other_dropped"
+            self.server.stats[key] = int(cur)
+            count("veneur.sink.kafka.other_dropped_total",
+                  self._delta(key), (f"sink:{sname}",))
 
         # import response timing (reference README:
         # veneur.import.response_duration_ns)

@@ -9,7 +9,8 @@ metric counts, fanout busy-drops/retries.  At ``begin_swap`` the
 interval closes (``Ledger.close_interval``) and at the end of the
 flush it seals (``Ledger.seal``) with the conservation checks:
 
-    received == staged + status + overflow + invalid        (ingest)
+    received == staged + status + shed + overflow + invalid  (ingest)
+    shed == sum(shed_by[tenant, reason])                     (shed)
     staged_rows == emitted + forwarded - overlap + retained  (rows)
 
 plus two *independent* cross-checks against the table's own interval
@@ -85,6 +86,20 @@ class LedgerRecord:
     overflow: int = 0        # row-table overflow drops (site-credited)
     invalid: int = 0         # malformed/non-finite drops at import sites
     parse_errors: int = 0    # line/packet-level errors (pre-sample)
+    # -- overload shedding (admission control / pressure tiers): every
+    #    shed sample carries a (tenant, reason) attribution, and seal
+    #    checks the breakdown sums back to the total — an anonymous
+    #    shed is an imbalance, not a smaller number
+    shed: int = 0
+    shed_by: dict[tuple[str, str], int] = field(default_factory=dict)
+    # flush ticks this interval absorbed beyond its own (the overrun
+    # watchdog coalesced N skipped swaps into this one record)
+    coalesced: int = 0
+    # kernel-level UDP receive drops observed (/proc or SO_RXQ_OVFL)
+    # during the interval: loss BEFORE the process saw the packet, so
+    # it is reported as observed-unattributed — named, but never a
+    # balance input (the samples were never ``received``)
+    kernel_drops: int = 0
     # -- independent table-side counters captured at begin_swap --------
     table_staged: int | None = None
     table_overflow: dict[str, int] = field(default_factory=dict)
@@ -143,12 +158,20 @@ class LedgerRecord:
     overflow_drift: int = 0  # site-credited overflow - table overflow
     rows_owed: int = 0       # staged rows unaccounted for at flush
     split_owed: int = 0      # forwarded rows no destination accounts for
+    shed_owed: int = 0       # shed samples missing tenant+reason
 
     def received_total(self) -> int:
         return sum(self.received.values())
 
     def dropped_total(self) -> int:
         return self.overflow + self.invalid
+
+    def shed_nested(self) -> dict:
+        """``shed_by`` as ``{tenant: {reason: n}}`` for JSON."""
+        out: dict[str, dict[str, int]] = {}
+        for (tenant, reason), n in self.shed_by.items():
+            out.setdefault(tenant, {})[reason] = n
+        return out
 
     def to_dict(self) -> dict:
         return {
@@ -162,6 +185,12 @@ class LedgerRecord:
             "dropped": {"overflow": self.overflow,
                         "invalid": self.invalid,
                         "total": self.dropped_total()},
+            "shed": {"total": self.shed,
+                     "by": self.shed_nested(),
+                     "owed": self.shed_owed},
+            "coalesced": self.coalesced,
+            "observed_unattributed": {
+                "kernel_drops": self.kernel_drops},
             "parse_errors": self.parse_errors,
             "table": {"staged": self.table_staged,
                       "overflow": dict(self.table_overflow)},
@@ -220,12 +249,16 @@ class Ledger:
     # -- ingest-side crediting (call under the server's ingest lock) ---
     def ingest(self, protocol: str, processed: int = 0, staged: int = 0,
                overflow: int = 0, invalid: int = 0,
-               parse_errors: int = 0, status: int = 0) -> None:
+               parse_errors: int = 0, status: int = 0,
+               shed: int = 0) -> None:
         """Credit one batch: ``processed`` samples presented on
         ``protocol``, of which ``staged`` were accepted, ``overflow``
         dropped on row-table overflow, ``invalid`` dropped for
-        malformed/non-finite values, and ``status`` were service-check
-        STATUS samples (accepted but never staged)."""
+        malformed/non-finite values, ``status`` were service-check
+        STATUS samples (accepted but never staged), and ``shed`` were
+        rejected by overload control (attribute them via
+        ``credit_shed`` in the same critical section — seal checks
+        the breakdown sums back to this total)."""
         with self._lock:
             cur = self._cur
             if processed:
@@ -236,13 +269,32 @@ class Ledger:
             cur.invalid += int(invalid)
             cur.parse_errors += int(parse_errors)
             cur.status += int(status)
+            cur.shed += int(shed)
+
+    def credit_shed(self, breakdown: dict) -> None:
+        """Attribute shed samples: ``{(tenant, reason): n}``.  The
+        totals must sum to what the paired ``ingest(..., shed=n)``
+        credited — seal fails the interval otherwise, so a shed
+        sample can never lose its name."""
+        with self._lock:
+            cur = self._cur
+            for key, n in breakdown.items():
+                if n:
+                    cur.shed_by[key] = cur.shed_by.get(key, 0) + int(n)
+
+    def note_coalesced(self) -> None:
+        """The overrun watchdog skipped a flush tick: the open
+        interval absorbs the skipped one (one swap will cover both),
+        and the record that eventually closes names the coalesce."""
+        with self._lock:
+            self._cur.coalesced += 1
 
     # -- interval close (under the ingest lock, same critical section
     #    as the table's begin_swap so credits and table counters agree)
     def close_interval(self, seq: int = 0, trace_id: int = 0,
                        table_staged: int | None = None,
-                       table_overflow: dict[str, int] | None = None
-                       ) -> LedgerRecord:
+                       table_overflow: dict[str, int] | None = None,
+                       kernel_drops: int = 0) -> LedgerRecord:
         with self._lock:
             rec = self._cur
             self._cur = LedgerRecord(start_unix=time.time())
@@ -251,6 +303,7 @@ class Ledger:
             rec.table_staged = table_staged
             if table_overflow:
                 rec.table_overflow = dict(table_overflow)
+            rec.kernel_drops += int(kernel_drops)
             return rec
 
     # -- flush-side crediting (synchronous inputs to the row balance) --
@@ -353,7 +406,9 @@ class Ledger:
         mode) escalate any imbalance to an error + counter."""
         with self._lock:
             rec.owed = rec.received_total() - (
-                rec.staged + rec.status + rec.overflow + rec.invalid)
+                rec.staged + rec.status + rec.shed + rec.overflow
+                + rec.invalid)
+            rec.shed_owed = rec.shed - sum(rec.shed_by.values())
             if rec.table_staged is not None:
                 rec.staged_drift = rec.staged - rec.table_staged
             if rec.table_overflow:
@@ -377,20 +432,22 @@ class Ledger:
             rec.balanced = (rec.owed == 0 and rec.staged_drift == 0
                             and rec.overflow_drift == 0
                             and rec.rows_owed == 0
-                            and rec.split_owed == 0)
+                            and rec.split_owed == 0
+                            and rec.shed_owed == 0)
             rec.sealed = True
             self._ring.append(rec)
             if not rec.balanced:
                 self.imbalanced_total += 1
         if not rec.balanced:
             msg = ("ledger imbalance node=%s seq=%d: owed=%d samples "
-                   "(received=%d staged=%d status=%d overflow=%d "
-                   "invalid=%d) staged_drift=%d overflow_drift=%d "
-                   "rows_owed=%d split_owed=%d")
+                   "(received=%d staged=%d status=%d shed=%d "
+                   "overflow=%d invalid=%d) staged_drift=%d "
+                   "overflow_drift=%d rows_owed=%d split_owed=%d "
+                   "shed_owed=%d")
             args = (self.node, rec.seq, rec.owed, rec.received_total(),
-                    rec.staged, rec.status, rec.overflow, rec.invalid,
-                    rec.staged_drift, rec.overflow_drift, rec.rows_owed,
-                    rec.split_owed)
+                    rec.staged, rec.status, rec.shed, rec.overflow,
+                    rec.invalid, rec.staged_drift, rec.overflow_drift,
+                    rec.rows_owed, rec.split_owed, rec.shed_owed)
             if self.strict:
                 log.error(msg, *args)
             else:
@@ -464,6 +521,23 @@ class Ledger:
                 1 for r in recs if r.reshard_epoch)
             out["reshard_moved_rows_total"] = sum(
                 r.reshard_moved_rows for r in recs)
+        shed = sum(r.shed for r in recs)
+        if shed or any(r.shed_owed for r in recs):
+            by: dict[str, dict[str, int]] = {}
+            for r in recs:
+                for (tenant, reason), n in r.shed_by.items():
+                    t = by.setdefault(tenant, {})
+                    t[reason] = t.get(reason, 0) + n
+            out["shed_total"] = shed
+            out["shed_by"] = by
+            out["shed_owed_total"] = sum(
+                abs(r.shed_owed) for r in recs)
+        coalesced = sum(r.coalesced for r in recs)
+        if coalesced:
+            out["coalesced_total"] = coalesced
+        kdrops = sum(r.kernel_drops for r in recs)
+        if kdrops:
+            out["kernel_drops_observed_total"] = kdrops
         return out
 
 

@@ -32,6 +32,11 @@ CODE_HISTOGRAM = 3
 CODE_SET = 4
 CODE_EVENT = 250
 CODE_SERVICE_CHECK = 251
+# overload admission rewrote this line's code: the table's column
+# ingest skips it (> CODE_SET) and the slow-path sweep must too —
+# the sample is fully accounted as `shed` in the ledger, not an
+# event/error (core/overload.py)
+CODE_SHED = 252
 CODE_ERROR = 255
 
 SCOPE_CODES = ("", "local", "global")  # index = wire scope code

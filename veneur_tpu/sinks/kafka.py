@@ -307,6 +307,11 @@ class KafkaMetricSink(SinkBase):
         self.buffer_bytes = buffer_bytes
         self.buffer_messages = buffer_messages
         self.flushed_total = 0
+        # "other" samples (events/checks) this sink could not deliver
+        # — no topic configured for the kind, or the topic's produce
+        # failed; read each tick by self-telemetry as
+        # veneur.sink.kafka.other_dropped_total
+        self.other_dropped = 0
 
     def flush(self, metrics: list[InterMetric]) -> None:
         if not metrics:
@@ -343,12 +348,17 @@ class KafkaMetricSink(SinkBase):
         FlushOtherSamples a TODO, kafka.go:222-225 — here they
         deliver.)"""
         from veneur_tpu.protocol.dogstatsd import ServiceCheck
-        if not (self.check_topic or self.event_topic) or not samples:
+        if not samples:
+            return
+        if not (self.check_topic or self.event_topic):
+            # nowhere to route ANY of them: counted, never silent
+            self.other_dropped += len(samples)
             return
         by_topic: dict[str, list] = {}
         for s in samples:
             if isinstance(s, ServiceCheck):
                 if not self.check_topic:
+                    self.other_dropped += 1
                     continue
                 rec = {"name": s.name, "status": int(s.status),
                        "timestamp": s.timestamp,
@@ -358,6 +368,7 @@ class KafkaMetricSink(SinkBase):
                     (s.name.encode(), json.dumps(rec).encode()))
             else:
                 if not self.event_topic:
+                    self.other_dropped += 1
                     continue
                 rec = {"title": s.title, "text": s.text,
                        "timestamp": s.timestamp,
@@ -390,6 +401,7 @@ class KafkaMetricSink(SinkBase):
                             record_batch(chunk, ts), self.acks,
                             self.retry_max)
             except OSError as e:
+                self.other_dropped += len(records)
                 log.warning("kafka %s flush failed: %s", topic, e)
 
 
