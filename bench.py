@@ -3089,6 +3089,295 @@ def _chaos_recovery(n_iters: int = 18, rows_per_iter: int = 400,
     return r
 
 
+_CRASH_CHILD = r"""
+import signal, sys, time
+from veneur_tpu.core.config import read_config
+from veneur_tpu.core.server import Server
+ckdir, fwd = sys.argv[1], sys.argv[2]
+s = Server(read_config(data={
+    "statsd_listen_addresses": ["udp://127.0.0.1:0"],
+    "grpc_listen_addresses": [],
+    "interval": "500ms", "hostname": "crash-local",
+    "forward_address": fwd, "forward_use_grpc": True,
+    "tpu_checkpoint_dir": ckdir,
+    "tpu_checkpoint_interval": "300ms"}))
+s.start()
+print("READY", s.statsd_ports[0], s.incarnation,
+      s.restarts_adopted, flush=True)
+stop = []
+signal.signal(signal.SIGTERM, lambda *_a: stop.append(1))
+while not stop:
+    time.sleep(0.05)
+s.shutdown()  # graceful: drain handoff ships staged mass
+"""
+
+
+def _chaos_crash(n_packets: int, ckpt_interval: float = 0.3) -> dict:
+    """Crash leg of ``--chaos`` (ISSUE 15): SIGKILL a real local
+    Server mid-soak under live UDP ingest, then restart it with
+    einhorn-style fd adoption and checkpoint recovery.
+
+    The bench process plays the einhorn master: it binds the UDP
+    reader socket once and cloaks it into each child generation via
+    ``VENEUR_TPU_SOCK_CLOAKED`` + ``pass_fds``, so datagrams sent
+    while NO child is alive park in the kernel receive queue and are
+    read by the replacement — ``kernel_drops == 0`` across the
+    restart, measured off ``/proc/net/udp``.  The checkpoint bound:
+    everything the dead child had ingested but not yet checkpointed
+    is at most the ingest offered between its last surviving segment
+    and the kill, so ``unattributed_lost`` must stay inside that
+    named window — and must not go NEGATIVE, which would mean a
+    recovered segment double-delivered mass the forward wire already
+    landed."""
+    import shutil
+    import signal as _signal
+    import socket as socket_mod
+    import subprocess
+    import tempfile
+
+    from veneur_tpu.core import overload as _ovl
+    from veneur_tpu.core.config import read_config
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.ops import checkpoint as _ckpt
+    from veneur_tpu.ops import fdpass
+    from veneur_tpu.sinks.simple import CaptureSink
+
+    out: dict = {"n_packets": n_packets,
+                 "checkpoint_interval": ckpt_interval}
+    cap = CaptureSink()
+    g = Server(read_config(data={
+        "grpc_listen_addresses": ["tcp://127.0.0.1:0"],
+        "statsd_listen_addresses": [],
+        "interval": "30s", "hostname": "crash-g",
+        "accelerator_probe_timeout": "5s"}), extra_sinks=[cap])
+    g.start()
+    fwd_addr = f"127.0.0.1:{g.grpc_ports[0]}"
+
+    # the master's socket: bound once, adopted by every generation
+    sock = socket_mod.socket(socket_mod.AF_INET,
+                             socket_mod.SOCK_DGRAM)
+    sock.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_RCVBUF,
+                    1 << 22)
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    rcvbuf = sock.getsockopt(socket_mod.SOL_SOCKET,
+                             socket_mod.SO_RCVBUF)
+    # conservative skb cost per parked datagram; the dead window
+    # must not overrun the kernel queue or drops stop being a bug
+    dead_budget = max(50, rcvbuf // 1024)
+    tx = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_DGRAM)
+
+    ckdir = tempfile.mkdtemp(prefix="veneur-crash-ck-")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env[fdpass.ENV_VAR] = fdpass.socket_cloak(
+        {"statsd.udp.0.0": sock})
+    env["VENEUR_TPU_CHECKPOINT_INTERVAL"] = f"{ckpt_interval}s"
+    errlog = open(os.path.join(ckdir, "children.log"), "ab")
+
+    def spawn():
+        p = subprocess.Popen(
+            [sys.executable, "-c", _CRASH_CHILD, ckdir, fwd_addr],
+            stdout=subprocess.PIPE, stderr=errlog, env=env,
+            pass_fds=[sock.fileno()],
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        line = p.stdout.readline().split()
+        assert line and line[0] == b"READY", line
+        return p, int(line[1]), int(line[2]), int(line[3])
+
+    sent = []  # (wall, n) batches, the offered-ingest timeline
+
+    def blast(n, names=32, batch=20, gap=0.004):
+        i = 0
+        while i < n:
+            k = min(batch, n - i)
+            for j in range(k):
+                tx.sendto(f"crash.{(i + j) % names}:1|c"
+                          f"|#veneurglobalonly".encode(),
+                          ("127.0.0.1", port))
+            sent.append((time.time(), k))
+            i += k
+            time.sleep(gap)
+
+    procs = []
+    try:
+        p1, p1_port, p1_inc, p1_adopted = spawn()
+        procs.append(p1)
+        assert p1_port == port, (p1_port, port)
+        out["first_child"] = {"incarnation": p1_inc,
+                              "fds_adopted": p1_adopted}
+
+        pre = int(0.55 * n_packets)
+        blast(pre)
+        # kill only once a FRESH segment covers recent ingest, so
+        # the recovery actually has something to ride
+        deadline = time.time() + 15
+        last = None
+        while time.time() < deadline:
+            segs = _ckpt.scan_recoverable(ckdir, 0, max_age=60)
+            segs = [s for s in segs
+                    if s.header.get("incarnation") == p1_inc
+                    and int(s.header.get("items", 0)) > 0]
+            if segs and time.time() - segs[-1].header["wall"] < 1.0:
+                break
+            blast(10)
+            time.sleep(0.02)
+        os.kill(p1.pid, _signal.SIGKILL)
+        kill_wall = time.time()
+        p1.wait(10)
+        # the checkpoint frontier, read from the now-stable disk
+        segs = [s for s in _ckpt.scan_recoverable(ckdir, 0,
+                                                  max_age=60)
+                if s.header.get("incarnation") == p1_inc]
+        last_ckpt_wall = max(
+            (float(s.header["wall"]) for s in segs), default=0.0)
+        out["surviving_segments"] = len(segs)
+        out["surviving_items"] = sum(
+            int(s.header.get("items", 0)) for s in segs)
+
+        # the restart gap: ingest continues with NO process on the
+        # socket — the kernel queue is the only thing catching it
+        blast(min(int(0.15 * n_packets), dead_budget))
+
+        p2, p2_port, p2_inc, p2_adopted = spawn()
+        procs.append(p2)
+        assert p2_port == port, (p2_port, port)
+        out["second_child"] = {"incarnation": p2_inc,
+                               "fds_adopted": p2_adopted}
+        blast(n_packets - sum(n for _w, n in sent))
+        time.sleep(2 * ckpt_interval)  # let the last flush forward
+        p2.send_signal(_signal.SIGTERM)
+        p2.wait(30)
+
+        deadline = time.time() + 10  # drain wires may still be landing
+        landed = prev = -1
+        while time.time() < deadline:
+            g.flush_once()
+            landed = int(sum(
+                m.value for m in cap.metrics
+                if m.name.startswith("crash.")
+                and m.type == "counter"))
+            if landed == prev:
+                break
+            prev = landed
+            time.sleep(0.3)
+
+        offered = sum(n for _w, n in sent)
+        out["offered_items"] = offered
+        out["landed_items"] = landed
+        out["unattributed_lost"] = offered - landed
+        # the named bound: ingest offered after the last surviving
+        # checkpoint and before the kill (post-kill datagrams parked
+        # in the kernel queue and were adopted, not lost)
+        out["loss_bound_items"] = sum(
+            n for w, n in sent
+            if last_ckpt_wall - 0.1 <= w <= kill_wall)
+        out["kernel_drops"] = sum(
+            _ovl.read_kernel_drops([sock]).values())
+        out["recovery_wires_received"] = g.stats.get(
+            "recovery_wires_received", 0)
+        out["recovery_items_received"] = g.stats.get(
+            "recovery_items_received", 0)
+        out["recovery_wires_deduped"] = g.stats.get(
+            "recovery_wires_deduped", 0)
+        out["drain_wires_received"] = g.stats.get(
+            "drain_wires_received", 0)
+        led = g.ledger.summary()
+        out["global_ledger"] = led
+        out["recovered_total"] = led.get("recovered_total", 0)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.stdout.close()
+        errlog.close()
+        tx.close()
+        sock.close()
+        g.shutdown()
+        shutil.rmtree(ckdir, ignore_errors=True)
+    return out
+
+
+def _chaos_scale_out(n_counters: int, n_histo: int,
+                     n_set_samples: int) -> dict:
+    """Scale-out leg of ``--chaos`` (ISSUE 15): an incumbent global
+    with resident sketch state hands the keyspace arcs a new ring
+    member now owns over the columnar import wire flagged
+    ``veneur-handoff``, and the CLUSTER conserves mass exactly — every
+    row emits once, on exactly one member, with both conservation
+    ledgers sealed balanced and the receiver crediting the arrival
+    as ``reshard_received_items``."""
+    from veneur_tpu.core.config import read_config
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.sinks.simple import CaptureSink
+
+    out: dict = {"n_counters": n_counters, "n_histo": n_histo,
+                 "n_set_samples": n_set_samples}
+    caps = [CaptureSink(), CaptureSink()]
+    globals_ = []
+    for gi, cap in enumerate(caps):
+        g = Server(read_config(data={
+            "grpc_listen_addresses": ["tcp://127.0.0.1:0"],
+            "statsd_listen_addresses": [],
+            "interval": "30s", "hostname": f"scale-g{gi}",
+            "accelerator_probe_timeout": "5s"}),
+            extra_sinks=[cap])
+        g.start()
+        globals_.append(g)
+    g0, g1 = globals_
+    try:
+        addrs = [f"127.0.0.1:{g.grpc_ports[0]}" for g in globals_]
+        for i in range(n_counters):
+            g0.handle_packet(f"scale.c.{i}:{i}|c".encode())
+        for i in range(n_histo * 16):
+            g0.handle_packet(
+                f"scale.h.{i % n_histo}:{i % 97}|h".encode())
+        for i in range(n_set_samples):
+            g0.handle_packet(
+                f"scale.s.{i % 8}:u{i}|s".encode())
+        ho = g0.arc_handoff(addrs, addrs[0])
+        out["handoff"] = ho
+        g1.flush_once()
+
+        names: dict = {}
+        double = 0
+        for cap in caps:
+            for m in cap.metrics:
+                key = (m.name, m.type)
+                if key in names:
+                    double += 1
+                names[key] = names.get(key, 0.0) + m.value
+        cmass = sum(v for (k, t), v in names.items()
+                    if k.startswith("scale.c.") and t == "counter")
+        out["counter_mass"] = cmass
+        out["counter_mass_expected"] = sum(range(n_counters))
+        out["double_emitted_series"] = double
+        out["histo_medians_seen"] = sum(
+            1 for (k, _t) in names
+            if k.startswith("scale.h.")
+            and k.endswith("50percentile"))
+        rec0, rec1 = g0.ledger.last(), g1.ledger.last()
+        out["sender_ledger_balanced"] = bool(
+            rec0 is not None and rec0.balanced)
+        out["receiver_ledger_balanced"] = bool(
+            rec1 is not None and rec1.balanced)
+        out["handoff_wires_received"] = g1.stats.get(
+            "handoff_wires_received", 0)
+        out["handoff_items_received"] = g1.stats.get(
+            "handoff_items_received", 0)
+        out["reshard_received_items"] = (
+            rec1.reshard_received_items if rec1 is not None else 0)
+        out["mass_conserved"] = bool(
+            cmass == out["counter_mass_expected"]
+            and double == 0
+            and out["histo_medians_seen"] == n_histo
+            and ho.get("errors", 1) == 0
+            and ho.get("dropped_items", 1) == 0)
+    finally:
+        for g in globals_:
+            g.shutdown()
+    return out
+
+
 def chaos_bench() -> dict:
     """``--chaos``: the fault-injection chaos soak — the ISSUE 11
     deliverable plus the ISSUE 12 recovery leg.  Kills a global shard
@@ -3103,14 +3392,18 @@ def chaos_bench() -> dict:
     ``total_lost == 0`` exactly."""
     if QUICK:
         rows_per_iter, n_histo, n_sets = 200, 32, 8
+        crash_packets, so_scale = 800, (300, 24, 96)
     else:
         rows_per_iter, n_histo, n_sets = 800, 64, 16
+        crash_packets, so_scale = 3000, (1200, 48, 256)
     out: dict = {"mode": "chaos_soak", "quick": QUICK}
     out["model_soak"] = _chaos_model_soak(
         n_iters=20, rows_per_iter=rows_per_iter, pool_wires=3)
     out["e2e"] = _chaos_e2e(n_histo, n_sets)
     out["recovery"] = _chaos_recovery(
         n_iters=18, rows_per_iter=rows_per_iter)
+    out["crash"] = _chaos_crash(crash_packets)
+    out["scale_out"] = _chaos_scale_out(*so_scale)
     ms, e2e = out["model_soak"], out["e2e"]
     required = {"wire_drop_retry", "wire_drop_fatal", "wire_delay",
                 "dest_stall", "discovery_flap", "shard_kill",
@@ -3147,6 +3440,35 @@ def chaos_bench() -> dict:
             rcv["ledger"]["imbalanced"] == 0
             and all(g["ledger"]["imbalanced"] == 0
                     for g in rcv["globals"])),
+    })
+    crash, so = out["crash"], out["scale_out"]
+    gates.update({
+        # the ISSUE 15 crash-riding contract: a SIGKILL costs at
+        # most one checkpoint interval of offered ingest, every bit
+        # of it named; the kernel boundary drops nothing across the
+        # restart (fd adoption); recovery lands once, not twice
+        "crash_kernel_drops_zero": crash["kernel_drops"] == 0,
+        "crash_fd_adopted": (
+            crash["second_child"]["fds_adopted"] >= 1),
+        "crash_recovery_flagged": (
+            crash["recovery_wires_received"] >= 1),
+        "crash_no_double_delivery": crash["unattributed_lost"] >= 0,
+        "crash_unattributed_bounded": (
+            crash["unattributed_lost"]
+            <= crash["loss_bound_items"]),
+        "crash_recovered_credited": crash["recovered_total"] > 0,
+        "crash_ledger_balanced": (
+            crash["global_ledger"]["imbalanced"] == 0),
+        "scaleout_mass_conserved": bool(so["mass_conserved"]),
+        "scaleout_handoff_flagged": (
+            so["handoff_wires_received"] >= 1),
+        "scaleout_arrival_credited": (
+            so["reshard_received_items"]
+            == so["handoff"].get("items", -1)
+            and so["reshard_received_items"] > 0),
+        "scaleout_ledgers_balanced": (
+            so["sender_ledger_balanced"]
+            and so["receiver_ledger_balanced"]),
     })
     out["chaos_gates"] = gates
     out["chaos_pass"] = all(gates.values())
@@ -3588,12 +3910,22 @@ def main() -> None:
                 break
 
     out = _assemble(configs, t_start, probe_info)
-    # preserve the raw artifact (transcriptions are not evidence)
+    # preserve the raw artifact (transcriptions are not evidence) —
+    # but per-run blobs are scratch, not repo state: they land in
+    # the system tmpdir unless --keep-runs pins them under
+    # bench_results/ for archival
     try:
-        os.makedirs(os.path.dirname(CKPT_DIR), exist_ok=True)
-        with open(os.path.join(os.path.dirname(CKPT_DIR),
-                               f"run_{int(t_start)}.json"), "w") as f:
+        import tempfile
+        if "--keep-runs" in sys.argv:
+            run_dir = os.path.dirname(CKPT_DIR)
+        else:
+            run_dir = os.path.join(tempfile.gettempdir(),
+                                   "veneur_tpu_bench_runs")
+        os.makedirs(run_dir, exist_ok=True)
+        run_path = os.path.join(run_dir, f"run_{int(t_start)}.json")
+        with open(run_path, "w") as f:
             json.dump(out, f, indent=1)
+        print(f"# run artifact: {run_path}", file=sys.stderr)
     except OSError:
         pass
     print(json.dumps(out))

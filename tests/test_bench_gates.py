@@ -433,6 +433,39 @@ def test_chaos_soak_artifact_committed():
     assert rcv["spool_balance_owed"] == 0
     assert rcv["ledger"]["imbalanced"] == 0
     assert rcv["spool_ledger"]["imbalanced"] == 0
+
+    # the ISSUE 15 crash leg: SIGKILL a live local mid-soak under
+    # UDP ingest, restart with fd adoption + checkpoint recovery.
+    # Loss is bounded by ONE checkpoint interval of offered ingest
+    # (the named window between the last surviving segment and the
+    # kill), never negative (recovery deduped, no double delivery),
+    # and the kernel boundary drops nothing across the restart.
+    cr = d["crash"]
+    assert cr["kernel_drops"] == 0
+    assert cr["first_child"]["fds_adopted"] >= 1
+    assert cr["second_child"]["fds_adopted"] >= 1
+    assert cr["second_child"]["incarnation"] == \
+        cr["first_child"]["incarnation"] + 1
+    assert 0 <= cr["unattributed_lost"] <= cr["loss_bound_items"]
+    assert cr["recovery_wires_received"] >= 1
+    assert cr["recovered_total"] > 0
+    assert cr["global_ledger"]["imbalanced"] == 0
+    assert cr["global_ledger"]["recovered_owed_total"] == 0
+
+    # the ISSUE 15 scale-out leg: an incumbent global hands the new
+    # member's keyspace arcs over the flagged import wire; the
+    # CLUSTER conserves mass exactly, the receiver credits the
+    # arrival, both ledgers seal balanced
+    so = d["scale_out"]
+    assert so["mass_conserved"] is True
+    assert so["double_emitted_series"] == 0
+    assert so["counter_mass"] == so["counter_mass_expected"]
+    assert so["handoff"]["errors"] == 0
+    assert so["handoff"]["dropped_items"] == 0
+    assert so["handoff_wires_received"] >= 1
+    assert so["reshard_received_items"] == so["handoff"]["items"] > 0
+    assert so["sender_ledger_balanced"] is True
+    assert so["receiver_ledger_balanced"] is True
     assert "platform" in d and "gates" in d
 
 

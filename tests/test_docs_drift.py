@@ -226,3 +226,72 @@ def test_operations_runbook_covers_overload_riding():
 
 def test_overload_debug_endpoint_documented():
     assert "/debug/overload" in DOCS
+
+
+def test_crash_riding_metrics_documented():
+    """ISSUE 15 names, pinned explicitly: the checkpoint/recovery
+    series, the import-side recovery and handoff acceptance
+    counters, fd adoption, and the ledger's recovered arm."""
+    for name in (
+            "veneur.checkpoint.written_total",
+            "veneur.checkpoint.bytes_total",
+            "veneur.checkpoint.rows_total",
+            "veneur.checkpoint.last_items",
+            "veneur.checkpoint.pruned_total",
+            "veneur.checkpoint.stale_discarded_total",
+            "veneur.checkpoint.errors_total",
+            "veneur.recovery.segments_total",
+            "veneur.recovery.items_total",
+            "veneur.recovery.errors_total",
+            "veneur.import.recovery_wires_total",
+            "veneur.import.recovery_items_total",
+            "veneur.import.recovery_deduped_total",
+            "veneur.forward.handoff.wires_total",
+            "veneur.forward.handoff.items_total",
+            "veneur.forward.handoff.errors_total",
+            "veneur.import.handoff_wires_total",
+            "veneur.import.handoff_items_total",
+            "veneur.restart.fds_adopted_total",
+            "veneur.ledger.recovered_total",
+            "veneur.ledger.recovered_owed_total",
+            "veneur.ledger.reshard_received_items_total",
+    ):
+        assert name in DOCS, name
+        assert any(name in (ROOT / m).read_text() for m in SCANNED), \
+            name
+
+
+def test_crash_riding_env_vars_documented():
+    """ISSUE 15 knobs: checkpointing, fd cloaking, and arc handoff
+    must appear in the README env table AND the operations runbook
+    that explains how to size them."""
+    readme = (ROOT / "README.md").read_text()
+    ops = (ROOT / "docs" / "operations.md").read_text()
+    for var in ("VENEUR_TPU_CHECKPOINT_DIR",
+                "VENEUR_TPU_CHECKPOINT_INTERVAL",
+                "VENEUR_TPU_SOCK_CLOAKED",
+                "VENEUR_TPU_ARC_HANDOFF"):
+        assert var in readme, var
+        assert var in ops, var
+
+
+def test_operations_runbook_covers_crash_riding():
+    """The ISSUE 15 runbook section: surviving a crash, naming the
+    wire flags, the dedup id, the loss bound, and the orphan-spool
+    write-off."""
+    ops = (ROOT / "docs" / "operations.md").read_text()
+    for needle in (
+            "Surviving a crash",
+            "veneur-recovery",
+            "X-Veneur-Recovery",
+            "grpc-import-recovery",
+            "incarnation:seq",
+            "at-most-once",
+            "checkpoint interval of offered ingest",
+            "veneur-handoff",
+            "reason:orphan_age",
+            "restarts_adopted",
+            "kernel_drops == 0",
+            "chaos_soak.json",
+    ):
+        assert needle in ops, needle

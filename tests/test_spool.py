@@ -65,7 +65,8 @@ def test_byte_cap_evicts_oldest_credited_as_cap():
     st = sp.stats()
     assert st["expired_items"] == 1
     assert st["expired_by_reason"] == {"age": 0, "cap": 1,
-                                       "retired": 0}
+                                       "retired": 0,
+                                       "orphan_age": 0}
     assert sp.queued("d:1") == 1 and sp.queued("d:2") == 1
     assert sp.take("d:1").read() == b"cccc"
     assert sp.check_balance() == 0
@@ -131,7 +132,7 @@ def test_disk_segments_write_replay_unlink(tmp_path):
     sp, _t = _spool(dir=str(tmp_path))
     sp.put("127.0.0.1:8128", b"wirebody", 2)
     files = [os.path.join(r, f) for r, _d, fs in os.walk(tmp_path)
-             for f in fs]
+             for f in fs if f.endswith(".wire")]
     assert len(files) == 1
     with open(files[0], "rb") as f:
         assert f.read() == b"wirebody"
@@ -156,7 +157,77 @@ def test_disk_segment_vanished_reads_none():
 def test_expire_reasons_are_the_closed_set():
     # every expiry must land in a NAMED bucket the docs + telemetry
     # enumerate — a new reason is an API change, not a drive-by
-    assert EXPIRE_REASONS == ("age", "cap", "retired")
+    assert EXPIRE_REASONS == ("age", "cap", "retired", "orphan_age")
+
+
+# ----------------------------------------------------------------------
+# orphan adoption: a dead incarnation's segments carry over
+
+
+def test_orphan_segments_adopted_and_replayable(tmp_path):
+    sp1, _t = _spool(dir=str(tmp_path), incarnation=1)
+    sp1.put("127.0.0.1:8128", b"wire-a", 10)
+    sp1.put("127.0.0.1:8128", b"wire-b", 5)
+    # the crash: no replay, no shutdown — segments stay on disk
+
+    sp2, _t2 = _spool(dir=str(tmp_path), incarnation=2,
+                      max_age=100.0)
+    st = sp2.stats()
+    assert st["adopted_wires"] == 2 and st["adopted_items"] == 15
+    assert st["incarnation"] == 2
+    # adopted wires enter the conservation story as spooled+queued,
+    # so the birth-to-death identity holds from the first wire
+    assert st["spooled_items"] == 15 and st["queued_items"] == 15
+    assert sp2.check_balance() == 0
+    led = SpoolLedger(node="t")
+    assert led.seal_snapshot(st, seq=1).balanced
+    # the real destination survives directory-name sanitization
+    e = sp2.take("127.0.0.1:8128")
+    assert e.read() == b"wire-a" and e.n_items == 10
+    sp2.mark_replayed(e)
+    sp2.mark_replayed(sp2.take("127.0.0.1:8128"))
+    assert sp2.stats()["replayed_items"] == 15
+    assert sp2.check_balance() == 0
+    assert led.seal_snapshot(sp2.stats(), seq=2).balanced
+
+
+def test_orphans_past_age_cap_expire_as_orphan_age(tmp_path):
+    sp1, _t = _spool(dir=str(tmp_path), incarnation=1)
+    sp1.put("d:1", b"stale-wire", 7)
+    sp1.put("d:1", b"fresh-wire", 3)
+    files = sorted(os.path.join(r, f)
+                   for r, _d, fs in os.walk(tmp_path)
+                   for f in fs if f.endswith(".wire"))
+    old = __import__("time").time() - 500
+    os.utime(files[0], (old, old))
+
+    sp2, _t2 = _spool(dir=str(tmp_path), incarnation=2,
+                      max_age=100.0)
+    st = sp2.stats()
+    assert st["adopted_wires"] == 2 and st["adopted_items"] == 10
+    # the stale orphan is a NAMED write-off, not a silent unlink
+    assert st["expired_by_reason"]["orphan_age"] == 7
+    assert st["queued_items"] == 3
+    assert sp2.check_balance() == 0
+    assert not os.path.exists(files[0])  # expired segment unlinked
+
+
+def test_old_format_segment_names_still_adopt(tmp_path):
+    ddir = os.path.join(str(tmp_path), "d_1")
+    os.makedirs(ddir)
+    # pre-adoption layout: bare {seq}.wire, no marker file — the
+    # sanitized directory name stands in for the destination and the
+    # item count is unknown (0)
+    with open(os.path.join(ddir, f"{7:012d}.wire"), "wb") as f:
+        f.write(b"legacy")
+    sp, _t = _spool(dir=str(tmp_path), incarnation=3,
+                    max_age=100.0)
+    st = sp.stats()
+    assert st["adopted_wires"] == 1 and st["adopted_items"] == 0
+    e = sp.take("d_1")
+    assert e is not None and e.read() == b"legacy"
+    sp.mark_replayed(e)
+    assert sp.check_balance() == 0
 
 
 # ----------------------------------------------------------------------

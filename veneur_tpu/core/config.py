@@ -446,6 +446,25 @@ class Config:
     # bounded.  VENEUR_TPU_OVERLOAD_COALESCE=0 keeps the old
     # warn-and-continue behavior.
     tpu_overload_coalesce: bool = True
+    # crash-riding checkpoints (ops/checkpoint.py): every interval the
+    # checkpointer copies the open interval's host staging and writes
+    # an atomically-renamed cumulative segment under
+    # tpu_checkpoint_dir, so a SIGKILL/OOM loses at most one
+    # checkpoint interval of ingest — and recovery replays the rest
+    # through the import wire, flagged veneur-recovery.  Enabled iff
+    # the dir is set AND the interval is > 0.
+    # VENEUR_TPU_CHECKPOINT_INTERVAL overrides ("0" disables).
+    tpu_checkpoint_interval: str = "1s"
+    # segment directory; empty disables checkpointing entirely.
+    # VENEUR_TPU_CHECKPOINT_DIR overrides.
+    tpu_checkpoint_dir: str = ""
+    # global-side keyspace-arc handoff on scale-out: when enabled, a
+    # global told of new ring members (Server.arc_handoff) ships the
+    # resident rows whose route-keys fall in the new members' arcs
+    # over the import wire, flagged veneur-handoff, before the locals
+    # flip their ring epoch — conserving mid-interval mass
+    # cluster-wide.  VENEUR_TPU_ARC_HANDOFF=0 disables.
+    tpu_arc_handoff: bool = True
 
     def resolve_aliases(self) -> None:
         """Fold the reference's deprecated alias keys into their
@@ -505,6 +524,13 @@ class Config:
 
     def forward_spool_max_age_seconds(self) -> float:
         return parse_duration(self.tpu_forward_spool_max_age)
+
+    def checkpoint_interval_seconds(self) -> float:
+        return parse_duration(self.tpu_checkpoint_interval or "0")
+
+    def checkpoint_enabled(self) -> bool:
+        return bool(self.tpu_checkpoint_dir) and \
+            self.checkpoint_interval_seconds() > 0
 
     def validate(self) -> list[str]:
         problems = []

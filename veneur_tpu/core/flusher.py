@@ -244,6 +244,13 @@ class Flusher:
         # (default).  False runs the per-row legacy loop — kept as the
         # parity oracle the columnar suite asserts against.
         self.columnar = columnar
+        # scale-out arc handoff override: a ``(meta) -> bool``
+        # installed for exactly one flush (Server.arc_handoff).  True
+        # force-forwards the row even on a node whose flusher never
+        # forwards (a global) — the keyspace arc now belongs to
+        # another member, so the row must LEAVE as mergeable state
+        # instead of being emitted here.  None in steady state.
+        self.handoff = None
 
     # ------------------------------------------------------------------
 
@@ -448,6 +455,8 @@ class Flusher:
         return meta.scope != dsd.SCOPE_GLOBAL or not self.is_local
 
     def _forwardable(self, meta: RowMeta, always: bool) -> bool:
+        if self.handoff is not None and self.handoff(meta):
+            return True
         if not self.is_local or meta.scope == dsd.SCOPE_LOCAL:
             return False
         return always or meta.scope == dsd.SCOPE_GLOBAL
@@ -544,6 +553,12 @@ class Flusher:
                     means=pre["fwd_means"][pos].copy(),
                     weights=pre["fwd_weights"][pos].copy()))
                 n_fwd += 1
+                # an arc handed off to a new ring owner forwards ONLY:
+                # the state now lives on the new member, which emits it
+                # next interval — emitting here too would double-report
+                # the row's mass cluster-wide for the handoff interval
+                if self.handoff is not None and self.handoff(meta):
+                    continue
             # mixed-scope histos emit local aggregates even while their
             # digest forwards; global-only histos emit nothing locally
             if meta.scope == dsd.SCOPE_GLOBAL and self.is_local:
@@ -661,21 +676,27 @@ class Flusher:
         if not len(rows):
             return
         v64 = np.asarray(vals)[rows].astype(np.float64)
+        # arc-handoff rows forward ONLY, on either tier: their state
+        # now lives on the new ring owner (see _flush_histos)
+        ho = np.zeros(len(rows), dtype=bool)
+        if self.handoff is not None:
+            ho = np.fromiter(
+                (bool(self.handoff(metas[int(r)])) for r in rows),
+                dtype=bool, count=len(rows))
         if self.is_local:
             sc = _scope_codes(metas, rows)
-            fwd = sc == _SCOPE_GLOBAL
-            for r, v in zip(rows[fwd], v64[fwd]):
-                res.forward.append(ForwardRow(metas[r], kind,
-                                              value=float(v)))
-            emit = ~fwd
-            frame.add_block(metas, rows[emit], v64[emit],
-                            type_code=type_code)
-            res.account_rows(staged=len(rows),
-                             emitted=int(emit.sum()),
-                             forwarded=int(fwd.sum()))
+            fwd = ho | (sc == _SCOPE_GLOBAL)
         else:
-            frame.add_block(metas, rows, v64, type_code=type_code)
-            res.account_rows(staged=len(rows), emitted=len(rows))
+            fwd = ho
+        for r, v in zip(rows[fwd], v64[fwd]):
+            res.forward.append(ForwardRow(metas[r], kind,
+                                          value=float(v)))
+        emit = ~fwd
+        frame.add_block(metas, rows[emit], v64[emit],
+                        type_code=type_code)
+        res.account_rows(staged=len(rows),
+                         emitted=int(emit.sum()),
+                         forwarded=int(fwd.sum()))
 
     def _frame_counters(self, snap: Snapshot, res: FlushResult,
                         pre: dict, frame: MetricFrame) -> None:
@@ -720,13 +741,20 @@ class Flusher:
         sc = _scope_codes(metas, rows)
         # routing counts mirror the legacy loop: on a local node every
         # non-local-scope row forwards and every non-global-scope row
-        # emits (default scope does both); a global node emits all
+        # emits (default scope does both); a global node emits all.
+        # Arc-handoff rows forward ONLY on either tier (emitting too
+        # would double-report their mass for the handoff interval).
+        ho = np.zeros(len(rows), dtype=bool)
+        if self.handoff is not None:
+            ho = np.fromiter(
+                (bool(self.handoff(metas[int(r)])) for r in rows),
+                dtype=bool, count=len(rows))
         if self.is_local:
-            fwd_mask = sc != _SCOPE_LOCAL
-            emit_mask = sc != _SCOPE_GLOBAL
+            fwd_mask = ho | (sc != _SCOPE_LOCAL)
+            emit_mask = ~ho & (sc != _SCOPE_GLOBAL)
         else:
-            fwd_mask = np.zeros(len(rows), dtype=bool)
-            emit_mask = np.ones(len(rows), dtype=bool)
+            fwd_mask = ho
+            emit_mask = ~ho
         res.account_rows(
             staged=len(rows), emitted=int(emit_mask.sum()),
             forwarded=len(pre["histo_fwd"]),
@@ -736,9 +764,8 @@ class Flusher:
             # mixed-scope histos emit local aggregates even while
             # their digest forwards; global-only histos emit nothing
             # locally
-            emit = sc != _SCOPE_GLOBAL
-            erows = rows[emit]
-            esc = sc[emit]
+            erows = rows[emit_mask]
+            esc = sc[emit_mask]
             if not len(erows):
                 res.tally["histograms"] = int(
                     snap.histo_touched[:len(metas)].sum())
@@ -746,8 +773,12 @@ class Flusher:
             gm = np.zeros(len(erows), dtype=bool)
             with_pcts = esc == _SCOPE_LOCAL
         else:
-            erows = rows
-            gm = sc == _SCOPE_GLOBAL
+            erows = rows[emit_mask]
+            if not len(erows):
+                res.tally["histograms"] = int(
+                    snap.histo_touched[:len(metas)].sum())
+                return
+            gm = sc[emit_mask] == _SCOPE_GLOBAL
             with_pcts = np.ones(len(erows), dtype=bool)
 
         # aggregates for mixed-scope rows come only from the local

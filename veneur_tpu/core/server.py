@@ -395,6 +395,41 @@ class Server:
         # flush, which flags forward wires drain=true and widens the
         # send deadline so the handoff lands before exit
         self._draining = False
+        # crash-riding state (ops/checkpoint, ops/fdpass): process
+        # start time, listener fds adopted from a predecessor via
+        # VENEUR_TPU_SOCK_CLOAKED, and the monotonic incarnation id
+        # that stamps checkpoint segments and spool filenames
+        self.start_epoch = time.time()
+        from veneur_tpu.ops import fdpass
+        self._adopted_socks = {}
+        for slot, fd in fdpass.parse_cloak().items():
+            try:
+                self._adopted_socks[slot] = fdpass.adopt_socket(fd)
+            except OSError as e:
+                # fail-open: a dead fd degrades that slot to a fresh
+                # bind, never a crash
+                log.warning("cloaked fd %d for slot %s unusable: %s",
+                            fd, slot, e)
+        self.restarts_adopted = 0
+        # live listener sockets by cloak slot name, for handing down
+        # to a replacement (fdpass.send_sockets / encode_cloak)
+        self._cloak_slots: dict[str, socket.socket] = {}
+        self.incarnation = 0
+        self._checkpointer = None
+        if config.checkpoint_enabled():
+            from veneur_tpu.ops import checkpoint as _ckpt
+            self.incarnation = _ckpt.next_incarnation(
+                config.tpu_checkpoint_dir)
+        # recovery ids already ingested by THIS process: the
+        # receiver-side dedup for retransmitted recovery wires
+        # (guarded by self.lock, same critical section as the apply)
+        self._recovery_seen: set[str] = set()
+        # scale-out arc handoff (forward/handoff.py): (ring,
+        # self_member) pending for exactly one flush, set by
+        # arc_handoff(); the shipper is lazily built and reused
+        self._handoff_pending = None
+        self._handoff_shipper = None
+        self._handoff_last: dict = {}
 
         if getattr(config, "tpu_warmup", False) and \
                 hasattr(self.table, "take_staged"):
@@ -880,8 +915,8 @@ class Server:
         return run
 
     def start(self) -> None:
-        for addr in self.config.statsd_listen_addresses:
-            self._start_statsd(addr)
+        for ai, addr in enumerate(self.config.statsd_listen_addresses):
+            self._start_statsd(addr, ai)
         if self.config.http_address:
             self._start_http(self.config.http_address)
         for addr in self.config.grpc_listen_addresses:
@@ -906,25 +941,69 @@ class Server:
             self._threads.append(t)
         for s in self.metric_sinks:
             s.start()
+        # cloak slots nobody claimed (listener-count/config drift
+        # between incarnations): close them so the fds don't leak —
+        # loudly, because an unclaimed slot means kernel-queued
+        # packets on that socket are now orphaned
+        for name, sock in self._adopted_socks.items():
+            log.warning("unclaimed cloaked listener %r; closing it",
+                        name)
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._adopted_socks.clear()
+        # crash-riding: start the staged-plane checkpointer, then
+        # replay any predecessor's surviving segments through the
+        # import path (recovery runs AFTER listeners so a forwarded
+        # recovery wire can stitch into live telemetry immediately)
+        if (self.config.checkpoint_enabled()
+                and hasattr(self.table, "checkpoint_capture")):
+            from veneur_tpu.ops.checkpoint import Checkpointer
+            self._checkpointer = Checkpointer(
+                self, self.config.tpu_checkpoint_dir,
+                self.config.checkpoint_interval_seconds(),
+                self.incarnation)
+            self._checkpointer.start()
+            try:
+                self._recover_from_checkpoints()
+            except Exception:
+                self.bump("recovery_errors")
+                log.exception("checkpoint recovery failed")
 
-    def _start_statsd(self, addr: str) -> None:
+    def _start_statsd(self, addr: str, index: int = 0) -> None:
         scheme, host, port, path = parse_addr(addr)
         if scheme == "udp":
             n = max(1, self.config.num_readers)
             for i in range(n):
-                sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-                if n > 1:
+                slot = f"statsd.udp.{index}.{i}"
+                sock = self._adopted_socks.pop(slot, None)
+                if sock is not None:
+                    # einhorn-style fd adoption: the predecessor (or
+                    # a supervising master) cloaked this bound socket
+                    # into VENEUR_TPU_SOCK_CLOAKED, so datagrams
+                    # queued in the kernel across the restart are
+                    # read by this process, never dropped at the
+                    # kernel boundary
+                    self.restarts_adopted += 1
+                    self.bump("listener_fds_adopted")
+                else:
+                    sock = socket.socket(socket.AF_INET,
+                                         socket.SOCK_DGRAM)
+                    if n > 1:
+                        sock.setsockopt(socket.SOL_SOCKET,
+                                        socket.SO_REUSEPORT, 1)
                     sock.setsockopt(socket.SOL_SOCKET,
-                                    socket.SO_REUSEPORT, 1)
-                sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
-                                self.config.read_buffer_size_bytes)
+                                    socket.SO_RCVBUF,
+                                    self.config.read_buffer_size_bytes)
+                    sock.bind((host, port))
                 # periodic wake: SO_REUSEPORT hashes the shutdown
                 # wake datagram to ONE group member, so a timeout is
                 # the guarantee every reader re-checks _shutdown
                 sock.settimeout(1.0)
-                sock.bind((host, port))
                 port = sock.getsockname()[1]  # resolve port 0 once
                 self._sockets.append(sock)
+                self._cloak_slots[slot] = sock
                 t = threading.Thread(target=self._crashguard(self._udp_reader),
                                      args=(sock, "dogstatsd-udp"),
                                      daemon=True,
@@ -1537,6 +1616,22 @@ class Server:
                             "by_inode": dict(
                                 server._kernel_drops_last),
                         },
+                        # crash-riding lifecycle: when this process
+                        # started, its checkpoint incarnation id, and
+                        # how many listener fds it adopted from a
+                        # predecessor (VENEUR_TPU_SOCK_CLOAKED)
+                        "start_epoch": server.start_epoch,
+                        "incarnation": server.incarnation,
+                        "restarts_adopted": server.restarts_adopted,
+                        # staged-plane checkpointer counters; None
+                        # when checkpointing is disabled
+                        "checkpoint": (
+                            dict(server._checkpointer.stats)
+                            if server._checkpointer is not None
+                            else None),
+                        # last scale-out arc handoff shipped by this
+                        # node ({} until arc_handoff runs)
+                        "handoff": dict(server._handoff_last),
                     })
                 elif (self.path == "/quitquitquit" and
                       server.config.http_quit):
@@ -1568,23 +1663,70 @@ class Server:
                         replay = http_import.decode_replay_header(
                             self.headers.get(
                                 http_import.REPLAY_HEADER))
+                        recovery = http_import.decode_recovery_header(
+                            self.headers.get(
+                                http_import.RECOVERY_HEADER))
+                        handoff = http_import.decode_handoff_header(
+                            self.headers.get(
+                                http_import.HANDOFF_HEADER))
+                        deduped = False
+                        acc = dropped = 0
+                        work = None
                         with server.lock:
-                            # split dropped into overflow vs invalid
-                            # exactly: every overflow bump happens
-                            # under this same lock, so the tally delta
-                            # across apply_import is this request's
-                            ov0 = server.table.overflow_total()
-                            acc, dropped = http_import.apply_import(
-                                server.table, items)
-                            ov = server.table.overflow_total() - ov0
-                            server.ledger.ingest(
-                                "http-import-drain" if drain
-                                else "http-import-replay" if replay
-                                else "http-import",
-                                processed=acc + dropped, staged=acc,
-                                overflow=ov, invalid=dropped - ov)
-                            work = server._maybe_device_step_locked()
+                            if (recovery and recovery
+                                    in server._recovery_seen):
+                                # retransmitted recovery wire: the
+                                # inc:seq id already landed — accept
+                                # and discard so the sender's retry
+                                # can't double-count the crash tail
+                                deduped = True
+                            else:
+                                if recovery:
+                                    server._recovery_seen.add(
+                                        recovery)
+                                # split dropped into overflow vs
+                                # invalid exactly: every overflow
+                                # bump happens under this same lock,
+                                # so the tally delta across
+                                # apply_import is this request's
+                                ov0 = server.table.overflow_total()
+                                acc, dropped = \
+                                    http_import.apply_import(
+                                        server.table, items)
+                                ov = (server.table.overflow_total()
+                                      - ov0)
+                                server.ledger.ingest(
+                                    "http-import-recovery"
+                                    if recovery
+                                    else "http-import-handoff"
+                                    if handoff
+                                    else "http-import-drain" if drain
+                                    else "http-import-replay"
+                                    if replay
+                                    else "http-import",
+                                    processed=acc + dropped,
+                                    staged=acc,
+                                    overflow=ov,
+                                    invalid=dropped - ov)
+                                if recovery:
+                                    inc = recovery.split(":", 1)[0]
+                                    server.ledger.recover(
+                                        f"incarnation:{inc}", acc)
+                                if handoff:
+                                    server.ledger.\
+                                        credit_reshard_received(acc)
+                                work = \
+                                    server._maybe_device_step_locked()
                         server._apply_staged(work)
+                        if deduped:
+                            server.bump("recovery_wires_deduped")
+                        elif recovery:
+                            server.bump("recovery_wires_received")
+                            server.bump("recovery_items_received",
+                                        acc)
+                        if handoff and not deduped:
+                            server.bump("handoff_wires_received")
+                            server.bump("handoff_items_received", acc)
                         if drain:
                             server.bump("drain_wires_received")
                             server.bump("drain_items_received", acc)
@@ -1607,7 +1749,23 @@ class Server:
                 else:
                     self.send_error(404)
 
-        if address.startswith("einhorn@"):
+        adopted = self._adopted_socks.pop("http", None)
+        if adopted is not None:
+            # fd adoption (VENEUR_TPU_SOCK_CLOAKED): the predecessor
+            # handed down its listening TCP socket, so connections
+            # queued in the accept backlog across the restart are
+            # served, and the port is never released (no bind race
+            # with a sibling).  Mirrors the einhorn@ branch below.
+            self._httpd = http.server.ThreadingHTTPServer(
+                adopted.getsockname()[:2], Handler,
+                bind_and_activate=False)
+            self._httpd.socket.close()
+            self._httpd.socket = adopted
+            (self._httpd.server_name,
+             self._httpd.server_port) = adopted.getsockname()[:2]
+            self.restarts_adopted += 1
+            self.bump("listener_fds_adopted")
+        elif address.startswith("einhorn@"):
             # adopt the listening socket einhorn inherited to us
             # (reference README 'Einhorn Usage': http_address
             # einhorn@0 via goji/bind) and ACK the master so it stops
@@ -1633,6 +1791,7 @@ class Server:
             self._httpd = http.server.ThreadingHTTPServer(
                 (host or "127.0.0.1", int(port)), Handler)
         self.http_port = self._httpd.server_port
+        self._cloak_slots["http"] = self._httpd.socket
         t = threading.Thread(target=self._httpd.serve_forever,
                              daemon=True, name="http")
         t.start()
@@ -1832,7 +1991,22 @@ class Server:
             for plugin in self.plugins:
                 submit(f"plugin:{plugin.name}", plugin.flush,
                        list(res.all_metrics()), self.flusher.hostname)
-            if self.is_local and res.forward:
+            handoff_pending = self._handoff_pending
+            if handoff_pending is not None and res.forward:
+                # scale-out arc handoff (Server.arc_handoff): this
+                # flush's forward rows are arcs the NEW ring assigns
+                # to other members — ship them over the import wire
+                # flagged veneur-handoff instead of the (on a global:
+                # unconfigured) forward path
+                ring, self_member = handoff_pending
+
+                def traced_handoff(rows):
+                    with cyc.stage("handoff") as sp:
+                        sp.add_tag("rows", str(len(rows)))
+                        self._ship_handoff(rows, ring, self_member,
+                                           led, cyc.wire_context(sp))
+                submit("handoff", traced_handoff, res.forward)
+            elif self.is_local and res.forward:
                 submit("forward", traced_forward, res.forward)
             submit("spans", self.span_worker.flush)
             # Wait for sink/forward/span tasks only within the interval
@@ -1921,6 +2095,14 @@ class Server:
                 with self.lock:
                     setp(self.overload.pressure.level)
         self.ledger.seal(led)
+        if self._checkpointer is not None:
+            # the sealed interval's mass is delivered: its checkpoint
+            # segments (and every older gen's) are now replay
+            # hazards, not safety — prune them
+            try:
+                self._checkpointer.on_flush(int(snap.gen))
+            except Exception:
+                log.exception("checkpoint prune after flush failed")
         try:
             self.telemetry.flush_tick(
                 res.tally, time.monotonic_ns() - t_flush0, sink_durs,
@@ -2087,7 +2269,8 @@ class Server:
                         32 << 20)),
                     max_age=self.config.forward_spool_max_age_seconds(),
                     dir=(getattr(self.config,
-                                 "tpu_forward_spool_dir", "") or None))
+                                 "tpu_forward_spool_dir", "") or None),
+                    incarnation=self.incarnation)
             self._sharded_fwd = ShardedForwarder(
                 addrs, compression=float(self.config.tpu_compression),
                 credentials=self._forward_grpc_credentials(),
@@ -2423,12 +2606,182 @@ class Server:
         finally:
             self._draining = False
 
+    # ------------------------------------------------------------------
+    # crash recovery + scale-out arc handoff
+
+    def _recover_from_checkpoints(self) -> None:
+        """Replay a crashed predecessor's surviving checkpoint
+        segments (newest per incarnation+gen, unconsumed, younger
+        than the recovery grace).  A local with a gRPC forward ships
+        each segment body over the forward wire flagged
+        ``veneur-recovery`` — the global books it past its interval
+        cutoff under ``grpc-import-recovery`` and dedups on the
+        ``inc:seq`` recovery id; everyone else re-ingests the body
+        locally through the columnar import path, credited
+        ``checkpoint-recovery`` and paired with the ledger's
+        ``recovered`` arm.  Consumed ids are registered in the
+        checkpoint dir so a crash DURING recovery (or two racing
+        replacements) replays nothing twice."""
+        from veneur_tpu.ops import checkpoint as ckpt
+        directory = self.config.tpu_checkpoint_dir
+        max_age = ckpt.RECOVERY_GRACE * max(
+            self.config.checkpoint_interval_seconds(), self.interval)
+        segs = ckpt.scan_recoverable(directory, self.incarnation,
+                                     max_age)
+        if not segs:
+            return
+        use_wire = (self.is_local and self.config.forward_use_grpc
+                    and bool(self.config.forward_address))
+        client = None
+        try:
+            if use_wire:
+                from veneur_tpu.forward.grpc_forward import \
+                    ForwardClient
+                # sharded locals recover through the FIRST member:
+                # the global tier merges a row wherever it lands, and
+                # one off-arc recovery wire beats re-deriving the
+                # predecessor's rows for per-arc routing
+                dest = self.config.forward_address.split(
+                    ",")[0].strip()
+                client = ForwardClient(
+                    dest,
+                    compression=float(self.config.tpu_compression),
+                    credentials=self._forward_grpc_credentials())
+            for seg in segs:
+                rid = seg.recovery_id
+                items = int(seg.header.get("items", 0))
+                try:
+                    if client is not None:
+                        from veneur_tpu.forward import \
+                            grpc_forward as gf
+                        client.send_wire(
+                            seg.body,
+                            metadata=[(gf.RECOVERY_KEY, rid)])
+                    else:
+                        self._recover_local(seg, rid)
+                except Exception:
+                    self.bump("recovery_errors")
+                    log.exception("recovery replay of %s failed",
+                                  seg.path)
+                    continue
+                ckpt.mark_consumed(directory, rid)
+                self.bump("recovery_segments_replayed")
+                self.bump("recovery_items_replayed", items)
+                log.info(
+                    "recovered checkpoint %s (%d items; %d device-"
+                    "staged beyond its reach) via %s", rid, items,
+                    int(seg.header.get("device_staged", 0)),
+                    "forward wire" if client is not None
+                    else "local re-ingest")
+        finally:
+            if client is not None:
+                client.close()
+
+    def _recover_local(self, seg, rid: str) -> None:
+        """Re-ingest one segment body through the columnar import
+        path under the ingest lock — the same receiver-side dedup
+        the wire path gets from the import server."""
+        from veneur_tpu.forward.grpc_forward import \
+            apply_metric_list_bytes
+        deduped = False
+        acc = dropped = 0
+        work = None
+        with self.lock:
+            if rid in self._recovery_seen:
+                deduped = True
+            else:
+                self._recovery_seen.add(rid)
+                ov0 = self.table.overflow_total()
+                acc, dropped = apply_metric_list_bytes(self.table,
+                                                       seg.body)
+                ov = self.table.overflow_total() - ov0
+                self.ledger.ingest(
+                    "checkpoint-recovery", processed=acc + dropped,
+                    staged=acc, overflow=ov, invalid=dropped - ov)
+                inc = rid.split(":", 1)[0]
+                self.ledger.recover(f"incarnation:{inc}", acc)
+                work = self._maybe_device_step_locked()
+        self._apply_staged(work)
+        if deduped:
+            self.bump("recovery_wires_deduped")
+            return
+        self.bump("imports_received", acc)
+        self.bump("metrics_dropped", dropped)
+
+    def arc_handoff(self, members: list[str],
+                    self_member: str) -> dict:
+        """Scale-out keyspace handoff, global tier: flush once with
+        the flusher's handoff gate installed, so every resident row
+        whose route-key arc belongs to another member under the NEW
+        ring force-forwards, and ship those rows over the import wire
+        flagged ``veneur-handoff`` (_ship_handoff).  Run on each
+        incumbent when discovery adds global M+1, BEFORE the locals'
+        rings flip — the newcomer receives its arcs' staged history
+        instead of starting cold while the incumbent re-reports the
+        same keys.  Returns the shipped-arc stats."""
+        if not getattr(self.config, "tpu_arc_handoff", True):
+            return {"enabled": False}
+        from veneur_tpu.forward import handoff as ho
+        from veneur_tpu.forward.ring import ConsistentRing
+        ring = ConsistentRing(list(members))
+        with self._flush_serial:
+            self._handoff_last = {}
+            self.flusher.handoff = ho.make_flusher_gate(
+                ring, self_member)
+            self._handoff_pending = (ring, self_member)
+            try:
+                self._flush_once_locked()
+            finally:
+                self.flusher.handoff = None
+                self._handoff_pending = None
+        self.bump("arc_handoffs")
+        return dict(self._handoff_last)
+
+    def _ship_handoff(self, rows, ring, self_member, led,
+                      trace_ctx=None) -> None:
+        """Partition a handoff flush's forward rows by the new ring
+        and send each member its arcs, flagged ``veneur-handoff``;
+        the receiver books them as a rebalance arrival
+        (reshard_received_items).  Wire failures drop loudly —
+        counted, ledger-credited, never silent."""
+        from veneur_tpu.forward import handoff as ho
+        if self._handoff_shipper is None:
+            self._handoff_shipper = ho.HandoffShipper(
+                compression=float(self.config.tpu_compression),
+                credentials=self._forward_grpc_credentials())
+        by_member, kept = ho.partition(rows, ring, self_member)
+        moved = sum(len(v) for v in by_member.values())
+        stats = self._handoff_shipper.ship(by_member, trace_ctx)
+        stats["moved_rows"] = moved
+        stats["kept_rows"] = kept
+        self._handoff_last = stats
+        self.bump("handoff_wires_sent", stats["wires"])
+        self.bump("handoff_items_sent", stats["items"])
+        if stats["errors"]:
+            self.bump("handoff_errors", stats["errors"])
+            self.bump("metrics_dropped", stats["dropped_items"])
+        if led is not None:
+            # name the outward rebalance on the interval record: the
+            # ring gained every member that is not this node and
+            # ``moved`` of this flush's rows left for new owners
+            self.ledger.credit_reshard(
+                led, 0, [m for m in ring.members
+                         if m != self_member], [], moved)
+            self.ledger.credit_forward_wire(
+                led, rows=stats["items"], errors=stats["errors"])
+
     def shutdown(self) -> None:
         if (not self._shutdown.is_set()
                 and getattr(self.config, "tpu_drain_on_shutdown", True)
                 and self.config.is_local()):
             self._drain_handoff()
         self._shutdown.set()
+        if self._checkpointer is not None:
+            self._checkpointer.stop()
+            self._checkpointer = None
+        if self._handoff_shipper is not None:
+            self._handoff_shipper.close()
+            self._handoff_shipper = None
         if getattr(self, "_sentry_handler", None) is not None:
             # don't leave error logs mirroring to a dead client (and
             # blocking the next Server's handler)

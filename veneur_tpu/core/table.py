@@ -588,6 +588,12 @@ class MetricTable:
         # conservation ledger cross-checks against site-credited sums
         self._staged_n = 0
         self._interval_ingested = 0
+        # samples that left host staging mid-interval (threshold
+        # device steps): a crash checkpoint can't see them, so the
+        # checkpointer records the count as a NAMED uncovered quantity
+        # instead of letting it read as covered (see
+        # checkpoint_capture)
+        self._interval_device_staged = 0
         # overload pressure: set_pressure_level walks histogram merge
         # width down the ladder so the expensive class loses precision
         # (more collapse per merge) before anyone loses samples; the
@@ -1376,6 +1382,83 @@ class MetricTable:
                 w.state.pending -= 1
                 self._pending_cv.notify_all()
 
+    def checkpoint_capture(self) -> dict | None:
+        """Copy the open interval's HOST staging for a crash
+        checkpoint.  MUST run under the caller's ingest lock; does no
+        device work and detaches nothing — ingest keeps combining into
+        the live buffers while the checkpointer serializes the copies
+        off-lock (the copy IS the double-buffer).
+
+        Mid-interval essentially all staged mass is host-side: dense
+        counter/gauge accumulators only ship at the swap, and the
+        list stagings detach early only past the histo_merge_samples
+        (4M-sample) / 64K-stat-row thresholds.  Whatever DID move to
+        device state early is counted in ``device_staged`` so the
+        checkpoint names its blind spot instead of hiding it.
+
+        Staging lists are captured as shallow list copies: they only
+        ever append ndarray chunks that no ingest path mutates
+        afterwards (reader-shard commits copy their scratch before
+        appending), so the chunks themselves are safe to share.  The
+        per-class meta lists are captured as (reference, length)
+        pairs: they are append-only, and compaction REPLACES the list
+        object at a swap boundary, so a held reference stays
+        self-consistent with the captured row ids.
+
+        Returns None when nothing is staged (nothing to lose)."""
+        cap: dict = {"gen": self.gen,
+                     "ingested": self._interval_ingested,
+                     "device_staged": self._interval_device_staged}
+        data = False
+        if self._counter_dirty:
+            cap["counter"] = self._counter_dense.copy()
+            data = True
+        if self._gauge_dirty:
+            cap["gauge"] = (self._gauge_dense.copy(),
+                            self._gauge_mask.copy())
+            data = True
+        if self._histo_stage.rows:
+            s = self._histo_stage
+            cap["histo"] = (list(s.rows), list(s.values),
+                            list(s.weights))
+            data = True
+        if self._digest_stage.rows:
+            s = self._digest_stage
+            cap["digest"] = (list(s.rows), list(s.values),
+                            list(s.weights))
+            data = True
+        if self._wire_digest_parts:
+            cap["wire_parts"] = list(self._wire_digest_parts)
+            data = True
+        if self._stats_import_parts:
+            cap["stats_parts"] = list(self._stats_import_parts)
+            data = True
+        if self._set_rows:
+            cap["set_members"] = (list(self._set_rows),
+                                  list(self._set_members))
+            data = True
+        if self._set_pos_rows:
+            cap["set_pos"] = (list(self._set_pos_rows),
+                              list(self._set_pos))
+            data = True
+        if (self._set_import_touched is not None and
+                self._set_import_touched.any()):
+            rows = np.flatnonzero(self._set_import_touched)
+            cap["set_import"] = (rows.astype(np.int32),
+                                 self._set_import_plane[rows].copy())
+            data = True
+        if not data:
+            return None
+        cap["counter_meta"] = (self.counter_idx.meta,
+                               len(self.counter_idx.meta))
+        cap["gauge_meta"] = (self.gauge_idx.meta,
+                             len(self.gauge_idx.meta))
+        cap["histo_meta"] = (self.histo_idx.meta,
+                             len(self.histo_idx.meta))
+        cap["set_meta"] = (self.set_idx.meta,
+                           len(self.set_idx.meta))
+        return cap
+
     def _detach_staged(self, final: bool) -> _StagedWork:
         """Hand off staging buffers for one apply.  Runs under the
         ingest lock and does NO concatenation, hashing, or device
@@ -1447,6 +1530,23 @@ class MetricTable:
                    w.histo is None and w.digest is None and
                    w.wire_parts is None and w.set_parts is None and
                    w.stats_parts is None and w.set_import is None)
+        if not final and not w.empty:
+            # mid-interval detach: these samples move to device state
+            # and out of any future checkpoint's view — tally them so
+            # the checkpoint header names what it does NOT cover
+            n = 0
+            if w.histo is not None:
+                n += sum(len(r) for r in w.histo.rows)
+            if w.digest is not None:
+                n += sum(len(r) for r in w.digest.rows)
+            if w.wire_parts is not None:
+                n += sum(len(p[0]) for p in w.wire_parts)
+            if w.set_parts is not None:
+                sr, _sm, spr, _sp = w.set_parts
+                n += len(sr) + sum(len(r) for r in spr)
+            if w.stats_parts is not None:
+                n += sum(len(p[0]) for p in w.stats_parts)
+            self._interval_device_staged += n
         return w
 
     def _apply_work(self, w: _StagedWork) -> None:
@@ -2196,6 +2296,7 @@ class MetricTable:
         # ledger's cross-check sees a consistent boundary
         pend.ingested = self._interval_ingested
         self._interval_ingested = 0
+        self._interval_device_staged = 0
         # the old planes belong to the outgoing state (and, soon, its
         # snapshot); the new interval ADOPTS the array references with
         # every kind marked fresh — new zeroed planes are allocated

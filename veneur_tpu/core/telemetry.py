@@ -226,6 +226,54 @@ class Telemetry:
               self._delta("replay_wires_received"))
         count("veneur.import.replay_items_total",
               self._delta("replay_items_received"))
+        # crash recovery, both directions: checkpoint segments this
+        # node replayed at startup (wire or local re-ingest), and
+        # recovery-flagged wires accepted from restarting peers —
+        # deduped counts retransmits the inc:seq registry absorbed
+        count("veneur.recovery.segments_total",
+              self._delta("recovery_segments_replayed"))
+        count("veneur.recovery.items_total",
+              self._delta("recovery_items_replayed"))
+        count("veneur.recovery.errors_total",
+              self._delta("recovery_errors"))
+        count("veneur.import.recovery_wires_total",
+              self._delta("recovery_wires_received"))
+        count("veneur.import.recovery_items_total",
+              self._delta("recovery_items_received"))
+        count("veneur.import.recovery_deduped_total",
+              self._delta("recovery_wires_deduped"))
+        # scale-out arc handoff, both directions; plus listener fds
+        # adopted from a predecessor at boot (einhorn-style restart)
+        count("veneur.forward.handoff.wires_total",
+              self._delta("handoff_wires_sent"))
+        count("veneur.forward.handoff.items_total",
+              self._delta("handoff_items_sent"))
+        count("veneur.forward.handoff.errors_total",
+              self._delta("handoff_errors"))
+        count("veneur.import.handoff_wires_total",
+              self._delta("handoff_wires_received"))
+        count("veneur.import.handoff_items_total",
+              self._delta("handoff_items_received"))
+        count("veneur.restart.fds_adopted_total",
+              self._delta("listener_fds_adopted"))
+        # staged-plane checkpointer (ops/checkpoint.py): segment
+        # writes, prunes after flush seals, and stale discards (a
+        # capture the flush overtook mid-serialize)
+        ckpt = getattr(self.server, "_checkpointer", None)
+        if ckpt is not None:
+            for attr, metric in (
+                    ("written", "veneur.checkpoint.written_total"),
+                    ("bytes", "veneur.checkpoint.bytes_total"),
+                    ("rows", "veneur.checkpoint.rows_total"),
+                    ("pruned", "veneur.checkpoint.pruned_total"),
+                    ("stale_discarded",
+                     "veneur.checkpoint.stale_discarded_total"),
+                    ("errors", "veneur.checkpoint.errors_total")):
+                key = f"checkpoint_{attr}"
+                self.server.stats[key] = int(ckpt.stats[attr])
+                count(metric, self._delta(key))
+            gauge("veneur.checkpoint.last_items",
+                  ckpt.stats["last_items"])
         # discovery refresh health for the sharded forward ring:
         # reason-tagged refresh errors (keep-last-good degradation)
         fwd = getattr(self.server, "_sharded_fwd", None)
@@ -398,6 +446,16 @@ class Telemetry:
             count("veneur.ledger.imbalance_total",
                   self._delta("ledger_imbalance"))
             count("veneur.ledger.shed_total", rec.shed)
+            # the recovered arm: crash-tail items this interval
+            # accepted under a recovery flag (paired with a normal
+            # ingest credit; owed != 0 means a recovery credit
+            # arrived without its source attribution) — plus the
+            # receiving side of a scale-out arc handoff
+            count("veneur.ledger.recovered_total", rec.recovered)
+            count("veneur.ledger.recovered_owed_total",
+                  abs(rec.recovered_owed))
+            count("veneur.ledger.reshard_received_items_total",
+                  rec.reshard_received_items)
 
         # overload control: shed attribution (the metric twin of the
         # ledger's shed block — every turned-away sample named by

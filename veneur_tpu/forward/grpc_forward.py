@@ -61,6 +61,19 @@ DRAIN_KEY = "veneur-drain"
 # normal import.
 REPLAY_KEY = "veneur-replay"
 
+# crash recovery: a restarted node replays its predecessor's staged
+# checkpoint and flags the wire with the segment's recovery id
+# (``<incarnation>:<seq>``) so the receiving global accepts it past
+# cutoff under a recovery protocol AND deduplicates a double-recovery
+# by id — replayed-at-least-once at the wire, counted-exactly-once in
+# the table.  Old peers ignore the key (degrades to a normal import).
+RECOVERY_KEY = "veneur-recovery"
+
+# scale-out arc handoff: an incumbent global shedding keyspace arcs to
+# a new member flags the shipped rows so the receiver books them as a
+# rebalance arrival (``grpc-import-handoff``), not organic traffic.
+HANDOFF_KEY = "veneur-handoff"
+
 
 def decode_drain_metadata(metadata) -> bool:
     """True when the wire is a shutdown drain handoff; False when the
@@ -79,6 +92,29 @@ def decode_replay_metadata(metadata) -> bool:
     try:
         md = {k: v for k, v in (metadata or ())}
         return md.get(REPLAY_KEY, "") == "1"
+    except (TypeError, ValueError):
+        return False
+
+
+def decode_recovery_metadata(metadata) -> str:
+    """The wire's recovery id (``incarnation:seq``) or "" when the
+    key is absent/malformed — fail-open like the drain flag, so a bad
+    id degrades to a normal (non-deduplicated) import rather than a
+    rejection."""
+    try:
+        md = {k: v for k, v in (metadata or ())}
+        rid = md.get(RECOVERY_KEY, "")
+        return rid if ":" in rid else ""
+    except (TypeError, ValueError):
+        return ""
+
+
+def decode_handoff_metadata(metadata) -> bool:
+    """True when the wire is a scale-out arc handoff; False when the
+    key is absent/malformed (fail-open)."""
+    try:
+        md = {k: v for k, v in (metadata or ())}
+        return md.get(HANDOFF_KEY, "") == "1"
     except (TypeError, ValueError):
         return False
 
@@ -787,6 +823,8 @@ class ImportServer:
         tid, sid = decode_trace_metadata(md)
         drain = decode_drain_metadata(md)
         replay = decode_replay_metadata(md)
+        recovery_id = decode_recovery_metadata(md)
+        handoff = decode_handoff_metadata(md)
         ledger = getattr(core, "ledger", None)
         # decode outside the ingest lock: while another handler's
         # interval fold holds it (or _apply_staged runs the device
@@ -794,6 +832,18 @@ class ImportServer:
         # cycle N+1 decode overlaps cycle N fold
         cols = decode_metric_list(request)
         with core.lock:
+            # crash-recovery dedup, atomic with the apply: a segment
+            # replayed twice (restart raced, or the replayer retried a
+            # timed-out send that actually landed) is counted ONCE
+            if recovery_id is not None and recovery_id:
+                seen = getattr(core, "_recovery_seen", None)
+                if seen is not None:
+                    if recovery_id in seen:
+                        core.stats["recovery_wires_deduped"] = (
+                            core.stats.get("recovery_wires_deduped", 0)
+                            + 1)
+                        return empty_pb2.Empty()
+                    seen.add(recovery_id)
             ov0 = core.table.overflow_total() if ledger else 0
             if cols is None:
                 acc, dropped = apply_metric_list(
@@ -806,12 +856,19 @@ class ImportServer:
                 # overflow (the table counted them) vs invalid
                 # (malformed/non-finite, dropped before the table)
                 ov = core.table.overflow_total() - ov0
-                proto = ("grpc-import-drain" if drain
+                proto = ("grpc-import-recovery" if recovery_id
+                         else "grpc-import-handoff" if handoff
+                         else "grpc-import-drain" if drain
                          else "grpc-import-replay" if replay
                          else "grpc-import")
                 ledger.ingest(proto, processed=acc + dropped,
                               staged=acc, overflow=ov,
                               invalid=dropped - ov)
+                if recovery_id:
+                    inc = recovery_id.split(":", 1)[0]
+                    ledger.recover(f"incarnation:{inc}", acc)
+                if handoff:
+                    ledger.credit_reshard_received(acc)
             work = core._maybe_device_step_locked()
         core._apply_staged(work)
         core.bump("imports_received", acc)
@@ -829,6 +886,16 @@ class ImportServer:
             # runbook
             core.bump("replay_wires_received")
             core.bump("replay_items_received", acc)
+        if recovery_id:
+            # a crashed peer's replacement replayed its checkpoint:
+            # late mass from the dead incarnation's open interval,
+            # accepted once (see the dedup above)
+            core.bump("recovery_wires_received")
+            core.bump("recovery_items_received", acc)
+        if handoff:
+            # an incumbent global shipped arcs this node now owns
+            core.bump("handoff_wires_received")
+            core.bump("handoff_items_received", acc)
         if dropped:
             core.bump("metrics_dropped", dropped)
         note = getattr(core, "note_import_span", None)

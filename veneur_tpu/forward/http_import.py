@@ -59,6 +59,18 @@ DRAIN_HEADER = "X-Veneur-Drain"
 # header — a replayed wire degrades to a normal import.
 REPLAY_HEADER = "X-Veneur-Replay"
 
+# crash-recovery twin of grpc_forward.RECOVERY_KEY: the header value
+# is the checkpoint segment's recovery id (``incarnation:seq``) so
+# the receiver books the POST under a recovery protocol and dedups a
+# double-recovery.  Old peers ignore the header — a recovered wire
+# degrades to a normal import.
+RECOVERY_HEADER = "X-Veneur-Recovery"
+
+# arc-handoff twin of grpc_forward.HANDOFF_KEY: an incumbent global
+# shipping keyspace arcs to a new member flags the POST so the
+# receiver books it as a rebalance arrival.
+HANDOFF_HEADER = "X-Veneur-Handoff"
+
 
 def decode_drain_header(value: str | None) -> bool:
     """True when the request is a shutdown drain handoff; False on
@@ -69,6 +81,18 @@ def decode_drain_header(value: str | None) -> bool:
 def decode_replay_header(value: str | None) -> bool:
     """True when the request is a spool replay after an outage; False
     on absent/malformed (fail-open: never rejects the import)."""
+    return value == "1"
+
+
+def decode_recovery_header(value: str | None) -> str:
+    """The request's recovery id (``incarnation:seq``) or "" on
+    absent/malformed (fail-open: degrades to a normal import)."""
+    return value if value and ":" in value else ""
+
+
+def decode_handoff_header(value: str | None) -> bool:
+    """True when the request is a scale-out arc handoff; False on
+    absent/malformed (fail-open)."""
     return value == "1"
 
 

@@ -25,12 +25,19 @@ robustness feature:
   (``put`` returns False; the caller attributes the drop)
 
 Optional disk segments (``dir=...``, modeled on ``sinks/s3.py``'s
-spool layout ``<dir>/<dest>/<seq>.wire``): bodies are written
-through to one file per wire and dropped from memory, so an
-outage-sized backlog costs disk instead of RSS.  Segments are
-unlinked on replay/expiry; recovery across process restart is NOT
-attempted (a fresh process has a fresh ledger — replaying another
-process's wires would break its conservation story).
+spool layout ``<dir>/<dest>/<incarnation>-<seq>-<items>.wire``):
+bodies are written through to one file per wire and dropped from
+memory, so an outage-sized backlog costs disk instead of RSS.
+Segments are unlinked on replay/expiry.  At startup a spool with a
+directory ADOPTS a dead predecessor's surviving segments (crash
+recovery): each orphan re-enters the conservation story at
+``spooled`` — crediting the lifetime totals alongside the queue — so
+the new process's spool ledger seals balanced from its first
+interval; orphans already past ``max_age`` (by file mtime) are
+expired on the spot under reason ``orphan_age``, a named write-off
+rather than a silent one.  The incarnation stamp in the filename
+(the checkpoint subsystem's monotonic id) tells a reader whose crash
+a segment survived.
 
 Every wire is accounted from birth to death so the cross-interval
 spool ledger (observe/ledger.py:SpoolLedger) can seal
@@ -50,7 +57,15 @@ import time
 
 log = logging.getLogger("veneur_tpu.spool")
 
-EXPIRE_REASONS = ("age", "cap", "retired")
+EXPIRE_REASONS = ("age", "cap", "retired", "orphan_age")
+
+# segment filenames: new form <incarnation>-<seq>-<items>.wire; the
+# pre-adoption form <seq>.wire still parses (incarnation/items
+# unknown -> 0) so an upgrade adopts its predecessor's segments too
+_SEG_RE = re.compile(r"^(?:(\d{8})-)?(\d{12})(?:-(\d+))?\.wire$")
+# per-destination marker holding the REAL destination string (the
+# directory name is sanitized, so replay could never match it)
+_DEST_MARKER = "dest"
 
 
 class Spooled(Exception):
@@ -96,7 +111,8 @@ class WireSpool:
 
     def __init__(self, max_bytes: int = 32 << 20,
                  max_age: float = 300.0, dir: str | None = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, incarnation: int = 0,
+                 adopt_orphans: bool = True):
         self.max_bytes = int(max_bytes)
         self.max_age = float(max_age)
         self.dir = dir or None
@@ -104,6 +120,9 @@ class WireSpool:
         self._lock = threading.Lock()
         self._queues: dict[str, list[_Entry]] = {}
         self._seq = 0
+        self.incarnation = int(incarnation)
+        self.adopted_wires = 0
+        self.adopted_items = 0
         # -- lifetime totals (the spool ledger's inputs) ---------------
         self.spooled_wires = 0
         self.spooled_items = 0
@@ -121,6 +140,79 @@ class WireSpool:
         self.queued_bytes = 0
         self.inflight_items = 0      # popped for replay, not resolved
         self.inflight_wires = 0
+        if self.dir is not None and adopt_orphans:
+            self._adopt_orphans()
+
+    # -- orphan adoption -----------------------------------------------
+
+    def _adopt_orphans(self) -> None:
+        """Adopt a dead predecessor's on-disk segments at startup.
+
+        Each orphan credits the ``spooled`` lifetime totals AND the
+        queue (or an immediate ``orphan_age`` expiry when its mtime is
+        past ``max_age``), so ``check_balance`` holds from the first
+        wire.  Destinations come from the per-directory marker file;
+        a directory without one (pre-marker layout) falls back to its
+        sanitized name, which no live destination matches — those
+        wires sit until the age cap writes them off, attributed."""
+        now = self._clock()
+        wall = time.time()
+        try:
+            dests = sorted(os.listdir(self.dir))
+        except OSError:
+            return
+        with self._lock:
+            for dname in dests:
+                ddir = os.path.join(self.dir, dname)
+                if not os.path.isdir(ddir):
+                    continue
+                dest = dname
+                try:
+                    with open(os.path.join(ddir, _DEST_MARKER)) as f:
+                        dest = f.read().strip() or dname
+                except OSError:
+                    pass
+                try:
+                    names = sorted(os.listdir(ddir))
+                except OSError:
+                    continue
+                for name in names:
+                    m = _SEG_RE.match(name)
+                    if m is None:
+                        continue
+                    path = os.path.join(ddir, name)
+                    try:
+                        st = os.stat(path)
+                    except OSError:
+                        continue
+                    n_items = int(m.group(3) or 0)
+                    nbytes = int(st.st_size)
+                    age = max(0.0, wall - st.st_mtime)
+                    entry = _Entry(dest, None, n_items, nbytes,
+                                   now - age, path=path)
+                    self.spooled_wires += 1
+                    self.spooled_items += n_items
+                    self.spooled_bytes += nbytes
+                    self.adopted_wires += 1
+                    self.adopted_items += n_items
+                    self.queued_bytes += nbytes
+                    if self.max_age > 0 and age > self.max_age:
+                        # too stale to replay into a live aggregator:
+                        # a named write-off, not a silent unlink
+                        self._expire_entry_locked(entry,
+                                                  "orphan_age")
+                        continue
+                    self._queues.setdefault(dest, []).append(entry)
+            # adopted backlog must respect the byte cap like any
+            # other intake: evict oldest-first, credited ``cap``
+            while self.queued_bytes > self.max_bytes:
+                if not self._evict_oldest_locked("cap"):
+                    break
+        if self.adopted_wires:
+            log.info("adopted %d orphaned spool wires (%d items; "
+                     "%d expired as orphan_age)", self.adopted_wires,
+                     self.adopted_items,
+                     self.expired_by_reason.get("orphan_age", 0))
 
     # -- intake --------------------------------------------------------
 
@@ -142,7 +234,7 @@ class WireSpool:
                     break
             entry = _Entry(dest, body, int(n_items), nbytes, now)
             if self.dir is not None:
-                path = self._write_segment(dest, body)
+                path = self._write_segment(dest, body, int(n_items))
                 if path is not None:
                     entry.path = path
                     entry.body = None
@@ -153,12 +245,22 @@ class WireSpool:
             self.queued_bytes += nbytes
             return True
 
-    def _write_segment(self, dest: str, body: bytes) -> str | None:
+    def _write_segment(self, dest: str, body: bytes,
+                       n_items: int) -> str | None:
         self._seq += 1
-        path = os.path.join(self.dir, _safe_dest(dest),
-                            f"{self._seq:012d}.wire")
+        ddir = os.path.join(self.dir, _safe_dest(dest))
+        path = os.path.join(
+            ddir, f"{self.incarnation:08d}-{self._seq:012d}-"
+            f"{n_items}.wire")
         try:
-            os.makedirs(os.path.dirname(path), exist_ok=True)
+            if not os.path.isdir(ddir):
+                os.makedirs(ddir, exist_ok=True)
+                # real destination string for an adopting successor
+                # (the directory name is sanitized, so it alone can't
+                # route a replay)
+                with open(os.path.join(ddir, _DEST_MARKER),
+                          "w") as f:
+                    f.write(dest)
             with open(path, "wb") as f:
                 f.write(body)
             return path
@@ -313,6 +415,9 @@ class WireSpool:
                 "queued_bytes": self.queued_bytes,
                 "inflight_wires": self.inflight_wires,
                 "inflight_items": self.inflight_items,
+                "adopted_wires": self.adopted_wires,
+                "adopted_items": self.adopted_items,
+                "incarnation": self.incarnation,
                 "max_bytes": self.max_bytes,
                 "max_age_s": self.max_age,
                 "disk": self.dir is not None,

@@ -150,6 +150,20 @@ class LedgerRecord:
     fanout_busy_drops: int = 0
     fanout_retries: int = 0
     fanout_timeouts: int = 0
+    # -- crash recovery: staged mass replayed from a prior
+    #    incarnation's checkpoint (re-ingested locally, or accepted on
+    #    the wire under the ``veneur-recovery`` flag).  The mass ALSO
+    #    credits the main ingest balance through a normal ``ingest``
+    #    call — this arm names how much of the interval's intake was
+    #    recovery and from which incarnation, and seal checks the
+    #    breakdown sums back to the total, so a recovered sample can
+    #    never shed its provenance
+    recovered: int = 0
+    recovered_by: dict[str, int] = field(default_factory=dict)
+    # -- scale-out arc handoff, receiving side (the receiver twin of
+    #    credit_reshard): items accepted under the handoff flag from
+    #    an incumbent global shipping arcs this node now owns
+    reshard_received_items: int = 0
     # -- verdict (filled by seal) --------------------------------------
     sealed: bool = False
     balanced: bool = True
@@ -159,6 +173,7 @@ class LedgerRecord:
     rows_owed: int = 0       # staged rows unaccounted for at flush
     split_owed: int = 0      # forwarded rows no destination accounts for
     shed_owed: int = 0       # shed samples missing tenant+reason
+    recovered_owed: int = 0  # recovered samples missing an incarnation
 
     def received_total(self) -> int:
         return sum(self.received.values())
@@ -209,7 +224,11 @@ class LedgerRecord:
             "reshard": {"epoch": self.reshard_epoch,
                         "added": list(self.reshard_added),
                         "removed": list(self.reshard_removed),
-                        "moved_rows": self.reshard_moved_rows},
+                        "moved_rows": self.reshard_moved_rows,
+                        "received_items": self.reshard_received_items},
+            "recovered": {"total": self.recovered,
+                          "by": dict(self.recovered_by),
+                          "owed": self.recovered_owed},
             "forward_wire": {"rows": self.forward_wire_rows,
                              "bytes": self.forward_wire_bytes,
                              "errors": self.forward_errors,
@@ -281,6 +300,35 @@ class Ledger:
             for key, n in breakdown.items():
                 if n:
                     cur.shed_by[key] = cur.shed_by.get(key, 0) + int(n)
+
+    def recover(self, source: str, items: int) -> None:
+        """Name ``items`` of the open interval's intake as crash
+        recovery from ``source`` (``incarnation:<id>``).  Pair with a
+        normal ``ingest`` credit in the same critical section — the
+        samples enter the main balance as received+staged mass like
+        any protocol's, and this arm records their provenance (seal
+        checks the breakdown sums back to the total)."""
+        with self._lock:
+            cur = self._cur
+            if items:
+                cur.recovered += int(items)
+                cur.recovered_by[source] = (
+                    cur.recovered_by.get(source, 0) + int(items))
+
+    def credit_reshard_received(self, items: int) -> None:
+        """Receiving side of a scale-out arc handoff: ``items``
+        accepted on the import wire under the handoff flag (they also
+        credit ``ingest`` normally — this names them as a rebalance
+        arrival, the twin of the sender's ``credit_reshard``)."""
+        with self._lock:
+            self._cur.reshard_received_items += int(items)
+
+    def open_to_dict(self) -> dict:
+        """Snapshot of the OPEN interval's record — what the
+        checkpointer stamps into a segment header so recovery can see
+        how much the dying interval had received."""
+        with self._lock:
+            return self._cur.to_dict()
 
     def note_coalesced(self) -> None:
         """The overrun watchdog skipped a flush tick: the open
@@ -429,11 +477,14 @@ class Ledger:
                     sum(rec.forward_split.values())
                     + rec.forward_spooled
                     + rec.forward_split_dropped)
+            rec.recovered_owed = rec.recovered - sum(
+                rec.recovered_by.values())
             rec.balanced = (rec.owed == 0 and rec.staged_drift == 0
                             and rec.overflow_drift == 0
                             and rec.rows_owed == 0
                             and rec.split_owed == 0
-                            and rec.shed_owed == 0)
+                            and rec.shed_owed == 0
+                            and rec.recovered_owed == 0)
             rec.sealed = True
             self._ring.append(rec)
             if not rec.balanced:
@@ -443,11 +494,12 @@ class Ledger:
                    "(received=%d staged=%d status=%d shed=%d "
                    "overflow=%d invalid=%d) staged_drift=%d "
                    "overflow_drift=%d rows_owed=%d split_owed=%d "
-                   "shed_owed=%d")
+                   "shed_owed=%d recovered_owed=%d")
             args = (self.node, rec.seq, rec.owed, rec.received_total(),
                     rec.staged, rec.status, rec.shed, rec.overflow,
                     rec.invalid, rec.staged_drift, rec.overflow_drift,
-                    rec.rows_owed, rec.split_owed, rec.shed_owed)
+                    rec.rows_owed, rec.split_owed, rec.shed_owed,
+                    rec.recovered_owed)
             if self.strict:
                 log.error(msg, *args)
             else:
@@ -521,6 +573,19 @@ class Ledger:
                 1 for r in recs if r.reshard_epoch)
             out["reshard_moved_rows_total"] = sum(
                 r.reshard_moved_rows for r in recs)
+        reshard_recv = sum(r.reshard_received_items for r in recs)
+        if reshard_recv:
+            out["reshard_received_items_total"] = reshard_recv
+        recovered = sum(r.recovered for r in recs)
+        if recovered or any(r.recovered_owed for r in recs):
+            by: dict[str, int] = {}
+            for r in recs:
+                for src, n in r.recovered_by.items():
+                    by[src] = by.get(src, 0) + n
+            out["recovered_total"] = recovered
+            out["recovered_by"] = by
+            out["recovered_owed_total"] = sum(
+                abs(r.recovered_owed) for r in recs)
         shed = sum(r.shed for r in recs)
         if shed or any(r.shed_owed for r in recs):
             by: dict[str, dict[str, int]] = {}
