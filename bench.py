@@ -2798,6 +2798,57 @@ def _chaos_model_soak(n_iters: int, rows_per_iter: int,
     }
 
 
+def _flight_summary(flight) -> dict:
+    """Settle the flight recorder's writer queue, then CRC-verify
+    every retained bundle through the same framing an offline replay
+    reads.  The chaos/overload gates assert per-leg that each
+    injected fault class produced a verifiable bundle naming the
+    right trigger, with the triggering interval's ledger record and
+    trace tree attached (server legs)."""
+    from veneur_tpu.observe.recorder import read_bundle
+    empty = {"bundles_total": 0, "by_trigger": {},
+             "suppressed_total": 0, "errors_total": 0,
+             "retained": 0, "crc_verified": 0,
+             "with_ledger_record": 0, "with_trace": 0}
+    if flight is None:
+        return empty
+    # drain() only waits for queue-empty; the writer may still be
+    # mid-_store on a popped item, so wait for quiescence: two reads
+    # 50ms apart with identical counters and an empty queue
+    flight.drain()
+    deadline = time.monotonic() + 5.0
+    st = flight.stats()
+    stable = None
+    while time.monotonic() < deadline:
+        snap = (st["bundles_total"], st["retained"],
+                st["errors_total"])
+        if snap == stable and flight._q.empty():
+            break
+        stable = snap
+        time.sleep(0.05)
+        st = flight.stats()
+    crc_ok = led_ok = trace_ok = 0
+    for meta in flight.list_bundles():
+        blob = flight.get(meta["name"])
+        parsed = read_bundle(blob) if blob is not None else None
+        if parsed is None:
+            continue
+        crc_ok += 1
+        ctx = parsed[1].get("context") or {}
+        if ctx.get("ledger_records"):
+            led_ok += 1
+        if ctx.get("trace"):
+            trace_ok += 1
+    return {"bundles_total": st["bundles_total"],
+            "by_trigger": st["by_trigger"],
+            "suppressed_total": st["suppressed_total"],
+            "errors_total": st["errors_total"],
+            "retained": st["retained"],
+            "crc_verified": crc_ok,
+            "with_ledger_record": led_ok,
+            "with_trace": trace_ok}
+
+
 def _chaos_e2e(n_histo: int, n_sets: int) -> dict:
     """Real-server half of ``--chaos``: one local Server forwarding
     sharded over loopback gRPC to two global Servers.  Proves, on the
@@ -2826,6 +2877,7 @@ def _chaos_e2e(n_histo: int, n_sets: int) -> dict:
         "forward_use_grpc": True,
         "tpu_sharded_global": True,
         "interval": "10s", "hostname": "chaos-l0",
+        "tpu_flight_cooldown": "0s",
         "accelerator_probe_timeout": "5s"}))
     l.start()
     rng = np.random.default_rng(23)
@@ -2895,6 +2947,11 @@ def _chaos_e2e(n_histo: int, n_sets: int) -> dict:
             out["reshard_intake_exact"]
             and l.stats.get("forward_errors", 0) == 0
             and l.stats.get("sharded_route_fallbacks", 0) == 0)
+        # the kill + reshard is the fault class; the flight recorder
+        # must have caught it off the post-reshard signal row
+        out["flight"] = _flight_summary(l.flight)
+        out["signal_rows"] = (l.signals.rows()
+                              if l.signals is not None else 0)
 
         # rolling restart: stage WITHOUT flushing, then shut the
         # local down — the drain handoff must carry the staged
@@ -2947,6 +3004,8 @@ def _chaos_recovery(n_iters: int = 18, rows_per_iter: int = 400,
     from veneur_tpu.forward.shard import ShardedForwarder
     from veneur_tpu.forward.spool import Spooled, WireSpool
     from veneur_tpu.observe.ledger import Ledger, SpoolLedger
+    from veneur_tpu.observe.recorder import FlightRecorder
+    from veneur_tpu.observe.signals import SignalHistory
     globals_ = [_ModelGlobal(0.0) for _ in range(2)]
     dead_port = globals_[1].port
     spool = WireSpool(max_bytes=8 * 1024 * 1024, max_age=120.0)
@@ -2956,6 +3015,20 @@ def _chaos_recovery(n_iters: int = 18, rows_per_iter: int = 400,
         breaker_threshold=2, breaker_cooldown=cooldown, spool=spool)
     led = Ledger(node="recovery")
     spool_led = SpoolLedger(node="recovery")
+    # this leg has no Server, so the signal plane is built by hand:
+    # one row per sealed interval, watched by the same trigger
+    # predicates the production flush hook evaluates
+    sig = SignalHistory(
+        ("breaker.opens_total", "breaker.open",
+         "spool.spooled_items", "spool.replayed_items",
+         "spool.queued_items"), capacity=256, node="recovery")
+    flight = FlightRecorder(
+        sig, cooldown=0.0, node="recovery",
+        context_fn=lambda _trig, _row: {
+            "ledger_records": ([led.last().to_dict()]
+                               if led.last() is not None else []),
+            "spool": spool.stats(),
+            "breakers": fwd.breaker_states()})
     wires = _cluster_wire_pool("rcvy", 2, rows_per_iter)
     attr_lock = threading.Lock()
     r = {"n_iters": n_iters, "rows_per_iter": rows_per_iter,
@@ -3025,6 +3098,21 @@ def _chaos_recovery(n_iters: int = 18, rows_per_iter: int = 400,
             replay_credited += delta
         spool_led.seal_snapshot(spool.stats(), seq=seq + 1)
         led.seal(rec)
+        _signal_tick(seq + 1)
+
+    def _signal_tick(seq: int) -> None:
+        st = spool.stats()
+        states = fwd.breaker_states()
+        row = {
+            "breaker.opens_total": fwd.totals()["breaker_opens"],
+            "breaker.open": sum(1 for s in states.values()
+                                if s["state"] == "open"),
+            "spool.spooled_items": st["spooled_items"],
+            "spool.replayed_items": fwd.replayed_items,
+            "spool.queued_items": st["queued_items"],
+        }
+        sig.append(row, seq=seq)
+        flight.observe(row, seq=seq)
 
     restarted = None
     try:
@@ -3059,11 +3147,15 @@ def _chaos_recovery(n_iters: int = 18, rows_per_iter: int = 400,
             replay_credited += delta
         spool_led.seal_snapshot(spool.stats(), seq=seq + 1)
         led.seal(rec)
+        _signal_tick(seq + 1)
         r["breaker_opens"] = fwd.totals()["breaker_opens"]
         r["replay_failures"] = fwd.replay_failures
         r["spool"] = spool.stats()
         r["spool_balance_owed"] = spool.check_balance()
+        r["flight"] = _flight_summary(flight)
+        r["signal_rows"] = sig.rows()
     finally:
+        flight.stop()
         fwd.stop()
         for g in globals_:
             g.stop()
@@ -3149,8 +3241,13 @@ def _chaos_crash(n_packets: int, ckpt_interval: float = 0.3) -> dict:
         "grpc_listen_addresses": ["tcp://127.0.0.1:0"],
         "statsd_listen_addresses": [],
         "interval": "30s", "hostname": "crash-g",
+        "tpu_flight_cooldown": "0s",
         "accelerator_probe_timeout": "5s"}), extra_sinks=[cap])
     g.start()
+    # baseline signal row BEFORE any child runs: the first appended
+    # row only seeds the flight recorder, so the recovery wires'
+    # counter increment needs a prior row to diff against
+    g.flush_once()
     fwd_addr = f"127.0.0.1:{g.grpc_ports[0]}"
 
     # the master's socket: bound once, adopted by every generation
@@ -3284,6 +3381,11 @@ def _chaos_crash(n_packets: int, ckpt_interval: float = 0.3) -> dict:
         led = g.ledger.summary()
         out["global_ledger"] = led
         out["recovered_total"] = led.get("recovered_total", 0)
+        # the SIGKILL's recovery replay must have tripped the flight
+        # recorder on the global's post-recovery signal row
+        out["flight"] = _flight_summary(g.flight)
+        out["signal_rows"] = (g.signals.rows()
+                              if g.signals is not None else 0)
     finally:
         for p in procs:
             if p.poll() is None:
@@ -3319,6 +3421,7 @@ def _chaos_scale_out(n_counters: int, n_histo: int,
             "grpc_listen_addresses": ["tcp://127.0.0.1:0"],
             "statsd_listen_addresses": [],
             "interval": "30s", "hostname": f"scale-g{gi}",
+            "tpu_flight_cooldown": "0s",
             "accelerator_probe_timeout": "5s"}),
             extra_sinks=[cap])
         g.start()
@@ -3334,6 +3437,9 @@ def _chaos_scale_out(n_counters: int, n_histo: int,
         for i in range(n_set_samples):
             g0.handle_packet(
                 f"scale.s.{i % 8}:u{i}|s".encode())
+        # receiver baseline row: g1 otherwise flushes exactly once,
+        # and the flight recorder's first row only seeds
+        g1.flush_once()
         ho = g0.arc_handoff(addrs, addrs[0])
         out["handoff"] = ho
         g1.flush_once()
@@ -3342,6 +3448,11 @@ def _chaos_scale_out(n_counters: int, n_histo: int,
         double = 0
         for cap in caps:
             for m in cap.metrics:
+                # conservation is over the handed-off keyspace only:
+                # self-telemetry re-emits per interval by design, and
+                # g1 now flushes twice (baseline row + post-handoff)
+                if not m.name.startswith("scale."):
+                    continue
                 key = (m.name, m.type)
                 if key in names:
                     double += 1
@@ -3372,6 +3483,11 @@ def _chaos_scale_out(n_counters: int, n_histo: int,
             and out["histo_medians_seen"] == n_histo
             and ho.get("errors", 1) == 0
             and ho.get("dropped_items", 1) == 0)
+        # the arc handoff must have tripped the receiver's flight
+        # recorder via the handoff.received_items increment
+        out["flight"] = _flight_summary(g1.flight)
+        out["signal_rows"] = (g1.signals.rows()
+                              if g1.signals is not None else 0)
     finally:
         for g in globals_:
             g.shutdown()
@@ -3470,6 +3586,41 @@ def chaos_bench() -> dict:
             so["sender_ledger_balanced"]
             and so["receiver_ledger_balanced"]),
     })
+    # flight-recorder gates (ISSUE 16): every injected fault class
+    # must have produced a CRC-verifiable bundle naming its trigger
+    legs = {"e2e": e2e, "recovery": rcv, "crash": crash,
+            "scaleout": so}
+    flights = {k: v.get("flight") or {} for k, v in legs.items()}
+    gates.update({
+        "flight_e2e_reshard": flights["e2e"].get(
+            "by_trigger", {}).get("reshard", 0) >= 1,
+        "flight_recovery_breaker_open": flights["recovery"].get(
+            "by_trigger", {}).get("breaker_open", 0) >= 1,
+        "flight_recovery_replay": flights["recovery"].get(
+            "by_trigger", {}).get("recovery_replay", 0) >= 1,
+        "flight_crash_recovery_replay": flights["crash"].get(
+            "by_trigger", {}).get("recovery_replay", 0) >= 1,
+        "flight_scaleout_handoff": flights["scaleout"].get(
+            "by_trigger", {}).get("handoff", 0) >= 1,
+        # every retained bundle must read back CRC-clean, and every
+        # bundle dumped by a real Server must carry the triggering
+        # interval's sealed ledger record + trace tree
+        "flight_bundles_crc_verified": all(
+            f.get("crc_verified", 0) == f.get("retained", -1)
+            and f.get("retained", 0) >= 1
+            for f in flights.values()),
+        "flight_context_attached": all(
+            flights[k].get("with_ledger_record", 0)
+            == flights[k].get("retained", -1)
+            and flights[k].get("with_trace", 0) >= 1
+            for k in ("e2e", "crash", "scaleout")),
+        "flight_dumps_clean": all(
+            f.get("errors_total", 1) == 0 for f in flights.values()),
+    })
+    out["flight_bundles"] = sum(
+        f.get("bundles_total", 0) for f in flights.values())
+    out["signal_rows"] = sum(
+        v.get("signal_rows", 0) for v in legs.values())
     out["chaos_gates"] = gates
     out["chaos_pass"] = all(gates.values())
     out.update(_backend_info())
@@ -3509,6 +3660,9 @@ def overload_bench() -> dict:
         # post-flush tick engages pressure for phase B
         "tpu_overload_occupancy_hi": 0.05,
         "tpu_gauge_rows": 4096,
+        # every trigger hit must dump: the soak asserts one bundle
+        # per injected fault class, not one per cooldown window
+        "tpu_flight_cooldown": "0s",
     }))
     parser = columnar.ColumnarParser()
     if not parser.available:
@@ -3538,6 +3692,12 @@ def overload_bench() -> dict:
                  "offered_noncounter": n_offered,
                  "offered_counters": 0, "tenants": tenants,
                  "native_parser": parser is not None}
+
+    # idle baseline signal row: pressure engages DURING the phase A
+    # flush (tick runs before the seal-time sample), so without this
+    # row the engage would land on the flight recorder's seed row
+    # and the pressure_change trigger would never see the transition
+    flush()
 
     # ---- phase A: tenant budgets vs >= 2x offered load --------------
     z = np.minimum(rng.zipf(1.5, size=n_offered), tenants)
@@ -3618,6 +3778,10 @@ def overload_bench() -> dict:
 
     ledsum = srv.ledger.summary()
     ovl_snap = srv.overload.snapshot()
+    out["flight"] = _flight_summary(srv.flight)
+    out["flight_bundles"] = out["flight"]["bundles_total"]
+    out["signal_rows"] = (srv.signals.rows()
+                          if srv.signals is not None else 0)
     srv.shutdown()
 
     shed_by = ledsum.get("shed_by", {})
@@ -3660,6 +3824,16 @@ def overload_bench() -> dict:
             out["phase_c"]["flush_overruns"] >= 1,
         "coalesce_named_in_ledger": rec_cover.coalesced >= 1,
         "coalesced_tick_counted": coalesce_skipped >= 1,
+        # flight-recorder gates (ISSUE 16): both injected fault
+        # classes dumped a CRC-verifiable bundle naming the trigger
+        "flight_pressure_change": out["flight"].get(
+            "by_trigger", {}).get("pressure_change", 0) >= 1,
+        "flight_flush_overrun": out["flight"].get(
+            "by_trigger", {}).get("flush_overrun", 0) >= 1,
+        "flight_bundles_crc_verified": (
+            out["flight"].get("crc_verified", 0)
+            == out["flight"].get("retained", -1)
+            and out["flight"].get("retained", 0) >= 1),
     }
     out["overload_gates"] = gates
     out["overload_pass"] = all(gates.values())
@@ -3849,6 +4023,11 @@ def _summary_line(out: dict) -> str:
             "shed_total")
         line["overload_unattributed_lost"] = out.get(
             "unattributed_lost")
+    # signal-plane verdict: the chaos/overload soaks carry the flight
+    # recorder's coverage so the one-line record names it too
+    if out.get("flight_bundles") is not None:
+        line["flight_bundles"] = out["flight_bundles"]
+        line["signal_rows"] = out.get("signal_rows")
     return json.dumps(line, separators=(",", ":"))
 
 
@@ -3968,6 +4147,8 @@ if __name__ == "__main__":
         print(json.dumps(out))
         print(json.dumps({"chaos_summary": True,
                           "chaos_pass": out.get("chaos_pass"),
+                          "flight_bundles": out.get("flight_bundles"),
+                          "signal_rows": out.get("signal_rows"),
                           "gates": out.get("chaos_gates")},
                          separators=(",", ":")))
     elif "--overload" in sys.argv:
@@ -3979,6 +4160,8 @@ if __name__ == "__main__":
                               "shed_total"),
                           "unattributed_lost": out.get(
                               "unattributed_lost"),
+                          "flight_bundles": out.get("flight_bundles"),
+                          "signal_rows": out.get("signal_rows"),
                           "gates": out.get("overload_gates")},
                          separators=(",", ":")))
     elif "--config" in sys.argv:

@@ -98,14 +98,19 @@ def dsn_server():
 # worker thread-leak guard (ISSUE 12): destination-pool and sink-fanout
 # workers are named ("proxy-dest-<dest>" / "sink-flush-<name>") so a
 # pool whose close()/retire()/stop() forgets to join is a visible test
-# failure here, not a slow accumulation across the suite
+# failure here, not a slow accumulation across the suite.  ISSUE 16
+# extends it to the flight recorder's dump writer ("flight-dump-*",
+# joined by FlightRecorder.stop()) and vtop's per-round scraper
+# threads ("vtop-scrape-*", joined every scrape round).
 
-_WORKER_PREFIXES = ("proxy-dest-", "sink-flush-")
+_WORKER_PREFIXES = ("proxy-dest-", "sink-flush-", "flight-dump-",
+                    "vtop-scrape-")
 
 _GUARDED_MODULES = ("test_breaker", "test_spool", "test_retry_budget",
                     "test_proxy_columnar", "test_sink_fanout",
                     "test_sharded_forward", "test_drain_handoff",
-                    "test_live_reshard")
+                    "test_live_reshard", "test_flight", "test_vtop",
+                    "test_signals")
 
 
 def _worker_threads():

@@ -696,3 +696,76 @@ def test_summary_line_overload_fields():
     nd = json.loads(nline)
     assert "overload_pass" not in nd
     assert "overload_shed_total" not in nd
+
+
+def _committed_artifact(name):
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench_results", name)
+    with open(path) as f:
+        return json.load(f)
+
+
+def test_chaos_soak_flight_recorder_coverage():
+    """ISSUE 16: every injected fault class in the committed chaos
+    artifact left a CRC-verified flight bundle naming its trigger —
+    reshard (e2e kill), breaker_open + recovery_replay (outage ride),
+    recovery_replay (crash checkpoint replay), handoff (scale-out) —
+    and every bundle a real Server dumped carries the triggering
+    interval's sealed ledger record and trace tree."""
+    d = _committed_artifact("chaos_soak.json")
+    expect = {"e2e": "reshard", "recovery": "breaker_open",
+              "crash": "recovery_replay", "scale_out": "handoff"}
+    for leg, trig in expect.items():
+        f = d[leg]["flight"]
+        assert f["by_trigger"].get(trig, 0) >= 1, (leg, trig)
+        assert f["retained"] >= 1, leg
+        assert f["crc_verified"] == f["retained"], leg
+        assert f["errors_total"] == 0, leg
+        assert d[leg]["signal_rows"] >= 2, leg
+    # the outage ride fires BOTH its triggers: breaker trip on the
+    # kill, recovery_replay when the spool drains through
+    assert d["recovery"]["flight"]["by_trigger"].get(
+        "recovery_replay", 0) >= 1
+    # server-dumped bundles carry the incident context
+    for leg in ("e2e", "crash", "scale_out"):
+        f = d[leg]["flight"]
+        assert f["with_ledger_record"] == f["retained"], leg
+        assert f["with_trace"] >= 1, leg
+    assert d["flight_bundles"] == sum(
+        d[leg]["flight"]["bundles_total"] for leg in expect) > 0
+    assert d["signal_rows"] == sum(
+        d[leg]["signal_rows"] for leg in expect) > 0
+
+
+def test_overload_soak_flight_recorder_coverage():
+    """ISSUE 16: the committed overload artifact shows the flight
+    recorder catching both injected fault classes — the pressure
+    engage between phases A and B and the phase C flush overrun —
+    with every retained bundle CRC-clean and context-bearing."""
+    d = _committed_artifact("overload_soak.json")
+    f = d["flight"]
+    assert f["by_trigger"].get("pressure_change", 0) >= 1
+    assert f["by_trigger"].get("flush_overrun", 0) >= 1
+    assert f["retained"] >= 2
+    assert f["crc_verified"] == f["retained"]
+    assert f["with_ledger_record"] == f["retained"]
+    assert f["errors_total"] == 0
+    assert d["flight_bundles"] == f["bundles_total"] >= 2
+    assert d["signal_rows"] >= 5
+
+
+def test_summary_line_flight_fields():
+    """The chaos/overload summary lines carry the signal-plane
+    verdict; the normal bench line never grows the fields."""
+    m = _bench_module()
+    line = m._summary_line({"platform": "cpu",
+                            "flight_bundles": 8,
+                            "signal_rows": 26})
+    assert len(line) < 1024
+    d = json.loads(line)
+    assert d["flight_bundles"] == 8
+    assert d["signal_rows"] == 26
+    nd = json.loads(m._summary_line({"platform": "cpu"}))
+    assert "flight_bundles" not in nd
+    assert "signal_rows" not in nd

@@ -133,6 +133,43 @@ def test_debug_flushes_empty_then_populated(server):
     assert rec["duration_ns"] > 0
 
 
+def test_debug_flushes_n_param(server):
+    """?n= bounds /debug/flushes to the newest N records (fleet
+    scrapers must not pull 128 full records per poll); default stays
+    the full ring."""
+    for i in range(3):
+        server.handle_packet(b"dbg.hits:1|c")
+        server.flush_once()
+    full = json.loads(_get(server, "/debug/flushes").read())
+    assert len(full) == 3
+    bounded = json.loads(_get(server, "/debug/flushes?n=2").read())
+    assert len(bounded) == 2
+    # newest-last, and the tail of the full dump
+    assert [r["seq"] for r in bounded] == \
+        [r["seq"] for r in full[-2:]]
+    # a bogus n falls back to the full ring, never a 500
+    assert len(json.loads(
+        _get(server, "/debug/flushes?n=bogus").read())) == 3
+
+
+def test_debug_ledger_n_param(server):
+    """?n= bounds the /debug/ledger record dump; the imbalanced-seq
+    index still covers the WHOLE ring so truncation can't hide an old
+    imbalance."""
+    for i in range(3):
+        server.handle_packet(b"dbg.hits:1|c")
+        server.flush_once()
+    full = json.loads(_get(server, "/debug/ledger").read())
+    assert full["intervals"] == 3
+    assert full["returned"] == 3
+    bounded = json.loads(_get(server, "/debug/ledger?n=1").read())
+    assert bounded["intervals"] == 3
+    assert bounded["returned"] == 1
+    assert len(bounded["records"]) == 1
+    assert bounded["records"][0]["seq"] == \
+        full["records"][-1]["seq"]
+
+
 def test_proxy_debug_surface():
     """The proxy's listener serves the same debughttp handlers
     (reference proxy.go:533-538 wires pprof + identity onto the proxy

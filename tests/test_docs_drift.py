@@ -295,3 +295,81 @@ def test_operations_runbook_covers_crash_riding():
             "chaos_soak.json",
     ):
         assert needle in ops, needle
+
+
+def test_signal_history_metrics_documented():
+    """ISSUE 16 names, pinned explicitly: the signal-history plane's
+    row counter and the flight recorder's bundle accounting."""
+    for name in (
+            "veneur.signals.rows_total",
+            "veneur.flight.bundles_total",
+            "veneur.flight.suppressed_total",
+            "veneur.flight.errors_total",
+    ):
+        assert name in DOCS, name
+        assert any(name in (ROOT / m).read_text() for m in SCANNED), \
+            name
+
+
+def test_signal_history_env_vars_documented():
+    """ISSUE 16 knobs: history depth, flight dir/cooldown/caps, and
+    the cluster peer list must appear in the README env table AND in
+    docs/observability.md."""
+    readme = (ROOT / "README.md").read_text()
+    for var in ("VENEUR_TPU_SIGNAL_HISTORY",
+                "VENEUR_TPU_FLIGHT_DIR",
+                "VENEUR_TPU_FLIGHT_COOLDOWN",
+                "VENEUR_TPU_FLIGHT_MAX_BUNDLES",
+                "VENEUR_TPU_FLIGHT_MAX_BYTES",
+                "VENEUR_TPU_CLUSTER_PEERS"):
+        assert var in readme, var
+        assert var in DOCS, var
+
+
+def test_observability_doc_covers_signal_plane():
+    """The 'Signal history & flight recorder' section: row schema
+    groups, every trigger name, and the offline reader."""
+    from veneur_tpu.observe.recorder import TRIGGER_NAMES
+    assert "Signal history & flight recorder" in DOCS
+    for needle in TRIGGER_NAMES:
+        assert needle in DOCS, needle
+    for needle in ("read_bundle", "vtop", "?summary=1",
+                   "flight-dump-"):
+        assert needle in DOCS, needle
+
+
+def test_debug_endpoint_inventory_documented():
+    """Every /debug/* route the server or proxy can serve must appear
+    in docs/observability.md — the inventory is scanned from the
+    debughttp endpoint tuples AND from raw route literals in
+    server.py/proxy.py, so a new endpoint wired in either place
+    without docs fails here with its path in the message."""
+    from veneur_tpu.core import debughttp
+    route_re = re.compile(r"/debug/[a-z_]+")
+    routes = set(debughttp.SERVER_DEBUG_ENDPOINTS)
+    routes |= set(debughttp.PROXY_DEBUG_ENDPOINTS)
+    for mod in ("veneur_tpu/core/debughttp.py",
+                "veneur_tpu/core/server.py",
+                "veneur_tpu/core/proxy.py"):
+        routes |= set(route_re.findall((ROOT / mod).read_text()))
+    missing = sorted(r for r in routes if r not in DOCS)
+    assert not missing, (
+        f"/debug routes missing from docs/observability.md: {missing}")
+
+
+def test_debug_endpoint_tuples_match_served_routes():
+    """The debughttp inventory tuples are the machine-readable route
+    list (vtop and the docs pin lean on them) — they must name every
+    literal actually routed in the handlers."""
+    from veneur_tpu.core import debughttp
+    route_re = re.compile(r'"(/debug/[a-z_]+)')
+    served = set(route_re.findall(
+        (ROOT / "veneur_tpu/core/server.py").read_text()))
+    for r in served:
+        assert any(r.startswith(e)
+                   for e in debughttp.SERVER_DEBUG_ENDPOINTS), r
+    served_p = set(route_re.findall(
+        (ROOT / "veneur_tpu/core/proxy.py").read_text()))
+    for r in served_p:
+        assert any(r.startswith(e)
+                   for e in debughttp.PROXY_DEBUG_ENDPOINTS), r

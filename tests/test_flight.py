@@ -1,0 +1,324 @@
+"""Anomaly flight recorder (observe/recorder.py): trigger predicates
+over appended signal rows, per-trigger cooldown, CRC-framed bundles
+readable offline, count+bytes evict-oldest retention, disk adoption,
+and the /debug/flight surface on a live server."""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+from veneur_tpu.core.config import read_config
+from veneur_tpu.observe.recorder import (
+    TRIGGER_NAMES, FlightRecorder, frame_bundle, read_bundle)
+from veneur_tpu.observe.signals import SignalHistory
+
+
+def _recorder(tmp_path=None, **kw):
+    h = SignalHistory(("x",), capacity=8)
+    kw.setdefault("cooldown", 0.0)
+    kw.setdefault("node", "flt")
+    return FlightRecorder(
+        h, directory=str(tmp_path) if tmp_path else "", **kw)
+
+
+# ----------------------------------------------------------------------
+# trigger predicates
+
+
+# for each trigger: a (prev, cur) row pair that must fire exactly it
+_TRIGGER_CASES = {
+    "ledger_imbalance": ({"ledger.imbalanced_total": 0},
+                         {"ledger.imbalanced_total": 1}),
+    "breaker_open": ({"breaker.opens_total": 2},
+                     {"breaker.opens_total": 3}),
+    "pressure_change": ({"pressure.level": 0},
+                        {"pressure.level": 2}),
+    "flush_overrun": ({"flush.overruns": 0},
+                      {"flush.overruns": 1}),
+    "recovery_replay": ({"spool.replayed_items": 10},
+                        {"spool.replayed_items": 25}),
+    "reshard": ({"reshard.epoch": 1}, {"reshard.epoch": 2}),
+    "handoff": ({"handoff.shipped_items": 0},
+                {"handoff.shipped_items": 40}),
+}
+
+
+def test_every_trigger_has_a_case():
+    assert set(_TRIGGER_CASES) == set(TRIGGER_NAMES)
+
+
+@pytest.mark.parametrize("trigger", TRIGGER_NAMES)
+def test_trigger_fires_exactly_once(trigger):
+    prev, cur = _TRIGGER_CASES[trigger]
+    rec = _recorder()
+    assert rec.observe(prev) == []  # first row seeds the baseline
+    assert rec.observe(cur) == [trigger]
+    rec.drain()
+    rec.stop()
+    assert rec.by_trigger() == {trigger: 1}
+    bundles = rec.list_bundles()
+    assert len(bundles) == 1
+    assert bundles[0]["trigger"] == trigger
+    assert trigger in bundles[0]["name"]
+
+
+def test_counter_decrease_or_steady_does_not_fire():
+    rec = _recorder()
+    rec.observe({"ledger.imbalanced_total": 5,
+                 "handoff.shipped_items": 9})
+    # steady and decreasing counters are not anomalies (a restart
+    # resets counters; the fresh incarnation starts a fresh baseline)
+    assert rec.observe({"ledger.imbalanced_total": 5,
+                        "handoff.shipped_items": 3}) == []
+    rec.stop()
+
+
+def test_cooldown_suppresses_then_reopens():
+    rec = _recorder(cooldown=3600.0)
+    rec.observe({"flush.overruns": 0})
+    assert rec.observe({"flush.overruns": 1}) == ["flush_overrun"]
+    assert rec.observe({"flush.overruns": 2}) == []  # in cooldown
+    assert rec.stats()["suppressed_total"] == 1
+    # cooldown is per trigger: a different trigger still fires
+    assert rec.observe({"flush.overruns": 2,
+                        "reshard.epoch": 1}) == ["reshard"]
+    rec.drain()
+    rec.stop()
+    assert rec.bundles_total == 2
+
+
+def test_zero_cooldown_fires_every_row():
+    rec = _recorder(cooldown=0.0)
+    rec.observe({"flush.overruns": 0})
+    for i in range(1, 4):
+        assert rec.observe({"flush.overruns": i}) == \
+            ["flush_overrun"]
+    rec.drain()
+    rec.stop()
+    assert rec.bundles_total == 3
+    assert rec.stats()["suppressed_total"] == 0
+
+
+# ----------------------------------------------------------------------
+# framing: CRC round trip, torn/corrupt rejection
+
+
+def test_frame_and_read_bundle_roundtrip(tmp_path):
+    body = json.dumps({"k": [1, 2, 3]}).encode()
+    blob = frame_bundle({"trigger": "reshard", "seq": 7}, body)
+    header, payload = read_bundle(blob)
+    assert header["trigger"] == "reshard"
+    assert header["body_bytes"] == len(body)
+    assert payload == {"k": [1, 2, 3]}
+    # and via a file path — the offline replay entrypoint
+    p = tmp_path / "one.bundle"
+    p.write_bytes(blob)
+    header2, payload2 = read_bundle(str(p))
+    assert (header2, payload2) == (header, payload)
+
+
+def test_read_bundle_rejects_torn_and_corrupt(tmp_path):
+    blob = frame_bundle({"trigger": "handoff"}, b'{"a": 1}')
+    assert read_bundle(b"not a bundle") is None
+    assert read_bundle(blob[:-3]) is None            # torn tail
+    corrupt = blob[:-2] + b"XX"                      # flipped bytes
+    assert read_bundle(corrupt) is None
+    assert read_bundle(str(tmp_path / "missing")) is None
+
+
+def test_bundle_payload_carries_history_window():
+    h = SignalHistory(("flush.overruns",), capacity=8)
+    rec = FlightRecorder(h, cooldown=0.0, last_k=2, node="n1")
+    for i, v in enumerate([0, 0, 1]):
+        h.append({"flush.overruns": v}, t=100.0 + i, seq=i)
+        rec.observe({"flush.overruns": v}, t=100.0 + i, seq=i)
+    rec.drain()
+    rec.stop()
+    name = rec.list_bundles()[0]["name"]
+    header, payload = read_bundle(rec.get(name))
+    assert header["node"] == "n1"
+    assert payload["trigger"] == "flush_overrun"
+    assert payload["seq"] == 2
+    assert payload["row"]["flush.overruns"] == 1
+    # last K rows, not the whole ring
+    hist = payload["history"]
+    assert hist["rows"] == 2
+    assert hist["signals"]["flush.overruns"]["v"] == [0, 1]
+
+
+def test_context_fn_failure_is_captured_not_fatal():
+    def boom(trigger, row):
+        raise RuntimeError("snapshot failed")
+    h = SignalHistory(("reshard.epoch",), capacity=4)
+    rec = FlightRecorder(h, context_fn=boom, cooldown=0.0)
+    rec.observe({"reshard.epoch": 1})
+    assert rec.observe({"reshard.epoch": 2}) == ["reshard"]
+    rec.drain()
+    rec.stop()
+    _, payload = read_bundle(rec.get(rec.list_bundles()[0]["name"]))
+    assert "RuntimeError" in payload["context"]["error"]
+
+
+# ----------------------------------------------------------------------
+# retention: evict-oldest by count and by bytes, disk + memory
+
+
+def test_evict_oldest_by_count(tmp_path):
+    rec = _recorder(tmp_path, max_bundles=3)
+    rec.observe({"flush.overruns": 0})
+    for i in range(1, 6):
+        rec.observe({"flush.overruns": i}, seq=i)
+        rec.drain()
+    rec.stop()
+    bundles = rec.list_bundles()
+    assert len(bundles) == 3
+    assert [b["seq"] for b in bundles] == [3, 4, 5]
+    # disk matches the index: evicted files are gone
+    on_disk = sorted(n for n in os.listdir(tmp_path)
+                     if n.endswith(".bundle"))
+    assert on_disk == sorted(b["name"] for b in bundles)
+    assert rec.bundles_total == 5  # counter is lifetime, not retained
+
+
+def test_evict_oldest_by_bytes():
+    h = SignalHistory(("flush.overruns",), capacity=8)
+    rec = FlightRecorder(h, cooldown=0.0, max_bytes=4096,
+                         context_fn=lambda t, r: {"pad": "x" * 2000})
+    rec.observe({"flush.overruns": 0})
+    for i in range(1, 5):
+        rec.observe({"flush.overruns": i}, seq=i)
+        rec.drain()
+    rec.stop()
+    st = rec.stats()
+    assert st["retained_bytes"] <= 4096
+    assert st["retained"] < st["bundles_total"]
+
+
+def test_disk_adoption_across_incarnations(tmp_path):
+    r1 = _recorder(tmp_path)
+    r1.observe({"reshard.epoch": 1})
+    r1.observe({"reshard.epoch": 2}, seq=9)
+    r1.drain()
+    r1.stop()
+    names = [b["name"] for b in r1.list_bundles()]
+    assert len(names) == 1
+    # a torn file in the dir must be skipped, not adopted
+    (tmp_path / "flt-0000000000000-000000-junk.bundle").write_bytes(
+        b"VTPUFLT1\ntorn")
+    r2 = _recorder(tmp_path)
+    adopted = r2.list_bundles()
+    assert [b["name"] for b in adopted] == names
+    assert adopted[0]["trigger"] == "reshard"
+    assert r2.get(names[0]) is not None
+    assert read_bundle(r2.get(names[0])) is not None
+    r2.stop()
+
+
+def test_get_rejects_path_traversal(tmp_path):
+    rec = _recorder(tmp_path)
+    assert rec.get("../../../etc/passwd") is None
+    assert rec.get("sub/dir.bundle") is None
+    rec.stop()
+
+
+def test_wedged_queue_counts_errors_not_backlog():
+    rec = _recorder()
+    rec._q.maxsize = 1
+    rec.observe({"flush.overruns": 0})
+    # saturate: the bounded queue drops dumps, never blocks the
+    # flush thread or grows without bound
+    for i in range(1, 50):
+        rec.observe({"flush.overruns": i})
+    rec.drain()
+    rec.stop()
+    st = rec.stats()
+    assert st["bundles_total"] + st["errors_total"] == 49
+
+
+# ----------------------------------------------------------------------
+# live server: /debug/flight listing + fetch, end to end
+
+
+@pytest.fixture
+def server():
+    from veneur_tpu.core.server import Server
+    srv = Server(read_config(data={
+        "statsd_listen_addresses": [], "interval": "10s",
+        "hostname": "flt", "http_address": "127.0.0.1:0",
+        "tpu_flight_cooldown": "0s"}))
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+def _get(server, path):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{server.http_port}{path}", timeout=10)
+
+
+def test_debug_flight_end_to_end(server):
+    server.handle_packet(b"flt.a:1|c")
+    server.flush_once()  # baseline row
+    # an anomaly between flushes: handoff mass arrives
+    server.bump("handoff_items_received", 7)
+    server.flush_once()
+    server.flight.drain()
+    out = json.loads(_get(server, "/debug/flight").read())
+    assert out["stats"]["bundles_total"] >= 1
+    assert out["stats"]["by_trigger"].get("handoff") == 1
+    byname = {b["trigger"]: b["name"] for b in out["bundles"]}
+    blob = _get(server,
+                f"/debug/flight/{byname['handoff']}").read()
+    parsed = read_bundle(blob)
+    assert parsed is not None, "fetched bundle failed CRC"
+    header, payload = parsed
+    assert header["trigger"] == "handoff"
+    assert payload["node"] == "flt"
+    assert payload["row"]["handoff.received_items"] == 7
+    # incident context: the triggering interval's sealed ledger
+    # record, its flush record + trace tree, live snapshots
+    ctx = payload["context"]
+    led = ctx["ledger_records"][-1]
+    assert led["balanced"] and led["seq"] == 2
+    assert ctx["flush_record"]["seq"] == 2
+    assert ctx["trace"], "trace tree missing from bundle"
+    assert all(sp["trace_id"] == str(ctx["flush_record"]["trace_id"])
+               for sp in ctx["trace"])
+    assert "spool_ledger" in ctx and "overload" in ctx
+    # stats surface in /debug/vars too
+    dv = json.loads(_get(server, "/debug/vars").read())
+    assert dv["flight"]["bundles_total"] >= 1
+    assert dv["stats"]["signal_rows"] == 2
+
+
+def test_debug_flight_unknown_bundle_404(server):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(server, "/debug/flight/no-such.bundle")
+    assert ei.value.code == 404
+
+
+def test_flight_writer_thread_joined_on_shutdown():
+    """The flight-dump-* writer must not outlive shutdown() — the
+    conftest leak guard watches this module's threads."""
+    import threading
+    from veneur_tpu.core.server import Server
+    srv = Server(read_config(data={
+        "statsd_listen_addresses": [], "interval": "10s",
+        "hostname": "fltj", "http_address": "127.0.0.1:0",
+        "tpu_flight_cooldown": "0s"}))
+    srv.start()
+    srv.handle_packet(b"flt.a:1|c")
+    srv.flush_once()
+    srv.bump("handoff_items_received", 3)
+    srv.flush_once()
+    srv.flight.drain()
+    assert any(t.name.startswith("flight-dump-")
+               for t in threading.enumerate())
+    srv.shutdown()
+    assert not any(t.name.startswith("flight-dump-")
+                   for t in threading.enumerate())

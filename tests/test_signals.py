@@ -1,0 +1,311 @@
+"""Signal history plane (observe/signals.py): the fixed-schema
+columnar ring with at-append EWMA rate + delta columns, its
+/debug/signals surface on server AND proxy, and re-seeding (empty,
+not crashed) across a checkpoint recovery."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+from veneur_tpu.core.config import read_config
+from veneur_tpu.observe.signals import SignalHistory
+
+
+# ----------------------------------------------------------------------
+# ring unit behavior
+
+
+def test_schema_is_fixed_and_unknown_names_ignored():
+    h = SignalHistory(("a", "b"), capacity=4)
+    h.append({"a": 1, "b": 2, "zzz": 99}, t=100.0, seq=1)
+    w = h.window()
+    assert set(w["signals"]) == {"a", "b"}
+    assert w["signals"]["a"]["v"] == [1]
+    # a schema name missing from a row renders null, never a crash
+    h.append({"a": 2}, t=101.0, seq=2)
+    assert h.window()["signals"]["b"]["v"] == [2, None]
+
+
+def test_delta_and_ewma_rate_at_append():
+    h = SignalHistory(("c",), capacity=8, alpha=0.5)
+    h.append({"c": 100}, t=10.0, seq=1)
+    w = h.window()
+    # first row: no baseline, delta 0, rate 0
+    assert w["signals"]["c"]["d"] == [0]
+    assert w["signals"]["c"]["r"] == [0]
+    h.append({"c": 150}, t=20.0, seq=2)  # +50 over 10s = 5/s
+    w = h.window()
+    assert w["signals"]["c"]["d"][-1] == 50
+    # EWMA with alpha=0.5 from 0: 0.5*5 = 2.5
+    assert w["signals"]["c"]["r"][-1] == pytest.approx(2.5)
+    h.append({"c": 250}, t=30.0, seq=3)  # +100 over 10s = 10/s
+    w = h.window()
+    assert w["signals"]["c"]["r"][-1] == pytest.approx(
+        0.5 * 10 + 0.5 * 2.5)
+
+
+def test_ring_wraps_and_keeps_newest():
+    h = SignalHistory(("x",), capacity=4)
+    for i in range(10):
+        h.append({"x": i}, t=float(i), seq=i)
+    assert h.rows() == 4
+    assert h.appended_total == 10
+    w = h.window()
+    assert w["signals"]["x"]["v"] == [6, 7, 8, 9]
+    assert w["seq"] == [6, 7, 8, 9]
+    # deltas survive the wrap (computed against the true previous
+    # row, not the evicted slot)
+    assert w["signals"]["x"]["d"] == [1, 1, 1, 1]
+
+
+def test_window_seconds_and_limit():
+    import time
+    h = SignalHistory(("x",), capacity=16)
+    now = time.time()
+    for i in range(6):
+        h.append({"x": i}, t=now - 50 + i * 10, seq=i)
+    w = h.window(seconds=25.0)
+    assert len(w["signals"]["x"]["v"]) <= 3
+    assert w["signals"]["x"]["v"][-1] == 5
+    w = h.window(limit=2)
+    assert w["signals"]["x"]["v"] == [4, 5]
+
+
+def test_summary_shape_before_and_after_rows():
+    h = SignalHistory(("x", "y"), capacity=4, node="n0", role="local")
+    s = h.summary()
+    assert s["rows"] == 0 and s["signals"] == {} and s["seq"] is None
+    h.append({"x": 1, "y": 2.5}, t=100.0, seq=7)
+    s = h.summary()
+    assert s["node"] == "n0" and s["role"] == "local"
+    assert s["seq"] == 7
+    assert s["signals"] == {"x": 1, "y": 2.5}
+    assert set(s["rates"]) == {"x", "y"}
+
+
+def test_non_finite_values_render_null():
+    h = SignalHistory(("x",), capacity=4)
+    h.append({"x": float("nan")}, t=1.0, seq=1)
+    h.append({"x": float("inf")}, t=2.0, seq=2)
+    w = json.loads(h.to_json().decode())
+    assert w["signals"]["x"]["v"] == [None, None]
+
+
+def test_concurrent_appends_no_tear():
+    """4 writer threads appending while a reader snapshots: every
+    window() is internally consistent (equal column lengths, rows
+    matches) and nothing tears."""
+    import threading
+    h = SignalHistory(("a", "b"), capacity=64)
+    stop = threading.Event()
+    errors = []
+
+    def writer(tid):
+        i = 0
+        while not stop.is_set():
+            h.append({"a": i, "b": i * 2}, seq=tid * 100000 + i)
+            i += 1
+
+    def reader():
+        while not stop.is_set():
+            w = h.window()
+            try:
+                n = w["rows"]
+                for col in w["signals"].values():
+                    assert len(col["v"]) == n
+                    assert len(col["d"]) == n
+                    assert len(col["r"]) == n
+                assert len(w["unix"]) == n and len(w["seq"]) == n
+            except AssertionError as e:
+                errors.append(e)
+                return
+
+    ts = [threading.Thread(target=writer, args=(t,))
+          for t in range(4)] + [threading.Thread(target=reader)]
+    for t in ts:
+        t.start()
+    import time
+    time.sleep(0.4)
+    stop.set()
+    for t in ts:
+        t.join(5.0)
+    assert not errors
+    assert h.rows() == 64
+
+
+# ----------------------------------------------------------------------
+# server integration: one row per flush seal, >= 30 named signals
+
+
+@pytest.fixture
+def server():
+    from veneur_tpu.core.server import Server
+    srv = Server(read_config(data={
+        "statsd_listen_addresses": [], "interval": "10s",
+        "hostname": "sig", "http_address": "127.0.0.1:0"}))
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+def _get(server, path):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{server.http_port}{path}", timeout=10)
+
+
+def test_server_samples_a_row_per_flush_seal(server):
+    assert server.signals.rows() == 0
+    server.handle_packet(b"sig.a:1|c")
+    server.flush_once()
+    server.flush_once()
+    assert server.signals.rows() == 2
+    row = server.signals.latest()
+    assert row["ingest.metrics_processed"] == 1
+    assert row["flush.count"] == 2
+    assert row["ledger.balanced"] == 1
+
+
+def test_debug_signals_thirty_plus_named_signals(server):
+    """Acceptance pin: /debug/signals returns >= 30 distinct named
+    signals per row on a live server, each with value/delta/EWMA-rate
+    columns of equal length."""
+    server.handle_packet(b"sig.a:1|c")
+    server.flush_once()
+    server.handle_packet(b"sig.a:3|c")
+    server.flush_once()
+    out = json.loads(_get(server, "/debug/signals").read())
+    assert out["rows"] == 2
+    assert len(out["signals"]) >= 30
+    assert len(set(out["signals"])) == len(out["signals"])
+    for name, col in out["signals"].items():
+        assert set(col) == {"v", "d", "r"}, name
+        assert len(col["v"]) == len(col["d"]) == len(col["r"]) == 2
+    # the load-bearing subsystems are all represented
+    for prefix in ("ingest.", "flush.", "pressure.", "shed.",
+                   "ledger.", "breaker.", "spool.", "table.",
+                   "sink.", "forward."):
+        assert any(n.startswith(prefix) for n in out["signals"]), \
+            prefix
+    # cumulative counters carry real deltas
+    proc = out["signals"]["ingest.metrics_processed"]
+    assert proc["v"] == [1, 2]
+    assert proc["d"] == [0, 1]
+
+
+def test_debug_signals_window_and_summary(server):
+    server.handle_packet(b"sig.a:1|c")
+    server.flush_once()
+    out = json.loads(_get(server, "/debug/signals?window=3600").read())
+    assert out["rows"] == 1
+    out = json.loads(
+        _get(server, "/debug/signals?window=0.000001").read())
+    assert out["rows"] == 0
+    summ = json.loads(
+        _get(server, "/debug/signals?summary=1").read())
+    assert summ["node"] == "sig"
+    assert summ["signals"]["flush.count"] == 1
+    assert "rates" in summ
+
+
+def test_debug_cluster_self_without_peers(server):
+    server.handle_packet(b"sig.a:1|c")
+    server.flush_once()
+    out = json.loads(_get(server, "/debug/cluster").read())
+    assert out["node"] == "sig"
+    assert out["self"]["signals"]["flush.count"] == 1
+    assert out["peers"] == {}
+
+
+def test_signal_history_disabled(server):
+    """tpu_signal_history=0 removes the plane: no ring, no flight
+    recorder, /debug/signals 404s, flushes still work."""
+    from veneur_tpu.core.server import Server
+    srv = Server(read_config(data={
+        "statsd_listen_addresses": [], "interval": "10s",
+        "hostname": "sig0", "http_address": "127.0.0.1:0",
+        "tpu_signal_history": 0}))
+    srv.start()
+    try:
+        assert srv.signals is None and srv.flight is None
+        srv.handle_packet(b"sig.a:1|c")
+        srv.flush_once()
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.http_port}/debug/signals",
+                timeout=10)
+        assert ei.value.code == 404
+    finally:
+        srv.shutdown()
+
+
+def test_history_reseeds_empty_across_checkpoint_recovery(tmp_path):
+    """PR-15 crash recovery: the replacement incarnation starts with
+    an EMPTY history ring (signals are per-process instants, not
+    recovered state) and sampling works through the recovery flush —
+    the recovered mass shows in the first row's ledger signals."""
+    from veneur_tpu.core.server import Server
+    data = {"statsd_listen_addresses": [], "interval": "30s",
+            "hostname": "sigck", "tpu_checkpoint_dir": str(tmp_path),
+            "tpu_checkpoint_interval": "30s"}
+    s1 = Server(read_config(data=data))
+    s1.start()
+    try:
+        s1.handle_packet(b"ck.warm:1|c")
+        s1.flush_once()  # predecessor has history rows of its own
+        assert s1.signals.rows() == 1
+        for i in range(20):
+            s1.handle_packet(f"ck.c.{i}:{i}|c".encode())
+        assert s1._checkpointer.run_once()
+    finally:
+        s1.shutdown()  # stands in for the crash (segment survives)
+
+    s2 = Server(read_config(data=data))
+    s2.start()
+    try:
+        # fresh incarnation: re-seeded empty, not crashed and not
+        # carrying the predecessor's rows
+        assert s2.signals.rows() == 0
+        assert s2.stats.get("recovery_segments_replayed", 0) == 1
+        s2.flush_once()
+        assert s2.signals.rows() == 1
+        row = s2.signals.latest()
+        assert row["recover.segments_replayed"] == 1
+        assert row["ledger.balanced"] == 1
+        led = s2.ledger.last()
+        assert led.recovered > 0
+    finally:
+        s2.shutdown()
+
+
+# ----------------------------------------------------------------------
+# proxy integration: ProxyLedger/destpool signal set
+
+
+def test_proxy_signals_surface():
+    from veneur_tpu.core.config import ProxyConfig
+    from veneur_tpu.core.proxy import ProxyServer
+    proxy = ProxyServer(ProxyConfig(
+        forward_address="127.0.0.1:9", http_address="127.0.0.1:0"))
+    proxy.start()
+    try:
+        proxy._refresh_once()
+        proxy._refresh_once()
+        base = f"http://127.0.0.1:{proxy.http_port}"
+        out = json.loads(urllib.request.urlopen(
+            base + "/debug/signals", timeout=10).read())
+        assert out["role"] == "proxy"
+        assert out["rows"] == 2
+        for prefix in ("route.", "ledger.", "wire.", "breaker.",
+                       "dest.", "discovery."):
+            assert any(n.startswith(prefix) for n in out["signals"]),\
+                prefix
+        assert out["signals"]["dest.count"]["v"] == [1, 1]
+        summ = json.loads(urllib.request.urlopen(
+            base + "/debug/signals?summary=1", timeout=10).read())
+        assert summ["role"] == "proxy"
+    finally:
+        proxy.shutdown()

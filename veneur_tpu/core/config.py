@@ -465,6 +465,29 @@ class Config:
     # flip their ring epoch — conserving mid-interval mass
     # cluster-wide.  VENEUR_TPU_ARC_HANDOFF=0 disables.
     tpu_arc_handoff: bool = True
+    # signal history plane (observe/signals.py): rows retained in the
+    # columnar per-flush signal ring served at /debug/signals.
+    # VENEUR_TPU_SIGNAL_HISTORY overrides; 0 disables the plane (and
+    # with it the flight recorder, which watches its rows).
+    tpu_signal_history: int = 512
+    # anomaly flight recorder (observe/recorder.py): directory for
+    # CRC-framed incident bundles.  Empty keeps bundles in a bounded
+    # in-memory store (still served at /debug/flight); set to persist
+    # across restarts.  VENEUR_TPU_FLIGHT_DIR overrides.
+    tpu_flight_dir: str = ""
+    # flight-recorder retention: bundle count and total bytes, evict
+    # oldest past either; and the per-trigger cooldown so a flapping
+    # trigger writes one bundle per window, not one per flush.
+    # VENEUR_TPU_FLIGHT_MAX_BUNDLES / VENEUR_TPU_FLIGHT_MAX_BYTES /
+    # VENEUR_TPU_FLIGHT_COOLDOWN override.
+    tpu_flight_max_bundles: int = 64
+    tpu_flight_max_bytes: int = 67108864
+    tpu_flight_cooldown: str = "30s"
+    # /debug/cluster peer list (comma separated http hosts); empty
+    # falls back to this node's forward destinations, so a local tier
+    # serves its globals' summaries with zero extra config.
+    # VENEUR_TPU_CLUSTER_PEERS overrides.
+    tpu_cluster_peers: str = ""
 
     def resolve_aliases(self) -> None:
         """Fold the reference's deprecated alias keys into their
@@ -718,6 +741,11 @@ class ProxyConfig:
     tpu_proxy_dest_queue: int = 8
     tpu_proxy_send_retries: int = 2
     tpu_proxy_send_backoff: float = 0.25
+    # proxy-side signal history (same ring as the server's, with the
+    # proxy's ProxyLedger/destpool signal set, sampled at the
+    # discovery-refresh cadence); VENEUR_TPU_SIGNAL_HISTORY overrides,
+    # 0 disables
+    tpu_signal_history: int = 512
 
     def consul_refresh_interval_seconds(self) -> float:
         return parse_duration(self.consul_refresh_interval)

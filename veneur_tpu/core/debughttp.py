@@ -17,9 +17,22 @@ identity endpoints), so the Python equivalents live here once:
 - ``/debug/vars``: expvar-style JSON dump (stats dict + device-cost
   registry), via ``vars_dump``
 - ``/debug/ledger``: the sample-conservation ledger ring (last 128
-  intervals, imbalances listed up front), via ``ledger_dump``
+  intervals, imbalances listed up front), via ``ledger_dump``;
+  ``?n=`` bounds the dump to the newest N records
 - ``/debug/trace/<trace_id>``: this process's fragment of a
   distributed flush trace, via ``trace_dump``
+- ``/debug/signals``: the columnar signal-history ring
+  (observe/signals.py) — ``?window=<sec>`` bounds it in time,
+  ``?summary=1`` serves the one-row fleet-scrape shape, via
+  ``signals_dump``
+- ``/debug/flight``: flight-recorder bundle listing + fetch
+  (``/debug/flight/<name>``), via ``flight_dump``
+
+``SERVER_DEBUG_ENDPOINTS`` / ``PROXY_DEBUG_ENDPOINTS`` are the
+authoritative inventories of every /debug/* path each role serves —
+test_docs_drift pins them against docs/observability.md AND against a
+scan of the actual do_GET routing, so a new debug surface can't land
+undocumented or uninventoried.
 
 Handlers are BaseHTTPRequestHandler methods; callers pass the request
 handler plus a per-process lock serializing the profiler (only one
@@ -33,6 +46,28 @@ import io
 import json
 import threading
 import time
+
+# every /debug/* path the server's do_GET routes (core/server.py)
+SERVER_DEBUG_ENDPOINTS = (
+    "/debug/pprof",
+    "/debug/flushes",
+    "/debug/ledger",
+    "/debug/trace",
+    "/debug/overload",
+    "/debug/signals",
+    "/debug/flight",
+    "/debug/cluster",
+    "/debug/vars",
+)
+
+# every /debug/* path the proxy's do_GET routes (core/proxy.py)
+PROXY_DEBUG_ENDPOINTS = (
+    "/debug/pprof",
+    "/debug/trace",
+    "/debug/ledger",
+    "/debug/signals",
+    "/debug/vars",
+)
 
 
 def respond_ok(handler, body: bytes = b"ok",
@@ -53,14 +88,78 @@ def vars_dump(handler, sources: dict) -> None:
                "application/json")
 
 
-def ledger_dump(handler, ledger) -> None:
+def query_params(path: str) -> dict[str, str]:
+    """The request's query string as a flat dict (last wins)."""
+    _, _, query = path.partition("?")
+    out: dict[str, str] = {}
+    for part in query.split("&"):
+        if part:
+            k, _, v = part.partition("=")
+            out[k] = v
+    return out
+
+
+def query_int(path: str, name: str, default: int = 0) -> int:
+    try:
+        return int(query_params(path).get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def query_float(path: str, name: str, default: float = 0.0) -> float:
+    try:
+        return float(query_params(path).get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def ledger_dump(handler, ledger, limit: int | None = None) -> None:
     """Serve the conservation-ledger ring as JSON (last 128 sealed
     intervals; ``imbalanced`` lists the seqs an operator should look
-    at first)."""
+    at first).  ``limit`` (the ``?n=`` query param) bounds the dump
+    to the newest N records."""
     if ledger is None:
         handler.send_error(404, "no ledger on this node")
         return
-    respond_ok(handler, ledger.to_json(), "application/json")
+    respond_ok(handler, ledger.to_json(limit=limit),
+               "application/json")
+
+
+def signals_dump(handler, history, path: str) -> None:
+    """Serve the signal-history ring: ``?window=<sec>`` bounds it in
+    time (default: all retained rows), ``?summary=1`` serves the
+    one-row shape vtop / /debug/cluster scrape."""
+    if history is None:
+        handler.send_error(404, "no signal history on this node")
+        return
+    if query_int(path, "summary", 0):
+        body = json.dumps(history.summary(),
+                          separators=(",", ":")).encode()
+    else:
+        body = history.to_json(query_float(path, "window", 0.0))
+    respond_ok(handler, body, "application/json")
+
+
+def flight_dump(handler, recorder, path: str) -> None:
+    """Serve the flight recorder: ``/debug/flight`` lists bundle
+    metadata + counters; ``/debug/flight/<name>`` serves one raw
+    CRC-framed bundle for offline replay."""
+    if recorder is None:
+        handler.send_error(404, "no flight recorder on this node")
+        return
+    clean, _, _ = path.partition("?")
+    tail = clean.partition("/debug/flight")[2].strip("/")
+    if not tail:
+        respond_ok(handler, json.dumps(
+            {"bundles": recorder.list_bundles(),
+             "stats": recorder.stats()}, indent=1).encode(),
+            "application/json")
+        return
+    blob = recorder.get(tail)
+    if blob is None:
+        handler.send_error(404, f"no bundle {tail!r}")
+        return
+    respond_ok(handler, blob, "application/octet-stream")
 
 
 def trace_dump(handler, index, path: str) -> None:

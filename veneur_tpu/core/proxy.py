@@ -137,9 +137,71 @@ class ProxyServer:
         self.http_port = None
         self._threads: list[threading.Thread] = []
 
+        # proxy-side signal history: one row per ledger roll (the
+        # discovery-refresh cadence — the proxy's "flush seal"), with
+        # the ProxyLedger/destpool signal set, served at
+        # /debug/signals like the server's (observe/signals.py stays
+        # jax-free so importing it here costs nothing)
+        self.signals = None
+        if int(getattr(config, "tpu_signal_history", 512)) > 0:
+            from veneur_tpu.observe.signals import SignalHistory
+            self.signals = SignalHistory(
+                schema=tuple(self._signal_row()),
+                capacity=int(getattr(config, "tpu_signal_history",
+                                     512)),
+                node=config.http_address or config.grpc_address or "",
+                role="proxy")
+
     def bump(self, key: str, n: int = 1) -> None:
         with self._stats_lock:
             self.stats[key] += n
+
+    def _signal_row(self, rec=None) -> dict:
+        """The proxy's fixed-schema signal row: routing conservation
+        (the just-sealed ProxyLedgerRecord), destination-pool wire
+        outcomes, breaker states, and discovery health.  Called with
+        no args at init to derive the schema."""
+        with self._stats_lock:
+            st = dict(self.stats)
+        row = {
+            "route.routed": rec.routed if rec is not None else 0,
+            "route.dropped": rec.dropped if rec is not None else 0,
+            "route.enqueued": rec.enqueued if rec is not None else 0,
+            "route.busy_dropped":
+                rec.busy_dropped if rec is not None else 0,
+            "route.fallbacks":
+                rec.fallbacks if rec is not None else 0,
+            "ledger.owed": rec.owed if rec is not None else 0,
+            "ledger.balanced": int(
+                rec.balanced if rec is not None else True),
+            "ledger.imbalanced_total": self.ledger.imbalanced_total,
+            "ingest.imports_received": st.get("imports_received", 0),
+            "ingest.import_errors": st.get("import_errors", 0),
+            "ingest.spans_proxied": st.get("spans_proxied", 0),
+        }
+        tot = self.destpool.totals()
+        row["wire.sent_items"] = tot.get("sent_items", 0)
+        row["wire.error_items"] = tot.get("error_items", 0)
+        row["wire.retries"] = tot.get("retries", 0)
+        row["wire.busy_dropped_items"] = tot.get(
+            "busy_dropped_items", 0)
+        row["dest.queued"] = sum(
+            w.get("queued", 0)
+            for w in self.destpool.stats().values())
+        states = self.destpool.breaker_states()
+        row["breaker.closed"] = sum(
+            1 for s in states.values() if s["state"] == "closed")
+        row["breaker.half_open"] = sum(
+            1 for s in states.values() if s["state"] == "half_open")
+        row["breaker.open"] = sum(
+            1 for s in states.values() if s["state"] == "open")
+        row["breaker.opens_total"] = tot.get("breaker_opens", 0)
+        ring = getattr(self, "ring", None)
+        disc = ring.stats() if ring is not None else {}
+        row["dest.count"] = len(disc.get("members", ()))
+        row["discovery.epoch"] = disc.get("epoch", 0)
+        row["discovery.refreshes"] = disc.get("refreshes", 0)
+        return row
 
     # ------------------------------------------------------------------
     # listeners
@@ -236,7 +298,15 @@ class ProxyServer:
                     debughttp.trace_dump(self, proxy.trace_index,
                                          self.path)
                 elif self.path.startswith("/debug/ledger"):
-                    debughttp.ledger_dump(self, proxy.ledger)
+                    debughttp.ledger_dump(
+                        self, proxy.ledger,
+                        limit=debughttp.query_int(self.path, "n", 0))
+                elif self.path.startswith("/debug/signals"):
+                    # the proxy's signal-history ring (ProxyLedger +
+                    # destpool signal set, sampled per discovery
+                    # refresh); same query surface as the server's
+                    debughttp.signals_dump(self, proxy.signals,
+                                           self.path)
                 elif self.path.startswith("/debug/vars"):
                     # same expvar surface as the server's listener;
                     # the proxy has no flush ring, but its routing
@@ -922,8 +992,18 @@ class ProxyServer:
         # ledger interval); skip empty intervals to keep the
         # /debug/ledger ring informative
         cur = self.ledger._cur
+        rec = None
         if cur.routed or cur.dropped or cur.fallbacks:
-            self.ledger.roll()
+            rec = self.ledger.roll()
+        # signal-history sample rides the same cadence: the sealed
+        # routing record (None on an idle interval) plus live
+        # destpool/breaker/discovery counters become one row
+        if self.signals is not None:
+            try:
+                self.signals.append(self._signal_row(rec))
+                self.bump("signal_rows")
+            except Exception:
+                log.exception("proxy signal sample failed")
         # drop clients for destinations that left the ring the
         # gRPC forwarders actually route on
         grpc_members = (self.grpc_ring or self.ring).ring.members

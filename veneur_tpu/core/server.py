@@ -30,7 +30,7 @@ from concurrent.futures import TimeoutError as _FuturesTimeout
 
 from veneur_tpu import __version__
 from veneur_tpu.core import metrics as im
-from veneur_tpu.core.config import Config
+from veneur_tpu.core.config import Config, parse_duration
 from veneur_tpu.core.flusher import Flusher, FlushResult
 from veneur_tpu.core.table import MetricTable, TableConfig
 import numpy as np
@@ -430,6 +430,38 @@ class Server:
         self._handoff_pending = None
         self._handoff_shipper = None
         self._handoff_last: dict = {}
+
+        # signal history plane + anomaly flight recorder: one
+        # fixed-schema row of every internal signal per flush seal
+        # into a bounded columnar ring (/debug/signals), with trigger
+        # predicates over the rows dumping CRC-framed incident
+        # bundles (/debug/flight).  The schema is derived ONCE here —
+        # before any subsystem has data — so a late-built forwarder
+        # can never grow the row mid-history.  This ring is the plane
+        # the autopilot (ROADMAP item 4) will read.
+        self.signals = None
+        self.flight = None
+        self._flight_record = None  # triggering interval's flush rec
+        if int(getattr(config, "tpu_signal_history", 512)) > 0:
+            self.signals = observe.SignalHistory(
+                schema=tuple(self._signal_row()),
+                capacity=int(getattr(config, "tpu_signal_history",
+                                     512)),
+                node=config.hostname or "",
+                role="local" if self.is_local else "global")
+            self.flight = observe.FlightRecorder(
+                self.signals, context_fn=self._flight_context,
+                directory=str(getattr(config, "tpu_flight_dir", "")),
+                max_bundles=int(getattr(
+                    config, "tpu_flight_max_bundles", 64)),
+                max_bytes=int(getattr(
+                    config, "tpu_flight_max_bytes", 67108864)),
+                cooldown=parse_duration(str(getattr(
+                    config, "tpu_flight_cooldown", "30s"))),
+                node=config.hostname or "")
+        # /debug/cluster peer-summary cache: addr -> (unix, summary)
+        self._cluster_cache: dict = {}
+        self._cluster_lock = threading.Lock()
 
         if getattr(config, "tpu_warmup", False) and \
                 hasattr(self.table, "take_staged"):
@@ -1520,11 +1552,39 @@ class Server:
                 elif self.path.startswith("/debug/flushes"):
                     from veneur_tpu.core import debughttp
                     debughttp.respond_ok(
-                        self, server.flush_ring.to_json(),
+                        self, server.flush_ring.to_json(
+                            limit=debughttp.query_int(
+                                self.path, "n", 0)),
                         "application/json")
                 elif self.path.startswith("/debug/ledger"):
                     from veneur_tpu.core import debughttp
-                    debughttp.ledger_dump(self, server.ledger)
+                    debughttp.ledger_dump(
+                        self, server.ledger,
+                        limit=debughttp.query_int(self.path, "n", 0))
+                elif self.path.startswith("/debug/signals"):
+                    # the columnar signal-history ring: ?window=<sec>
+                    # bounds it in time, ?summary=1 serves the
+                    # one-row shape vtop / /debug/cluster scrape
+                    from veneur_tpu.core import debughttp
+                    debughttp.signals_dump(self, server.signals,
+                                           self.path)
+                elif self.path.startswith("/debug/flight"):
+                    # flight-recorder bundles: listing + raw
+                    # CRC-framed fetch for offline replay
+                    from veneur_tpu.core import debughttp
+                    debughttp.flight_dump(self, server.flight,
+                                          self.path)
+                elif self.path.startswith("/debug/cluster"):
+                    # fleet view: own latest signal row merged with
+                    # cached peer summaries (tpu_cluster_peers, or
+                    # the forward destinations)
+                    from veneur_tpu.core import debughttp
+                    import json as _json
+                    debughttp.respond_ok(
+                        self,
+                        _json.dumps(server._cluster_view(),
+                                    indent=1).encode(),
+                        "application/json")
                 elif self.path.startswith("/debug/trace"):
                     from veneur_tpu.core import debughttp
                     debughttp.trace_dump(self, server.trace_index,
@@ -1632,6 +1692,15 @@ class Server:
                         # last scale-out arc handoff shipped by this
                         # node ({} until arc_handoff runs)
                         "handoff": dict(server._handoff_last),
+                        # signal-history plane + flight recorder at a
+                        # glance (full views at /debug/signals and
+                        # /debug/flight); None when disabled
+                        "signals": (
+                            server.signals.summary()
+                            if server.signals is not None else None),
+                        "flight": (
+                            server.flight.stats()
+                            if server.flight is not None else None),
                     })
                 elif (self.path == "/quitquitquit" and
                       server.config.http_quit):
@@ -2095,6 +2164,11 @@ class Server:
                 with self.lock:
                     setp(self.overload.pressure.level)
         self.ledger.seal(led)
+        # signal-history sample at every seal: the sealed record, the
+        # cycle's stage timings, and every subsystem's counters become
+        # one row; the flight recorder's triggers run on it
+        self._sample_signals(led, cyc.record,
+                             time.monotonic_ns() - t_flush0)
         if self._checkpointer is not None:
             # the sealed interval's mass is delivered: its checkpoint
             # segments (and every older gen's) are now replay
@@ -2770,6 +2844,250 @@ class Server:
             self.ledger.credit_forward_wire(
                 led, rows=stats["items"], errors=stats["errors"])
 
+    # ------------------------------------------------------------------
+    # signal history plane (observe/signals.py + observe/recorder.py)
+
+    def _signal_row(self, led=None, record=None,
+                    flush_ns: int = 0) -> dict:
+        """One fixed-schema row of every internal signal.  Called with
+        no args at init to derive the schema, so every subsystem
+        access is guarded — a disabled/lazily-built subsystem reports
+        0, never a missing column.  Cumulative counters are preferred
+        (the ring computes delta + EWMA rate at append); per-interval
+        values (stage ns, pressure score) ride as instants."""
+        with self._stats_lock:
+            st = dict(self.stats)
+        row = {
+            "ingest.packets_received": st.get("packets_received", 0),
+            "ingest.packet_errors": st.get("packet_errors", 0),
+            "ingest.metrics_processed": st.get("metrics_processed", 0),
+            "ingest.metrics_dropped": st.get("metrics_dropped", 0),
+            "ingest.imports_received": st.get("imports_received", 0),
+            "ingest.import_errors": st.get("import_errors", 0),
+            "ingest.kernel_drops": st.get("socket_kernel_drops", 0),
+            "flush.count": st.get("flushes", 0),
+            "flush.errors": st.get("flush_errors", 0),
+            "flush.slow_tasks": st.get("flush_slow_tasks", 0),
+            "flush.duration_ns": int(flush_ns),
+            "flush.compiles":
+                self.device_costs.totals()["compile_total"],
+            "handoff.shipped_items": st.get("handoff_items_sent", 0),
+            "handoff.received_items":
+                st.get("handoff_items_received", 0),
+            "recover.recovered_items":
+                st.get("recovery_items_received", 0),
+            "recover.replay_wires": st.get("replay_wires_received", 0),
+            "recover.segments_replayed":
+                st.get("recovery_segments_replayed", 0),
+            "trace.spans_sent": self.trace_client.sent,
+            "trace.spans_dropped": self.trace_client.dropped,
+        }
+        stages = record.stages if record is not None else {}
+        for stage in ("snapshot", "dispatch", "device_wait",
+                      "host_emit", "sink_flush", "forward"):
+            row[f"flush.stage.{stage}_ns"] = stages.get(stage, 0)
+        row["flush.readback_bytes"] = (
+            record.readback_bytes if record is not None else 0)
+        ov = self.overload
+        row["pressure.score"] = (
+            ov.pressure.score if ov is not None else 0.0)
+        row["pressure.level"] = (
+            ov.pressure.level if ov is not None else 0)
+        row["pressure.engaged"] = int(
+            ov.pressure.engaged if ov is not None else False)
+        row["pressure.transitions"] = (
+            ov.pressure.transitions if ov is not None else 0)
+        row["flush.overruns"] = (
+            ov.flush_overruns if ov is not None else 0)
+        row["flush.coalesced"] = (
+            ov.coalesced_total if ov is not None else 0)
+        row["shed.total"] = ov.shed_total if ov is not None else 0
+        row["shed.tenants"] = (
+            len({t for t, _ in ov.shed_by_total})
+            if ov is not None else 0)
+        row["ledger.received"] = (
+            led.received_total() if led is not None else 0)
+        row["ledger.staged"] = led.staged if led is not None else 0
+        row["ledger.status"] = led.status if led is not None else 0
+        row["ledger.shed"] = led.shed if led is not None else 0
+        row["ledger.overflow"] = (
+            led.overflow if led is not None else 0)
+        row["ledger.invalid"] = led.invalid if led is not None else 0
+        row["ledger.owed"] = led.owed if led is not None else 0
+        row["ledger.balanced"] = int(
+            led.balanced if led is not None else True)
+        row["ledger.emitted_rows"] = (
+            led.emitted_rows if led is not None else 0)
+        row["ledger.forwarded_rows"] = (
+            led.forwarded_rows if led is not None else 0)
+        row["ledger.retained_rows"] = (
+            led.retained_rows if led is not None else 0)
+        row["ledger.coalesced"] = (
+            led.coalesced if led is not None else 0)
+        row["ledger.parse_errors"] = (
+            led.parse_errors if led is not None else 0)
+        row["ledger.imbalanced_total"] = self.ledger.imbalanced_total
+        row["reshard.received_items"] = (
+            led.reshard_received_items if led is not None else 0)
+        table = getattr(self, "table", None)
+        row["table.staged"] = (
+            int(table.staged()) if table is not None else 0)
+        occ = 0.0
+        for name in ("counter_idx", "gauge_idx", "histo_idx",
+                     "set_idx"):
+            idx = getattr(table, name, None)
+            if idx is not None and getattr(idx, "capacity", 0):
+                occ = max(occ, idx.occupancy() / idx.capacity)
+        row["table.occupancy"] = round(occ, 6)
+        fwd = getattr(self, "_sharded_fwd", None)
+        states = fwd.breaker_states() if fwd is not None else {}
+        row["breaker.closed"] = sum(
+            1 for s in states.values() if s["state"] == "closed")
+        row["breaker.half_open"] = sum(
+            1 for s in states.values() if s["state"] == "half_open")
+        row["breaker.open"] = sum(
+            1 for s in states.values() if s["state"] == "open")
+        tot = fwd.totals() if fwd is not None else {}
+        row["breaker.opens_total"] = tot.get("breaker_opens", 0)
+        row["forward.sent_items"] = tot.get("sent_items", 0)
+        row["forward.error_items"] = tot.get("error_items", 0)
+        row["forward.busy_dropped_items"] = tot.get(
+            "busy_dropped_items", 0)
+        row["forward.replayed_items"] = tot.get("replayed_items", 0)
+        row["forward.queued"] = sum(
+            w.get("queued", 0)
+            for w in (fwd.stats() if fwd is not None else {}).values())
+        disc = fwd.discovery_stats() if fwd is not None else {}
+        row["forward.destinations"] = len(disc.get("members", ()))
+        row["reshard.epoch"] = disc.get("epoch", 0)
+        row["reshard.moved_rows"] = st.get(
+            "forward_reshard_moved_rows", 0)
+        sp = fwd.spool_stats() if fwd is not None else None
+        for key in ("queued_items", "queued_bytes", "spooled_items",
+                    "replayed_items", "expired_items",
+                    "inflight_items"):
+            row[f"spool.{key}"] = (sp or {}).get(key, 0)
+        fan = (self._fanout.stats()
+               if getattr(self, "_fanout", None) is not None else {})
+        row["sink.flushes"] = sum(
+            w.get("flushes", 0) for w in fan.values())
+        row["sink.errors"] = sum(
+            w.get("errors", 0) for w in fan.values())
+        row["sink.busy_drops"] = sum(
+            w.get("busy_drops", 0) for w in fan.values())
+        row["sink.timeouts"] = sum(
+            w.get("timeouts", 0) for w in fan.values())
+        return row
+
+    def _sample_signals(self, led, record, flush_ns: int) -> None:
+        """The per-seal sampling hook: append one row to the history
+        ring, evaluate the flight-recorder triggers on it, and count
+        both (veneur.signals.rows_total / veneur.flight.*)."""
+        if self.signals is None:
+            return
+        try:
+            row = self._signal_row(led, record, flush_ns)
+            t_now = time.time()
+            seq = led.seq if led is not None else 0
+            self.signals.append(row, t=t_now, seq=seq)
+            if self.flight is not None:
+                # the triggering interval's flush record is not in the
+                # flush ring yet (appended after the seal hook) —
+                # stash it for _flight_context
+                self._flight_record = record
+                self.flight.observe(row, t=t_now, seq=seq)
+            self.bump("signal_rows")
+        except Exception:
+            log.exception("signal sample failed")
+
+    def _flight_context(self, trigger: str, row: dict) -> dict:
+        """Incident context captured into a flight bundle at trigger
+        time: the triggering interval's sealed ledger record(s), its
+        flush record + trace tree, and the live subsystem snapshots.
+        Cheap dict copies only — this runs on the flush thread."""
+        out: dict = {}
+        recs = self.ledger.records()
+        out["ledger_records"] = [r.to_dict() for r in recs[-4:]]
+        rec = getattr(self, "_flight_record", None)
+        if rec is None:
+            flushes = self.flush_ring.records()
+            rec = flushes[-1] if flushes else None
+        if rec is not None:
+            out["flush_record"] = rec.to_dict()
+            out["trace"] = self.trace_index.get(rec.trace_id)
+        fwd = self._sharded_fwd
+        out["breakers"] = (
+            fwd.breaker_states() if fwd is not None else {})
+        out["spool"] = fwd.spool_stats() if fwd is not None else None
+        out["discovery"] = (
+            fwd.discovery_stats() if fwd is not None else {})
+        out["overload"] = (
+            self.overload.snapshot()
+            if self.overload is not None else None)
+        out["spool_ledger"] = self._spool_ledger.summary()
+        with self._stats_lock:
+            out["stats"] = dict(self.stats)
+        return out
+
+    # ------------------------------------------------------------------
+    # /debug/cluster: own latest row merged with cached peer summaries
+
+    _CLUSTER_TTL = 10.0
+
+    def _cluster_peers(self) -> list[str]:
+        peers = [p.strip() for p in str(getattr(
+            self.config, "tpu_cluster_peers", "")).split(",")
+            if p.strip()]
+        if not peers and self._sharded_fwd is not None:
+            peers = list(self._sharded_fwd.discovery_stats().get(
+                "members", ()))
+        return peers
+
+    def _scrape_peer(self, addr: str) -> dict:
+        url = addr if "://" in addr else f"http://{addr}"
+        url = url.rstrip("/") + "/debug/signals?summary=1"
+        with urllib.request.urlopen(url, timeout=1.0) as resp:
+            return json.loads(resp.read().decode())
+
+    def _cluster_view(self) -> dict:
+        """Own signal summary merged with peer summaries, cached per
+        peer for ``_CLUSTER_TTL`` seconds (keep-last-good: a peer that
+        stops answering serves its stale summary, flagged, instead of
+        vanishing from the fleet view)."""
+        now = time.monotonic()
+        peers = {}
+        for addr in self._cluster_peers():
+            with self._cluster_lock:
+                cached = self._cluster_cache.get(addr)
+            if cached is not None and (now - cached[0]) < \
+                    self._CLUSTER_TTL:
+                peers[addr] = cached[1]
+                continue
+            try:
+                summ = self._scrape_peer(addr)
+                summ["stale"] = False
+                summ.pop("error", None)
+                with self._cluster_lock:
+                    self._cluster_cache[addr] = (now, summ)
+                peers[addr] = summ
+            except Exception as e:
+                if cached is not None:
+                    stale = dict(cached[1])
+                    stale["stale"] = True
+                    stale["error"] = f"{type(e).__name__}: {e}"
+                    peers[addr] = stale
+                else:
+                    peers[addr] = {
+                        "error": f"{type(e).__name__}: {e}",
+                        "stale": True}
+        return {
+            "node": self.config.hostname or "",
+            "role": "local" if self.is_local else "global",
+            "self": (self.signals.summary()
+                     if self.signals is not None else None),
+            "peers": peers,
+        }
+
     def shutdown(self) -> None:
         if (not self._shutdown.is_set()
                 and getattr(self.config, "tpu_drain_on_shutdown", True)
@@ -2835,6 +3153,8 @@ class Server:
             self._grpc_client.close()
         if self._sharded_fwd is not None:
             self._sharded_fwd.stop()
+        if self.flight is not None:
+            self.flight.stop()
         for s in self.metric_sinks + self.span_sinks:
             if hasattr(s, "stop"):
                 try:

@@ -481,6 +481,32 @@ class Telemetry:
               self._delta("flush_coalesced"))
         count("veneur.socket.kernel_drops_total",
               self._delta("socket_kernel_drops"))
+        # signal-history plane + anomaly flight recorder
+        # (observe/signals.py / observe/recorder.py): rows sampled
+        # into the columnar ring, and incident bundles dumped —
+        # tagged by the trigger that fired them — plus dumps the
+        # per-trigger cooldown suppressed and writer-path errors
+        sig = getattr(self.server, "signals", None)
+        if sig is not None:
+            self.server.stats["signals_rows"] = int(
+                sig.appended_total)
+            count("veneur.signals.rows_total",
+                  self._delta("signals_rows"))
+        flt = getattr(self.server, "flight", None)
+        if flt is not None:
+            for trig, total in sorted(flt.by_trigger().items()):
+                key = f"flight_bundles_{trig}"
+                self.server.stats[key] = int(total)
+                count("veneur.flight.bundles_total",
+                      self._delta(key), (f"trigger:{trig}",))
+            self.server.stats["flight_suppressed"] = int(
+                flt.suppressed_total)
+            count("veneur.flight.suppressed_total",
+                  self._delta("flight_suppressed"))
+            self.server.stats["flight_errors"] = int(
+                flt.errors_total)
+            count("veneur.flight.errors_total",
+                  self._delta("flight_errors"))
         # "other"-sample drops at sinks that only speak samples they
         # understand (kafka's FlushOtherSamples contract): counted,
         # never silent

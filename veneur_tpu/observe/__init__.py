@@ -26,6 +26,14 @@ The reference veneur traces its own flushes (flusher.go:29
 ``traceindex`` — bounded per-process index of recent internal spans
     keyed by trace id, served at ``/debug/trace/<trace_id>`` so one
     interval's cross-tier span tree is queryable on every node.
+``signals``    — fixed-schema columnar ring of per-flush signal rows
+    (EWMA rate + delta computed at append), served at
+    ``/debug/signals?window=<sec>`` — the history plane the autopilot
+    (ROADMAP item 4) will read.
+``recorder``   — anomaly flight recorder: trigger predicates over the
+    signal rows dump CRC-framed incident bundles (last K rows, sealed
+    ledger records, flush record + trace tree, subsystem snapshots)
+    to ``VENEUR_TPU_FLIGHT_DIR``, listed at ``/debug/flight``.
 """
 
 from veneur_tpu.observe.devicecost import (DeviceCostRegistry, REGISTRY,
@@ -38,10 +46,15 @@ from veneur_tpu.observe.tracer import (FlushCycle, FlushTracer,
                                        NULL_CYCLE, NullCycle)
 from veneur_tpu.observe.traceindex import TraceIndex, span_to_dict
 from veneur_tpu.observe.profiler import capture_device_profile
+from veneur_tpu.observe.recorder import (FlightRecorder, read_bundle,
+                                         TRIGGER_NAMES)
+from veneur_tpu.observe.signals import SignalHistory
 
 __all__ = ["DeviceCostRegistry", "REGISTRY", "instrument",
            "FlushRecord", "FlushRing", "FlushCycle", "FlushTracer",
            "NullCycle", "NULL_CYCLE", "capture_device_profile",
            "ClassDropTally", "Ledger", "LedgerRecord",
            "SpoolLedger", "SpoolLedgerRecord",
-           "TraceIndex", "span_to_dict"]
+           "TraceIndex", "span_to_dict",
+           "SignalHistory", "FlightRecorder", "read_bundle",
+           "TRIGGER_NAMES"]

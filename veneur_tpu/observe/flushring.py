@@ -69,8 +69,13 @@ class FlushRing:
         with self._lock:
             return list(self._ring)
 
-    def to_json(self) -> bytes:
-        return json.dumps([r.to_dict() for r in self.records()],
+    def to_json(self, limit: int | None = None) -> bytes:
+        """``limit`` bounds the dump to the newest N records (the
+        ``?n=`` query param on /debug/flushes)."""
+        recs = self.records()
+        if limit and limit > 0:
+            recs = recs[-limit:]
+        return json.dumps([r.to_dict() for r in recs],
                           indent=1).encode()
 
     def stage_summary(self) -> dict:

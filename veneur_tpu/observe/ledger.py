@@ -518,14 +518,19 @@ class Ledger:
         with self._lock:
             return self._ring[-1] if self._ring else None
 
-    def to_json(self) -> bytes:
+    def to_json(self, limit: int | None = None) -> bytes:
+        """``limit`` bounds the dump to the newest N records (fleet
+        scrapers pass ``?n=``); imbalanced seqs still cover the whole
+        ring so a truncated poll can't hide an old imbalance."""
         recs = self.records()
+        tail = recs[-limit:] if limit and limit > 0 else recs
         out = {
             "node": self.node,
             "strict": self.strict,
             "intervals": len(recs),
+            "returned": len(tail),
             "imbalanced": [r.seq for r in recs if not r.balanced],
-            "records": [r.to_dict() for r in recs],
+            "records": [r.to_dict() for r in tail],
         }
         return json.dumps(out, indent=1).encode()
 
@@ -882,14 +887,16 @@ class ProxyLedger:
         with self._lock:
             return list(self._ring)
 
-    def to_json(self) -> bytes:
+    def to_json(self, limit: int | None = None) -> bytes:
         recs = self.records()
+        tail = recs[-limit:] if limit and limit > 0 else recs
         out = {
             "node": self.node,
             "strict": self.strict,
             "intervals": len(recs),
+            "returned": len(tail),
             "imbalanced": [r.seq for r in recs if not r.balanced],
-            "records": [r.to_dict() for r in recs],
+            "records": [r.to_dict() for r in tail],
         }
         return json.dumps(out, indent=1).encode()
 
