@@ -1253,11 +1253,16 @@ def sockets_bench() -> dict:
     ingest number (>60k packets/sec in production,
     /root/reference/README.md:310-312).  A loadgen thread blasts
     DogStatsD datagrams at a live Server (SO_REUSEPORT readers,
-    recvmmsg drain, native parse, device table) and the server's own
-    stats report what was received and aggregated.  Loadgen and
-    server share the host core here, so the figure UNDERSTATES an
-    isolated server.  Two shapes: single-metric packets (the
-    reference's production shape) and 25-line batched packets."""
+    kernel-efficient drain, native parse, device table) and the
+    server's own stats report what was received and aggregated.
+    Loadgen and server share the host core here, so the figure
+    UNDERSTATES an isolated server.  Two shapes: single-metric
+    packets (the reference's production shape) and 25-line batched
+    packets — each run per ingest backend (io_uring multishot ring
+    vs recvmmsg) where the kernel grants both, plus a reader-count
+    sweep per backend.  The artifact is unusable without provenance,
+    so kernel release, effective rcvbuf, platform pin and the
+    RESOLVED backend are stamped at top level."""
     import socket as socket_mod
     import threading
 
@@ -1270,47 +1275,89 @@ def sockets_bench() -> dict:
     duration = 5.0 if QUICK else 12.0
     rss0_kb = _rss_now_kb()
 
-    for label, lines_per_packet in (("single_line", 1),
-                                    ("batch_25", 25)):
+    # provenance stamps first: a socket number divorced from the
+    # kernel, rcvbuf ceiling and drain backend that produced it has
+    # burned us before (round artifacts with platform_pin: null)
+    out["kernel_release"] = os.uname().release
+    # cores decide whether the backend ratio is meaningful: with one
+    # core the blast loadgen and the reader timeshare it, both
+    # backends receive ~everything, and pkts/s measures the sender's
+    # CPU share — the speedup gate is platform-relative on this
+    out["cpu_count"] = os.cpu_count()
+    try:
+        ps = socket_mod.socket(socket_mod.AF_INET,
+                               socket_mod.SOCK_DGRAM)
+        ps.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_RCVBUF,
+                      64 << 20)
+        out["effective_rcvbuf"] = ps.getsockopt(
+            socket_mod.SOL_SOCKET, socket_mod.SO_RCVBUF)
+        ps.close()
+    except OSError:
+        out["effective_rcvbuf"] = 0
+    from veneur_tpu import native as _native
+    from veneur_tpu.native import uring as _uring
+    _uring_err = _uring.probe(_native.load())
+    out["uring_probe_errno"] = -_uring_err
+
+    def build_pkts(lines_per_packet: int) -> list:
+        # pre-built datagrams: 1k names, realistic counter lines
+        pkts = []
+        for i in range(4096):
+            lines = [
+                f"svc.req.count."
+                f"{(i * lines_per_packet + j) % 1000}:"
+                f"{1 + (j % 9)}|c".encode()
+                for j in range(lines_per_packet)]
+            pkts.append(b"\n".join(lines))
+        return pkts
+
+    def run_shape(backend: str, lines_per_packet: int,
+                  n_readers: int, n_socks: int) -> dict:
         srv = Server(read_config(data={
             "statsd_listen_addresses": ["udp://127.0.0.1:0"],
             "interval": "3s",
             "hostname": "bench",
+            "num_readers": n_readers,
+            "tpu_ingest_backend": backend,
             "accelerator_probe_timeout": "5s"}))
         srv.start()
         try:
             port = srv.statsd_ports[0]
-            # pre-built datagrams: 1k names, realistic counter lines
-            pkts = []
-            for i in range(4096):
-                lines = [
-                    f"svc.req.count."
-                    f"{(i * lines_per_packet + j) % 1000}:"
-                    f"{1 + (j % 9)}|c".encode()
-                    for j in range(lines_per_packet)]
-                pkts.append(b"\n".join(lines))
+            pkts = build_pkts(lines_per_packet)
             sent = [0]
             stop = threading.Event()
+            mask = n_socks - 1
 
             def blast():
-                s = socket_mod.socket(socket_mod.AF_INET,
-                                      socket_mod.SOCK_DGRAM)
-                s.connect(("127.0.0.1", port))
+                # several source sockets so REUSEPORT's 4-tuple hash
+                # actually spreads flows across the readers
+                socks = []
+                for _ in range(n_socks):
+                    s = socket_mod.socket(socket_mod.AF_INET,
+                                          socket_mod.SOCK_DGRAM)
+                    s.connect(("127.0.0.1", port))
+                    socks.append(s)
                 n = 0
                 while not stop.is_set():
                     # burst between stop checks; send() can drop at
                     # rcvbuf pressure — that's the measurement
-                    for p in pkts:
+                    for k, p in enumerate(pkts):
                         try:
-                            s.send(p)
+                            socks[k & mask].send(p)
                         except OSError:
                             pass
                         n += 1
                     sent[0] = n
-                s.close()
+                for s in socks:
+                    s.close()
 
             base_pkts = srv.stats.get("packets_received", 0)
             base_metrics = srv.stats.get("metrics_processed", 0)
+            # device_costs is the process-global registry and reader
+            # thread names repeat per server, so the breakdown is a
+            # delta against this run's starting counters
+            base_readers = srv.device_costs.snapshot().get(
+                "readers", {})
             t = threading.Thread(target=blast, daemon=True)
             t0 = time.perf_counter()
             t.start()
@@ -1323,7 +1370,10 @@ def sockets_bench() -> dict:
             got_pkts = srv.stats.get("packets_received", 0) - base_pkts
             got_metrics = (srv.stats.get("metrics_processed", 0) -
                            base_metrics)
-            out[label] = {
+            res = {
+                # what actually drained the socket (a uring ask can
+                # land on recvmmsg via probe/runtime fallback)
+                "backend": srv.ingest_backend,
                 "seconds": round(dt, 3),
                 "offered_packets": sent[0],
                 "received_packets": got_pkts,
@@ -1333,95 +1383,58 @@ def sockets_bench() -> dict:
                 "metrics_per_sec": round(got_metrics / dt, 1),
                 "vs_reference_60k": round(got_pkts / dt / 60_000.0, 2),
             }
+            if n_readers > 1:
+                readers = srv.device_costs.snapshot().get(
+                    "readers", {})
+                per_reader = {}
+                for name, r in sorted(readers.items()):
+                    b = base_readers.get(name, {})
+                    d = {k: r[k] - b.get(k, 0)
+                         for k in ("packets", "samples",
+                                   "fused_batches", "batches")}
+                    if d["batches"]:
+                        per_reader[name] = d
+                res["per_reader"] = per_reader
+            return res
         finally:
             srv.shutdown()
 
-    # ---- multi-reader sweep: SO_REUSEPORT reader scaling on the
-    # fused shard path (readers parse+probe lock-free against the RCU
-    # index, then take the table lock only for the O(touched-rows)
-    # merge).  Loadgen still timeshares the host, so the sweep shows
-    # SCALING SHAPE, not isolated per-reader capacity; the per-reader
-    # breakdown from the device-cost registry shows how evenly the
-    # kernel spread the flows.
-    sweep = {}
-    for n_readers in (1, 2, 4):
-        srv = Server(read_config(data={
-            "statsd_listen_addresses": ["udp://127.0.0.1:0"],
-            "interval": "3s",
-            "hostname": "bench",
-            "num_readers": n_readers,
-            "accelerator_probe_timeout": "5s"}))
-        srv.start()
-        try:
-            port = srv.statsd_ports[0]
-            pkts = []
-            for i in range(4096):
-                lines = [f"svc.req.count.{(i * 25 + j) % 1000}:"
-                         f"{1 + (j % 9)}|c".encode()
-                         for j in range(25)]
-                pkts.append(b"\n".join(lines))
-            stop = threading.Event()
-            sent = [0]
+    # headline shapes on the auto-resolved backend: what a default
+    # deployment on THIS kernel actually runs
+    for label, lines_per_packet in (("single_line", 1),
+                                    ("batch_25", 25)):
+        out[label] = run_shape("auto", lines_per_packet, 1, 1)
+    out["ingest_backend"] = out["single_line"]["backend"]
 
-            def blast():
-                socks = []
-                # several source sockets so REUSEPORT's 4-tuple hash
-                # actually spreads flows across the readers
-                for _ in range(8):
-                    s = socket_mod.socket(socket_mod.AF_INET,
-                                          socket_mod.SOCK_DGRAM)
-                    s.connect(("127.0.0.1", port))
-                    socks.append(s)
-                n = 0
-                while not stop.is_set():
-                    for k, p in enumerate(pkts):
-                        try:
-                            socks[k & 7].send(p)
-                        except OSError:
-                            pass
-                        n += 1
-                    sent[0] = n
-                for s in socks:
-                    s.close()
-
-            base_pkts = srv.stats.get("packets_received", 0)
-            base_metrics = srv.stats.get("metrics_processed", 0)
-            # device_costs is the process-global registry and reader
-            # thread names repeat per server, so the breakdown is a
-            # delta against this sweep step's starting counters
-            base_readers = srv.device_costs.snapshot().get(
-                "readers", {})
-            t = threading.Thread(target=blast, daemon=True)
-            t0 = time.perf_counter()
-            t.start()
-            time.sleep(duration)
-            stop.set()
-            t.join(10.0)
-            dt = time.perf_counter() - t0
-            time.sleep(0.5)
-            got_pkts = srv.stats.get("packets_received", 0) - base_pkts
-            got_metrics = (srv.stats.get("metrics_processed", 0) -
-                           base_metrics)
-            readers = srv.device_costs.snapshot().get("readers", {})
-            per_reader = {}
-            for name, r in sorted(readers.items()):
-                b = base_readers.get(name, {})
-                d = {k: r[k] - b.get(k, 0)
-                     for k in ("packets", "samples", "fused_batches",
-                               "batches")}
-                if d["batches"]:
-                    per_reader[name] = d
-            sweep[f"readers_{n_readers}"] = {
-                "seconds": round(dt, 3),
-                "offered_packets": sent[0],
-                "received_packets": got_pkts,
-                "packets_per_sec": round(got_pkts / dt, 1),
-                "metrics_per_sec": round(got_metrics / dt, 1),
-                "per_reader": per_reader,
-            }
-        finally:
-            srv.shutdown()
-    out["reader_sweep"] = sweep
+    # ---- backend axis: io_uring multishot ring vs recvmmsg on the
+    # same shapes, plus SO_REUSEPORT reader scaling (1/2/4) per
+    # backend on the fused shard path.  Loadgen still timeshares the
+    # host, so the sweep shows SCALING SHAPE, not isolated per-reader
+    # capacity; per_reader shows how evenly the kernel spread flows.
+    sweep: dict = {}
+    for backend in ("uring", "recvmmsg"):
+        if backend == "uring" and _uring_err != 0:
+            sweep[backend] = {
+                "skipped": True,
+                "reason": "probe refused: %s" %
+                          os.strerror(-_uring_err)}
+            continue
+        row: dict = {}
+        for label, lines_per_packet in (("single_line", 1),
+                                        ("batch_25", 25)):
+            row[label] = run_shape(backend, lines_per_packet, 1, 1)
+        for n_readers in (1, 2, 4):
+            row[f"readers_{n_readers}"] = run_shape(
+                backend, 25, n_readers, 8)
+        sweep[backend] = row
+    out["backend_sweep"] = sweep
+    uring_row = sweep.get("uring") or {}
+    if not uring_row.get("skipped"):
+        rm_row = sweep["recvmmsg"]
+        for label in ("single_line", "batch_25"):
+            out[f"uring_speedup_{label}"] = round(
+                uring_row[label]["packets_per_sec"] /
+                max(rm_row[label]["packets_per_sec"], 1.0), 2)
 
     # ---- burst->drain: the receive ceiling isolated from loadgen
     # timesharing.  On a 1-core host rate-vs-loss conflates sender
@@ -4028,6 +4041,18 @@ def _summary_line(out: dict) -> str:
     if out.get("flight_bundles") is not None:
         line["flight_bundles"] = out["flight_bundles"]
         line["signal_rows"] = out.get("signal_rows")
+    # sockets verdict: the ingest provenance stamps plus the headline
+    # rate and the uring-over-recvmmsg ratio, so the one-line record
+    # names what kernel/backend produced the number
+    if out.get("mode") == "sockets":
+        line["platform_pin"] = out.get("platform_pin")
+        line["kernel_release"] = out.get("kernel_release")
+        line["effective_rcvbuf"] = out.get("effective_rcvbuf")
+        line["ingest_backend"] = out.get("ingest_backend")
+        line["single_line_pkts_per_sec"] = out.get(
+            "single_line", {}).get("packets_per_sec")
+        line["uring_speedup_single_line"] = out.get(
+            "uring_speedup_single_line")
     return json.dumps(line, separators=(",", ":"))
 
 
@@ -4122,7 +4147,9 @@ if __name__ == "__main__":
     elif "--sockets" in sys.argv:
         # the server probes and falls back on its own; the pin (when
         # set) is honored via the module-top jax.config.update
-        print(json.dumps(sockets_bench()))
+        out = sockets_bench()
+        print(json.dumps(out))
+        print(_summary_line(out))
     elif "--tls" in sys.argv:
         print(json.dumps(tls_bench()))
     elif "--soak" in sys.argv:

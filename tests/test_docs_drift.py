@@ -373,3 +373,61 @@ def test_debug_endpoint_tuples_match_served_routes():
     for r in served_p:
         assert any(r.startswith(e)
                    for e in debughttp.PROXY_DEBUG_ENDPOINTS), r
+
+
+def test_ingest_backend_metrics_documented():
+    """ISSUE 17 names, pinned explicitly: backend fallback attribution
+    and provided-buffer pool exhaustion."""
+    for name in (
+            "veneur.socket.backend_fallback_total",
+            "veneur.socket.uring_enobufs_total",
+    ):
+        assert name in DOCS, name
+        assert any(name in (ROOT / m).read_text() for m in SCANNED), \
+            name
+
+
+def test_ingest_backend_env_vars_documented():
+    """ISSUE 17 knobs: backend selection, ring sizing, and reader
+    pinning must appear in the README env table, the performance doc
+    that explains the mechanism, AND the operations runbook that
+    explains the fallback contract."""
+    readme = (ROOT / "README.md").read_text()
+    perf = (ROOT / "docs" / "performance.md").read_text()
+    ops = (ROOT / "docs" / "operations.md").read_text()
+    for var in ("VENEUR_TPU_INGEST_BACKEND",
+                "VENEUR_TPU_URING_BUFFERS",
+                "VENEUR_TPU_READER_PIN_CORES"):
+        assert var in readme, var
+        assert var in perf, var
+        assert var in ops, var
+
+
+def test_performance_doc_covers_kernel_ingest():
+    """The 'Kernel-efficient ingest' section: the backend matrix, the
+    probe ladder, the truncation contract, and the fallback metric."""
+    perf = (ROOT / "docs" / "performance.md").read_text()
+    for needle in (
+            "Kernel-efficient ingest",
+            "multishot",
+            "recvmmsg",
+            "veneur.socket.backend_fallback_total",
+            "veneur.socket.uring_enobufs_total",
+            "metric_max_length",
+    ):
+        assert needle in perf, needle
+
+
+def test_operations_runbook_covers_ingest_backend():
+    """The ingest-backend runbook section: tier table, the
+    never-costs-a-reader contract, and the memlock/sysctl hints."""
+    ops = (ROOT / "docs" / "operations.md").read_text()
+    for needle in (
+            "socket ingest backend",
+            "a backend failure never costs a reader",
+            "veneur.socket.backend_fallback_total",
+            "veneur.socket.uring_enobufs_total",
+            "io_uring_disabled",
+            "ulimit -l",
+    ):
+        assert needle in ops, needle

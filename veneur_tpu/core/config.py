@@ -309,6 +309,31 @@ class Config:
     # VENEUR_TPU_MULTI_READER_FUSED=0 falls back to the split
     # parse-then-ingest_columns path.
     tpu_multi_reader_fused: bool = True
+    # ingest backend for the UDP reader drain tier: "uring" walks an
+    # io_uring multishot-receive completion ring straight into the
+    # fused native parse (zero syscalls per packet, zero copies
+    # before parse), "recvmmsg" is the bulk-drain syscall tier,
+    # "python" the per-packet recv loop.  "auto" picks uring iff the
+    # startup probe shows the kernel grants it (io_uring + provided
+    # buffer rings + multishot recv, i.e. >= 6.0 and not denied by
+    # seccomp/sysctl), else recvmmsg.  Runtime failures fall back one
+    # tier with a named counter rather than dropping the reader.
+    # VENEUR_TPU_INGEST_BACKEND overrides.
+    tpu_ingest_backend: str = "auto"
+    # provided-buffer pool size per reader ring (power of two).  Each
+    # buffer holds one datagram of up to metric_max_length bytes, so
+    # the pool is also the max completion batch one parse pass can
+    # consume — bigger pools amortize the per-batch Python round
+    # further but pin more memory (buffers * (metric_max_length+1)).
+    # VENEUR_TPU_URING_BUFFERS overrides.
+    tpu_uring_buffers: int = 2048
+    # per-reader CPU core pinning: "auto" pins reader i to core
+    # i % cpu_count when there are at least as many cores as readers
+    # (each shard's ring, pool and parse scratch stay on one core),
+    # "off" never pins, or an explicit comma list like "2,3,4,5"
+    # assigns reader i to the i-th listed core.
+    # VENEUR_TPU_READER_PIN_CORES overrides.
+    tpu_reader_pin_cores: str = "auto"
     # compile every canonical kernel shape at startup (against a
     # scratch table) so the first flush interval doesn't eat the XLA
     # compiles; off by default because it adds seconds to process
@@ -595,6 +620,27 @@ class Config:
                 "yes", "no"):
             problems.append(
                 "tpu_collective_import must be auto, on or off")
+        if self.tpu_ingest_backend not in ("auto", "uring",
+                                           "recvmmsg", "python"):
+            problems.append(
+                "tpu_ingest_backend must be auto, uring, recvmmsg "
+                "or python")
+        if self.tpu_uring_buffers < 2 or \
+                self.tpu_uring_buffers > 32768 or \
+                self.tpu_uring_buffers & (self.tpu_uring_buffers - 1):
+            problems.append(
+                "tpu_uring_buffers must be a power of two in "
+                "[2, 32768]")
+        pin = self.tpu_reader_pin_cores
+        if pin not in ("auto", "off"):
+            try:
+                cores = [int(c) for c in pin.split(",") if c.strip()]
+                if not cores or any(c < 0 for c in cores):
+                    raise ValueError
+            except ValueError:
+                problems.append(
+                    "tpu_reader_pin_cores must be auto, off or a "
+                    "comma list of core ids")
         if "," in self.forward_address and not self.tpu_sharded_global:
             problems.append(
                 "multiple forward_address members need "

@@ -329,6 +329,7 @@ class Server:
         # cumulative drop count from /proc/net/udp at the previous
         # sample, so each interval records only the delta
         self._kernel_drops_last: dict[int, int] = {}
+        self._uring_enobufs_last = 0
 
         self.events: list[dsd.Event] = []
         self.checks: list[dsd.ServiceCheck] = []
@@ -414,6 +415,16 @@ class Server:
         # live listener sockets by cloak slot name, for handing down
         # to a replacement (fdpass.send_sockets / encode_cloak)
         self._cloak_slots: dict[str, socket.socket] = {}
+        # ingest backend tier (ISSUE 17): resolved once at listener
+        # startup — "uring" iff the probe shows the kernel grants the
+        # multishot provided-buffer receive, else "recvmmsg"/"python".
+        # A reader whose ring dies at runtime drops itself to the
+        # recvmmsg tier (never exits), bumping the named fallback
+        # counter; _urings tracks live rings for /debug/vars.
+        self.ingest_backend: str | None = None
+        self._uring_probe_err = 0
+        self._backend_fallback_logged = False
+        self._urings: dict[str, object] = {}
         self.incarnation = 0
         self._checkpointer = None
         if config.checkpoint_enabled():
@@ -698,7 +709,15 @@ class Server:
         self._kernel_drops_last = cur
         if delta:
             self.bump("socket_kernel_drops", delta)
-        return delta
+        # uring buffer-pool exhaustion is the same failure at a new
+        # site — a packet arrived, no buffer could land it — so its
+        # delta rides the identical pressure input (cumulative in
+        # stats[socket_uring_enobufs], delta into overload.tick)
+        with self._stats_lock:
+            eb = self.stats.get("socket_uring_enobufs", 0)
+        eb_delta = max(0, eb - self._uring_enobufs_last)
+        self._uring_enobufs_last = eb
+        return delta + eb_delta
 
     def handle_packet(self, data: bytes) -> None:
         """Parse one datagram (possibly multi-line) into the table
@@ -1003,9 +1022,77 @@ class Server:
                 self.bump("recovery_errors")
                 log.exception("checkpoint recovery failed")
 
+    def _resolve_ingest_backend(self) -> str:
+        """Resolve tpu_ingest_backend ("auto" probes the kernel) to
+        the tier the readers will actually run: uring / recvmmsg /
+        python.  Cached — the answer cannot change in-process."""
+        if self.ingest_backend is not None:
+            return self.ingest_backend
+        from veneur_tpu import native as native_mod
+        from veneur_tpu.native import uring as uring_mod
+        mode = getattr(self.config, "tpu_ingest_backend", "auto")
+        lib = native_mod.load()
+        if lib is None or mode == "python":
+            self.ingest_backend = "python"
+            return self.ingest_backend
+        if mode == "recvmmsg":
+            self.ingest_backend = "recvmmsg"
+            return self.ingest_backend
+        err = uring_mod.probe(lib)
+        self._uring_probe_err = err
+        if err == 0:
+            self.ingest_backend = "uring"
+        else:
+            # auto or explicit uring on a kernel that refuses: land
+            # on the recvmmsg tier with the named counter — an
+            # explicit request degrading silently is how ENOSYS
+            # becomes a 3am packet-loss mystery
+            self.ingest_backend = "recvmmsg"
+            self._note_backend_fallback(
+                uring_mod.probe_reason(err),
+                "startup probe refused (%s)" % os.strerror(-err))
+        return self.ingest_backend
+
+    def _note_backend_fallback(self, reason: str, detail: str) -> None:
+        """Count (by reason) and log-once a uring->recvmmsg drop."""
+        self.bump("socket_backend_fallback")
+        self.bump(f"socket_backend_fallback_{reason}")
+        if not self._backend_fallback_logged:
+            self._backend_fallback_logged = True
+            log.warning("io_uring ingest unavailable: %s; readers "
+                        "run the recvmmsg drain tier", detail)
+
+    def _pin_reader_core(self, index: int) -> None:
+        """Pin this reader thread to one CPU so its ring, buffer pool
+        and parse scratch stay core-local (tpu_reader_pin_cores:
+        "auto" = reader i -> core i%N when cores >= readers, "off",
+        or an explicit comma list)."""
+        pin = getattr(self.config, "tpu_reader_pin_cores", "auto")
+        if pin == "off" or not hasattr(os, "sched_setaffinity"):
+            return
+        try:
+            avail = sorted(os.sched_getaffinity(0))
+            if pin == "auto":
+                n = max(1, self.config.num_readers)
+                if len(avail) < n:
+                    return  # oversubscribed: pinning would stack
+                core = avail[index % len(avail)]
+            else:
+                cores = [int(c) for c in pin.split(",") if c.strip()]
+                core = cores[index % len(cores)]
+                if core not in avail:
+                    return
+            os.sched_setaffinity(0, {core})
+        except (OSError, ValueError):
+            pass  # pinning is an optimization, never a failure
+
     def _start_statsd(self, addr: str, index: int = 0) -> None:
         scheme, host, port, path = parse_addr(addr)
         if scheme == "udp":
+            # resolve (and probe, under "auto") the drain tier before
+            # the readers spawn, so /debug/vars never shows None and
+            # a probe-refused fallback is counted exactly once
+            self._resolve_ingest_backend()
             n = max(1, self.config.num_readers)
             for i in range(n):
                 slot = f"statsd.udp.{index}.{i}"
@@ -1037,7 +1124,7 @@ class Server:
                 self._sockets.append(sock)
                 self._cloak_slots[slot] = sock
                 t = threading.Thread(target=self._crashguard(self._udp_reader),
-                                     args=(sock, "dogstatsd-udp"),
+                                     args=(sock, "dogstatsd-udp", i),
                                      daemon=True,
                                      name=f"udp-reader-{i}")
                 t.start()
@@ -1242,7 +1329,8 @@ class Server:
         self.span_worker.submit(span)
 
     def _udp_reader(self, sock: socket.socket,
-                    proto: str = "dogstatsd-udp") -> None:
+                    proto: str = "dogstatsd-udp",
+                    reader_index: int = 0) -> None:
         """Blocking datagram read loop (reference server.go:1240
         ReadMetricSocket).
 
@@ -1251,24 +1339,46 @@ class Server:
         then non-blocking sweep) and pushes the whole batch through one
         parse + one lock acquisition — the TPU-shaped replacement for
         the reference's per-packet goroutine hop (server.go:1152).
+
+        On the "uring" backend tier the loop above is replaced
+        entirely: a multishot io_uring receive completes into a
+        kernel-provided buffer pool and the fused parse reads the
+        datagrams IN PLACE there (no recv syscall, no join/copy).  A
+        ring that dies at runtime drops this reader HERE, to the
+        recvmmsg tier below — the reader never exits over it.
         """
+        self._pin_reader_core(reader_index)
         bufsize = self.config.metric_max_length + 1
         # one parser per reader thread (scratch buffers are reused
         # across calls, so sharing would race)
         parser = columnar.ColumnarParser()
         if not parser.available:
             parser = None
+        backend = self._resolve_ingest_backend()
         # multi-reader fused ingest: a per-reader shard runs the fused
         # parse+probe+combine C pass lock-free against private scratch
         # (index probes are RCU-safe), holding self.lock only for the
         # miss-resolve + O(touched-rows) merge.  Single-reader servers
-        # keep the whole-pass-under-lock path (nothing contends).
+        # keep the whole-pass-under-lock path (nothing contends) —
+        # except on the uring tier, whose zero-copy parse IS the
+        # shard pass, so every uring reader gets one.
+        want_shard = (self.config.num_readers > 1 and
+                      getattr(self.config, "tpu_multi_reader_fused",
+                              True))
+        uring_ok = (backend == "uring" and proto == "dogstatsd-udp"
+                    and sock.family == socket.AF_INET)
         shard = None
-        if (parser is not None and self.config.num_readers > 1 and
-                getattr(self.config, "tpu_multi_reader_fused", True)):
+        if parser is not None and (want_shard or uring_ok):
             make = getattr(self.table, "make_reader_shard", None)
             if make is not None:
                 shard = make()
+        if uring_ok and shard is not None:
+            if self._uring_reader(sock, proto, parser, shard):
+                return  # clean shutdown on the ring
+            # ring refused or died: fall through to recvmmsg, with
+            # the shard only if the multi-reader path wants one
+            if not want_shard:
+                shard = None
         max_batch = self.config.reader_batch_packets
         # native bulk drain: one recvmmsg syscall per batch instead of
         # one recv + bytes object per packet (see vtpu_recv_drain);
@@ -1314,10 +1424,16 @@ class Server:
                     drained = drain_buf[:nbytes].tobytes()
                     n_pkts += int(drain_n.value)
                 if drain_over.value:
-                    # received but rejected: both counters move, as on
-                    # the blocking path
-                    n_pkts += int(drain_over.value)
-                    self.bump("packet_errors", int(drain_over.value))
+                    # received but rejected whole (MSG_TRUNC: parsing
+                    # the clipped tail could yield a valid WRONG
+                    # value): both counters move as on the blocking
+                    # path, and the ledger attributes the packet as a
+                    # parse error so truncation is never silent
+                    n_over = int(drain_over.value)
+                    n_pkts += n_over
+                    self.bump("packet_errors", n_over)
+                    self.ledger.ingest("dogstatsd",
+                                       parse_errors=n_over)
             # (no native drain — library without the symbol, e.g. a
             # stale cached .so: packets process one per loop; a
             # MSG_DONTWAIT sweep would BLOCK on the timeout socket,
@@ -1331,6 +1447,155 @@ class Server:
                 threading.current_thread().name, n_pkts, processed,
                 time.monotonic_ns() - t0, fused=shard is not None)
             self.bump(f"received_{proto}", n_pkts)
+
+    def _uring_reader(self, sock: socket.socket, proto: str,
+                      parser, shard) -> bool:
+        """io_uring multishot drain tier: returns True on clean
+        shutdown, False when the ring could not be built or died at
+        runtime (the caller continues this reader on the recvmmsg
+        tier — a backend failure must never cost a reader).
+
+        Steady state is zero syscalls per packet and zero copies
+        before parse: the kernel lands datagrams in the ring's buffer
+        pool while the previous batch parses, and the fused pass
+        reads them in place (ReaderShard.parse_ring).  When overload
+        admission is active the ring degrades to a copy-out drain
+        through handle_packet_batch, whose columnar branch carries
+        the vectorized admission check.
+        """
+        from veneur_tpu import native as native_mod
+        from veneur_tpu.native import uring as uring_mod
+        lib = native_mod.load()
+        c = self.config
+        bufsize = c.metric_max_length + 1
+        try:
+            ring = uring_mod.UringReader(
+                lib, sock.fileno(),
+                int(getattr(c, "tpu_uring_buffers", 2048)), bufsize)
+        except (uring_mod.UringError, ValueError) as e:
+            reason = getattr(e, "reason", "error")
+            self._note_backend_fallback(
+                reason, "ring setup failed (%s)" % e)
+            return False
+        name = threading.current_thread().name
+        self._urings[name] = ring
+        drain_buf = np.empty(
+            min(ring.buf_count, 512) * (bufsize + 1), np.uint8)
+        # cap each walk at half the pool: the zero-copy pass holds
+        # its buffers through commit, and a round that held them all
+        # would starve the multishot into an ENOBUFS termination on
+        # every cycle.  Half in flight, half landing keeps the recv
+        # armed continuously.
+        max_msgs = max(1, ring.buf_count // 2)
+        # adaptive batch pooling: under load, ask the kernel to
+        # accumulate completions before waking us (one walk over
+        # hundreds of datagrams instead of a wakeup per arrival);
+        # at a trickle, wake per packet so latency stays flat.  The
+        # previous round's size is the load signal.
+        wait_batch = 1
+        max_batch = min(max_msgs, 512)
+        try:
+            while not self._shutdown.is_set():
+                try:
+                    adm = (self.overload is not None
+                           and self.overload.admission_active)
+                    if adm:
+                        # admission needs a contiguous buffer for the
+                        # columnar shed pass: one copy, same backend
+                        nbytes, n_msgs, n_over, n_eb = ring.drain(
+                            drain_buf, min(max_msgs, 512),
+                            bufsize - 1,
+                            50 if wait_batch > 1 else 1000,
+                            wait_batch)
+                        self._uring_batch_stats(proto, n_over, n_eb)
+                        wait_batch = min(max_batch,
+                                         max(1, n_msgs // 2))
+                        if n_msgs == 0:
+                            continue
+                        t0 = time.monotonic_ns()
+                        processed = self.handle_packet_batch(
+                            [], parser,
+                            drained=drain_buf[:nbytes].tobytes(),
+                            drained_pkts=n_msgs, shard=None)
+                        self.device_costs.add_reader_batch(
+                            name, n_msgs, processed,
+                            time.monotonic_ns() - t0, fused=False)
+                        self.bump(f"received_{proto}", n_msgs)
+                        continue
+                    t0 = time.monotonic_ns()
+                    nbytes, n_msgs, n_over, n_eb = shard.parse_ring(
+                        ring, max_msgs, bufsize - 1,
+                        50 if wait_batch > 1 else 1000, wait_batch)
+                    wait_batch = min(max_batch, max(1, n_msgs // 2))
+                    self._uring_batch_stats(proto, n_over, n_eb)
+                    if n_msgs == 0:
+                        continue
+                    self.bump("packets_received", n_msgs)
+                    with self.lock:
+                        processed, dropped, others = shard.commit()
+                        self.ledger.ingest(
+                            "dogstatsd", processed=processed,
+                            staged=processed - dropped,
+                            overflow=dropped)
+                        work = self._maybe_device_step_locked()
+                    self._apply_staged(work)
+                    shard.reset()  # scrub local scratch off the lock
+                    # slow-path lines point into commit's source
+                    # (the arena, or the replay buffer on the rare
+                    # epoch-fallback): slice them out BEFORE release
+                    # hands the arena buffers back to the kernel
+                    src = shard.last_slow_src
+                    if isinstance(src, (bytes, bytearray)):
+                        slow = [src[off:off + ln]
+                                for off, ln, _kind in others]
+                    else:
+                        slow = [src[off:off + ln].tobytes()
+                                for off, ln, _kind in others]
+                    ring.release()
+                    errors = 0
+                    for line in slow:
+                        try:
+                            parsed = dsd.parse_line(line)
+                        except dsd.ParseError:
+                            errors += 1
+                            continue
+                        p, d = self.ingest_parsed(parsed, bump=False)
+                        processed += p
+                        dropped += d
+                    if errors:
+                        self.bump("packet_errors", errors)
+                        self.ledger.ingest("dogstatsd",
+                                           parse_errors=errors)
+                    if processed:
+                        self.bump("metrics_processed", processed)
+                    if dropped:
+                        self.bump("metrics_dropped", dropped)
+                    self.device_costs.add_reader_batch(
+                        name, n_msgs, processed,
+                        time.monotonic_ns() - t0, fused=True)
+                    self.bump(f"received_{proto}", n_msgs)
+                except uring_mod.UringError as e:
+                    self._note_backend_fallback(
+                        e.reason, "ring died at runtime (%s)" % e)
+                    return False
+        finally:
+            self._urings.pop(name, None)
+            ring.close()
+        return True
+
+    def _uring_batch_stats(self, proto: str, n_over: int,
+                           n_eb: int) -> None:
+        """Oversize + ENOBUFS accounting shared by both ring modes:
+        oversize datagrams were received-then-rejected whole (the
+        ledger sees them as parse errors, like MSG_TRUNC on the
+        recvmmsg tier); ENOBUFS completions are kernel-side drops at
+        the pool boundary, observed like /proc/net/udp drops."""
+        if n_over:
+            self.bump(f"received_{proto}", n_over)
+            self.bump("packet_errors", n_over)
+            self.ledger.ingest("dogstatsd", parse_errors=n_over)
+        if n_eb:
+            self.bump("socket_uring_enobufs", n_eb)
 
     def handle_packet_batch(self, packets: list[bytes], parser,
                             drained: bytes | None = None,
@@ -1675,6 +1940,28 @@ class Server:
                                 "socket_kernel_drops", 0),
                             "by_inode": dict(
                                 server._kernel_drops_last),
+                            # resolved ingest drain tier (None until
+                            # the first reader starts) and the
+                            # startup probe's -errno when refused
+                            "backend": server.ingest_backend,
+                            "uring_probe_errno":
+                                -server._uring_probe_err,
+                            "backend_fallback_total": stats.get(
+                                "socket_backend_fallback", 0),
+                            # ENOBUFS completions: packets the kernel
+                            # dropped at the provided-buffer pool
+                            # boundary (pressure input, like
+                            # kernel_drops_total)
+                            "uring_enobufs_total": stats.get(
+                                "socket_uring_enobufs", 0),
+                            # per-reader ring health: pool occupancy
+                            # (kernel-held vs parse-held buffers), cq
+                            # backlog, completion-batch histogram
+                            "uring": {
+                                name: ring.stats()
+                                for name, ring in
+                                sorted(server._urings.items())
+                            } or None,
                         },
                         # crash-riding lifecycle: when this process
                         # started, its checkpoint incarnation id, and

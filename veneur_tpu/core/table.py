@@ -2461,6 +2461,8 @@ class ReaderShard:
         self._cols: dict | None = None  # per-line columns, grow-only
         self._meta = np.zeros(12, np.int64)
         self._buf: bytes | None = None
+        self._ring = None  # UringReader when fed by parse_ring
+        self.last_slow_src = None  # what commit's offsets index
         self._epoch = -1
         # rows commit() merged, for the off-lock zeroing in reset()
         self._zc = self._zg = self._zh = self._zs = None
@@ -2496,6 +2498,7 @@ class ReaderShard:
         t = self.table
         buf_b = bytes(buf) if not isinstance(buf, bytes) else buf
         self._buf = buf_b
+        self._ring = None
         # epoch BEFORE the probe pass: if compaction lands during the
         # pass, commit sees the bumped epoch and discards
         self._epoch = t._reindex_epoch
@@ -2526,6 +2529,65 @@ class ReaderShard:
             p(sc["ok"], u8p),
             p(meta, ct.c_int64))
 
+    def parse_ring(self, ring, max_msgs: int, max_len: int,
+                   wait_ms: int, wait_batch: int = 1
+                   ) -> tuple[int, int, int, int]:
+        """Lock-free fused parse straight from an io_uring buffer
+        pool (``veneur_tpu.native.uring.UringReader``): waits up to
+        wait_ms for completions, then parses each datagram IN PLACE
+        in the ring arena — no recv syscall, no join/copy round.
+        ``wait_batch`` > 1 asks the kernel to pool that many
+        completions before waking us (the multishot batching lever —
+        under load it turns per-arrival wakeups into one walk over
+        hundreds of datagrams).  Miss/slow offsets index the arena;
+        the buffers backing them stay held until ``ring.release()``,
+        which the caller runs AFTER commit.  Returns (payload_bytes,
+        n_msgs, n_oversize, n_enobufs); raises UringError when the
+        ring is dead and the caller must fall back to the recvmmsg
+        tier."""
+        import ctypes as ct
+        from ..native.uring import UringError
+        t = self.table
+        self._buf = None
+        self._ring = ring
+        # epoch BEFORE the probe pass, same as parse()
+        self._epoch = t._reindex_epoch
+        # scratch sized for the recvmmsg tier's worst case; the C
+        # side stops consuming completions before the worst-case
+        # line count could overrun it
+        sc = self._ensure_cols(8192)
+        meta = self._meta
+        meta[:] = 0
+        io_out = ring.io_out
+        io_out[:] = 0
+
+        def p(a, ty):
+            return a.ctypes.data_as(ct.POINTER(ty))
+
+        u8p = ct.c_uint8
+        nbytes = t._lib.vtpu_uring_parse_ingest(
+            ring.handle, max_msgs, max_len, wait_ms, wait_batch,
+            len(sc["hr"]), t.key_index.handle, hashing.HLL_P,
+            p(self._c_dense, ct.c_double), p(self._c_touch, u8p),
+            p(self._g_dense, ct.c_float), p(self._g_mask, u8p),
+            p(self._g_touch, u8p),
+            p(sc["hr"], ct.c_int32), p(sc["hv"], ct.c_float),
+            p(sc["hw"], ct.c_float), p(self._h_touch, u8p),
+            p(sc["sr"], ct.c_int32), p(sc["sp"], ct.c_int32),
+            p(self._s_touch, u8p),
+            p(sc["mk"], ct.c_uint64), p(sc["mt"], u8p),
+            p(sc["mv"], ct.c_double), p(sc["mm"], ct.c_uint64),
+            p(sc["mw"], ct.c_float),
+            p(sc["mo"], ct.c_int64), p(sc["ml"], ct.c_int32),
+            p(sc["oo"], ct.c_int64), p(sc["ol"], ct.c_int32),
+            p(sc["ok"], u8p),
+            p(meta, ct.c_int64), p(io_out, ct.c_int32))
+        if nbytes < 0:
+            self._ring = None
+            raise UringError(int(nbytes), "io_uring parse")
+        return (int(nbytes), int(io_out[0]), int(io_out[1]),
+                int(io_out[2]))
+
     def commit(self) -> tuple[int, int, list[tuple[int, int, int]]]:
         """Locked merge half — the caller MUST hold the same lock
         that serializes every other table mutation.  Returns
@@ -2535,10 +2597,20 @@ class ReaderShard:
         if self._epoch != t._reindex_epoch:
             # rows renumbered under us: local combines used stale row
             # ids.  Drop them and run the raw buffer through the
-            # locked single-reader fused path.
-            buf = self._buf
+            # locked single-reader fused path.  On the ring path the
+            # raw bytes only exist as held pool buffers — materialize
+            # them first (rare: one copy per compaction, not per
+            # batch).
+            if self._ring is not None:
+                buf = self._ring.pending_copy()
+            else:
+                buf = self._buf
             self._discard()
-            return t.ingest_buffer(buf)
+            out = t.ingest_buffer(buf)
+            # slow-path offsets now index the replay buffer, not the
+            # ring arena — callers slice last_slow_src either way
+            self.last_slow_src = buf
+            return out
         sc, meta = self._cols, self._meta
 
         def p(a, ty):
@@ -2547,7 +2619,13 @@ class ReaderShard:
         u8p = ct.c_uint8
         n_miss = int(meta[2])
         if n_miss:
-            buf_np = np.frombuffer(self._buf, np.uint8)
+            # miss offsets index the parse source: the joined batch
+            # buffer, or (ring path) the io_uring arena the held
+            # buffers live in
+            if self._ring is not None:
+                buf_np = self._ring.arena
+            else:
+                buf_np = np.frombuffer(self._buf, np.uint8)
             shim = _MissLines(buf_np, sc["mo"], sc["ml"], sc["mt"])
             t._resolve_misses(shim, np.arange(n_miss),
                               sc["mk"][:n_miss])
@@ -2613,7 +2691,12 @@ class ReaderShard:
         others = [(int(sc["oo"][i]), int(sc["ol"][i]),
                    int(sc["ok"][i])) for i in range(n_other)]
         self._zc, self._zg, self._zh, self._zs = cr, gr, hr_t, sr_t
+        # what the returned slow-path offsets index: the ring arena
+        # on the parse_ring path, else the parsed bytes buffer
+        self.last_slow_src = (self._ring.arena
+                              if self._ring is not None else self._buf)
         self._buf = None
+        self._ring = None
         return processed, dropped, others
 
     def reset(self) -> None:
@@ -2642,4 +2725,5 @@ class ReaderShard:
         self._h_touch.fill(0)
         self._s_touch.fill(0)
         self._buf = None
+        self._ring = None
         self._zc = self._zg = self._zh = self._zs = None

@@ -481,6 +481,17 @@ class Telemetry:
               self._delta("flush_coalesced"))
         count("veneur.socket.kernel_drops_total",
               self._delta("socket_kernel_drops"))
+        # io_uring ingest tier health: uring->recvmmsg fallbacks by
+        # reason (probe refused / ring died at runtime) and
+        # buffer-pool-exhaustion drops at the kernel boundary
+        for reason in ("enosys", "eperm", "enomem", "einval",
+                       "error"):
+            d = self._delta(f"socket_backend_fallback_{reason}")
+            if d:
+                count("veneur.socket.backend_fallback_total", d,
+                      (f"reason:{reason}",))
+        count("veneur.socket.uring_enobufs_total",
+              self._delta("socket_uring_enobufs"))
         # signal-history plane + anomaly flight recorder
         # (observe/signals.py / observe/recorder.py): rows sampled
         # into the columnar ring, and incident bundles dumped —

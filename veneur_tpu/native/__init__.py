@@ -179,6 +179,35 @@ def _bind(lib: ctypes.CDLL) -> None:
         u64p, u8p, f64p, u64p, f32p, i64p, i32p,
         i64p, i32p, u8p,
         i64p]
+    # io_uring multishot ring ingest (stubs on non-Linux / old
+    # toolchains: probe returns -ENOSYS, new fails — same symbols)
+    lib.vtpu_uring_probe.restype = i64
+    lib.vtpu_uring_probe.argtypes = []
+    lib.vtpu_uring_new.restype = vp
+    lib.vtpu_uring_new.argtypes = [
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, u8p, i64p]
+    lib.vtpu_uring_free.restype = None
+    lib.vtpu_uring_free.argtypes = [vp]
+    lib.vtpu_uring_stats.restype = None
+    lib.vtpu_uring_stats.argtypes = [vp, i64p]
+    lib.vtpu_uring_drain.restype = i64
+    lib.vtpu_uring_drain.argtypes = [
+        vp, u8p, i64, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32, i32p, i32p, i32p]
+    lib.vtpu_uring_parse_ingest.restype = i64
+    lib.vtpu_uring_parse_ingest.argtypes = [
+        vp, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32, vp, i64,
+        f64p, u8p, f32p, u8p, u8p,
+        i32p, f32p, f32p, u8p,
+        i32p, i32p, u8p,
+        u64p, u8p, f64p, u64p, f32p, i64p, i32p,
+        i64p, i32p, u8p,
+        i64p, i32p]
+    lib.vtpu_uring_pending_copy.restype = i64
+    lib.vtpu_uring_pending_copy.argtypes = [vp, u8p, i64]
+    lib.vtpu_uring_release.restype = i64
+    lib.vtpu_uring_release.argtypes = [vp]
     lib.vtpu_metriclist_decode.restype = i64
     lib.vtpu_metriclist_decode.argtypes = [
         u8p, i64, i64, i64, i64,
