@@ -96,7 +96,14 @@ def _backend_info() -> dict:
     """Platform stamp for artifacts: what backend did THIS process
     actually run on.  A CPU capture must be unmistakable for a device
     capture — the platform/device_kind travel with every number."""
+    # provenance floor (ISSUE 18): kernel + core count travel with
+    # EVERY artifact, not just --sockets — round artifacts with
+    # platform_pin: null and no host stamp were unreviewable, and
+    # cpu_count decides whether any multi-process ratio on the
+    # capture host is meaningful at all
     info: dict = {"platform_pin": _PLATFORM_PIN or None,
+                  "kernel_release": os.uname().release,
+                  "cpu_count": os.cpu_count(),
                   "gates": dict(_GATES)}
     try:
         # "auto" resolves per backend; the artifact records what ran.
@@ -2598,6 +2605,317 @@ def cluster_bench() -> dict:
     return out
 
 
+# Worker for --collective-forward: one process of the N-local x
+# M-global gloo mesh.  Locals run the gRPC-wire oracle phase
+# (rows_to_metric_list -> real loopback gRPC -> global's ImportServer)
+# then the collective phase (pack_block -> ONE all_to_all -> global's
+# apply_collective_blocks); phases are bracketed by empty-rendezvous
+# barriers so each phase's wall clock covers delivery-to-staged on
+# every process.  Same spawn/skip shape as tests/test_distributed_fold.
+_COLLECTIVE_WORKER = r"""
+import json, os, sys, time
+pid = int(sys.argv[1]); port = sys.argv[2]
+n_locals = int(sys.argv[3]); n_globals = int(sys.argv[4])
+gports = [int(p) for p in sys.argv[5].split(",")]
+cycles = int(sys.argv[6]); rows_per_dest = int(sys.argv[7])
+max_rows = int(sys.argv[8]); key_bytes = int(sys.argv[9])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["VENEUR_TPU_DIST_COORDINATOR"] = f"127.0.0.1:{port}"
+os.environ["VENEUR_TPU_DIST_NUM_PROCS"] = str(n_locals + n_globals)
+os.environ["VENEUR_TPU_DIST_PROCESS_ID"] = str(pid)
+
+from veneur_tpu.parallel import sharded
+assert sharded.init_process_mesh()
+import jax
+assert jax.process_count() == n_locals + n_globals
+
+import numpy as np
+from veneur_tpu.core.flusher import ForwardRow
+from veneur_tpu.core.table import RowMeta
+from veneur_tpu.forward.collective import CollectiveTransport
+from veneur_tpu.ops import hll, tdigest
+from veneur_tpu.parallel import collective_forward as cplanes
+from veneur_tpu.protocol import dogstatsd as dsd
+
+COMP = float(tdigest.DEFAULT_COMPRESSION)
+schema = cplanes.PlaneSchema(compression=COMP, max_rows=max_rows,
+                             key_bytes=key_bytes)
+peers = {f"127.0.0.1:{gp}": n_locals + j
+         for j, gp in enumerate(gports)}
+
+
+def meta(name, mtype, tags=()):
+    return RowMeta(name=name, tags=tuple(tags),
+                   scope=dsd.SCOPE_DEFAULT, type=mtype)
+
+
+def dest_rows(local_id, dest_id):
+    # production-ish mix per destination: counter/timer dominated
+    # (the reference's shape), a few sets
+    rng = np.random.default_rng(1000 * local_id + dest_id)
+    C = schema.centroids
+    n_set = max(1, rows_per_dest // 16)
+    n_histo = rows_per_dest // 5
+    n_gauge = rows_per_dest * 3 // 20
+    n_counter = rows_per_dest - n_set - n_histo - n_gauge
+    rows = []
+    pre = f"cf.{local_id}.{dest_id}"
+    for i in range(n_counter):
+        rows.append(ForwardRow(
+            meta(f"{pre}.c{i}", dsd.COUNTER, (f"k:{i % 7}",)),
+            "counter", value=float(i % 97 + 1)))
+    for i in range(n_gauge):
+        rows.append(ForwardRow(
+            meta(f"{pre}.g{i}", dsd.GAUGE), "gauge",
+            value=float(rng.normal() * 100)))
+    for i in range(n_histo):
+        k = int(rng.integers(8, 64))
+        means = np.zeros(C, np.float32)
+        weights = np.zeros(C, np.float32)
+        means[:k] = rng.normal(size=k).astype(np.float32) * 50
+        weights[:k] = rng.integers(1, 9, size=k).astype(np.float32)
+        vals = means[:k].astype(np.float64)
+        w = weights[:k].astype(np.float64)
+        stats = np.array([w.sum(), vals.min(), vals.max(),
+                          (vals * w).sum(),
+                          (1.0 / np.abs(vals + 100.0)).sum()],
+                         np.float32)
+        rows.append(ForwardRow(
+            meta(f"{pre}.h{i}", dsd.HISTOGRAM, ("t:h",)), "histo",
+            stats=stats, means=means, weights=weights))
+    for i in range(n_set):
+        regs = rng.integers(0, 16, size=hll.M).astype(np.uint8)
+        rows.append(ForwardRow(
+            meta(f"{pre}.s{i}", dsd.SET), "set", regs=regs))
+    return rows
+
+
+t_perf = time.perf_counter
+
+if pid < n_locals:
+    from veneur_tpu.forward.grpc_forward import (ForwardClient,
+                                                 rows_to_metric_list)
+    groups = {d: dest_rows(pid, j) for j, d in enumerate(peers)}
+    tr = CollectiveTransport(schema, peers=peers, deadline=300.0)
+    clients = {d: ForwardClient(d, timeout=60.0, compression=COMP)
+               for d in peers}
+    # ---- gRPC-wire oracle phase (barrier / timed / barrier) ----
+    tr.exchange_empty(None)
+    t0 = t_perf(); ser_s = 0.0
+    for _ in range(cycles):
+        for d, rows in groups.items():
+            s0 = t_perf()
+            body = rows_to_metric_list(
+                rows, COMP).SerializeToString()
+            ser_s += t_perf() - s0
+            clients[d].send_wire(body)
+    tr.exchange_empty(None)
+    wire_wall = t_perf() - t0
+    # ---- collective phase ----
+    tr.exchange_empty(None)
+    t0 = t_perf()
+    for _ in range(cycles):
+        sent, rejected, landed = tr.send_cycle(groups)
+        assert not rejected, f"{len(rejected)} rows rejected"
+    tr.exchange_empty(None)
+    coll_wall = t_perf() - t0
+    res = {"role": "local", "pid": pid,
+           "wire_wall_s": wire_wall, "coll_wall_s": coll_wall,
+           "serialize_s": ser_s,
+           "pack_s": tr.counters["pack_ns"] / 1e9,
+           "exchange_s": tr.counters["exchange_ns"] / 1e9,
+           "fallback_cycles": tr.counters["fallback_cycles"],
+           "sent_rows": tr.counters["sent_rows"]}
+    for c in clients.values():
+        c.close()
+    tr.stop()
+else:
+    from veneur_tpu.core.config import read_config
+    from veneur_tpu.core.server import Server
+    my_port = gports[pid - n_locals]
+    srv = Server(read_config(data={
+        "grpc_listen_addresses": [f"tcp://127.0.0.1:{my_port}"],
+        "statsd_listen_addresses": [],
+        "interval": "10s", "hostname": f"cfg{pid}",
+        "tpu_collective_forward": "on",
+        "tpu_collective_max_rows": max_rows,
+        "tpu_collective_key_bytes": key_bytes}))
+    srv.start()
+    tr = srv._collective_transport()
+    # ---- wire phase: serve RPCs between the barriers ----
+    tr.exchange_empty(None)
+    tr.exchange_empty(None)
+    wire_received = srv.stats.get("imports_received", 0)
+    # ---- collective phase: rendezvous + timed fold per cycle ----
+    tr.exchange_empty(None)
+    fold_s = 0.0
+    for _ in range(cycles):
+        landed = tr.exchange_empty(None)
+        f0 = t_perf()
+        srv.apply_collective_blocks(landed)
+        fold_s += t_perf() - f0
+    tr.exchange_empty(None)
+    res = {"role": "global", "pid": pid,
+           "wire_received": wire_received,
+           "coll_received": srv.stats.get(
+               "collective_items_received", 0),
+           "coll_blocks": srv.stats.get(
+               "collective_blocks_received", 0),
+           "bad_blocks": srv.stats.get("collective_bad_blocks", 0),
+           "fold_s": fold_s,
+           "ledger_imbalanced": srv.ledger.summary().get(
+               "imbalanced", 0)}
+    srv.shutdown()
+print("CFRESULT " + json.dumps(res), flush=True)
+"""
+
+
+def collective_forward_bench() -> dict:
+    """``--collective-forward``: the ISSUE 18 tentpole's transport
+    race.  N local senders and M receiving globals run as N+M REAL
+    mesh processes (gloo CPU collectives, the same spawn shape as
+    tests/test_distributed_fold.py); the same per-destination rows
+    ride (a) the production gRPC wire into each global's ImportServer
+    and (b) the fixed-schema plane blocks through ONE
+    ``jax.lax.all_to_all`` per cycle into the same fused import
+    kernels.  Headline ``collective_items_per_sec`` against the wire
+    oracle, with per-phase pack/serialize/exchange/fold timings and
+    exact delivery counts on both transports.
+
+    The ratio is platform-relative, same as the sockets uring sweep:
+    with fewer cores than mesh processes every rendezvous costs
+    scheduler quanta (two jax runtimes time-sharing one core spend
+    ~170ms per all_to_all on loopback regardless of payload), so the
+    artifact stamps cpu_count/mesh_procs and the gate reads them."""
+    import socket as socket_mod
+    import subprocess
+
+    if QUICK:
+        n_locals, n_globals, cycles, rows_per_dest = 1, 1, 3, 128
+    else:
+        n_locals, n_globals, cycles, rows_per_dest = 2, 2, 6, 256
+    n_procs = n_locals + n_globals
+    out: dict = {"mode": "collective_forward", "quick": QUICK,
+                 "n_locals": n_locals, "n_globals": n_globals,
+                 "mesh_procs": n_procs, "cycles": cycles,
+                 "rows_per_dest": rows_per_dest}
+    try:
+        socks = []
+        for _ in range(1 + n_globals):
+            s = socket_mod.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        ports = [s.getsockname()[1] for s in socks]
+        for s in socks:
+            s.close()
+    except OSError as e:
+        out["skipped"] = True
+        out["reason"] = f"cannot allocate loopback ports: {e}"
+        return out
+    coord, gports = ports[0], ports[1:]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    argv_tail = [str(coord), str(n_locals), str(n_globals),
+                 ",".join(str(p) for p in gports), str(cycles),
+                 str(rows_per_dest), str(rows_per_dest), "96"]
+    try:
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", _COLLECTIVE_WORKER, str(i)]
+            + argv_tail,
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True) for i in range(n_procs)]
+    except OSError as e:
+        out["skipped"] = True
+        out["reason"] = f"cannot spawn mesh workers: {e}"
+        return out
+    outs = []
+    try:
+        for p in procs:
+            o, _ = p.communicate(timeout=600)
+            outs.append(o)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        out["skipped"] = True
+        out["reason"] = "mesh workers timed out"
+        return out
+    results = {}
+    for i, (p, o) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            low = o.lower()
+            if ("gloo" in low or "collectives" in low
+                    or "deadline_exceeded" in low):
+                out["skipped"] = True
+                out["reason"] = ("distributed CPU collectives "
+                                 f"unavailable: {o[-400:]}")
+                return out
+            out["error"] = f"worker {i} rc={p.returncode}: {o[-2000:]}"
+            return out
+        for ln in o.splitlines():
+            if ln.startswith("CFRESULT "):
+                r = json.loads(ln[len("CFRESULT "):])
+                results[r["pid"]] = r
+    if len(results) != n_procs:
+        out["error"] = f"got {len(results)}/{n_procs} worker results"
+        return out
+    locals_ = [results[i] for i in range(n_locals)]
+    globals_ = [results[i] for i in range(n_locals, n_procs)]
+    items_per_phase = cycles * n_locals * n_globals * rows_per_dest
+    # phase wall = the slowest process's barrier-to-barrier window
+    # (barriers are mesh-wide rendezvous, so the windows align and
+    # cover delivery-to-staged on the receiving side too)
+    wire_wall = max(r["wire_wall_s"] for r in locals_)
+    coll_wall = max(r["coll_wall_s"] for r in locals_)
+    wire_rate = items_per_phase / wire_wall if wire_wall else 0.0
+    coll_rate = items_per_phase / coll_wall if coll_wall else 0.0
+    out.update({
+        "items_per_phase": items_per_phase,
+        "wire_items_per_sec": round(wire_rate, 1),
+        "collective_items_per_sec": round(coll_rate, 1),
+        "collective_speedup_vs_wire": round(
+            coll_rate / wire_rate, 3) if wire_rate else None,
+        "phase_seconds": {
+            "wire_wall": round(wire_wall, 4),
+            "collective_wall": round(coll_wall, 4),
+            "serialize": round(
+                sum(r["serialize_s"] for r in locals_), 4),
+            "pack": round(sum(r["pack_s"] for r in locals_), 4),
+            "exchange": round(
+                sum(r["exchange_s"] for r in locals_), 4),
+            "fold": round(sum(r["fold_s"] for r in globals_), 4),
+        },
+        "conservation": {
+            "wire_received": sum(r["wire_received"]
+                                 for r in globals_),
+            "collective_received": sum(r["coll_received"]
+                                       for r in globals_),
+            "expected_per_phase": items_per_phase,
+            "fallback_cycles": sum(r["fallback_cycles"]
+                                   for r in locals_),
+            "bad_blocks": sum(r["bad_blocks"] for r in globals_),
+            "ledger_imbalanced": sum(r["ledger_imbalanced"]
+                                     for r in globals_),
+        },
+        "workers": results,
+    })
+    c = out["conservation"]
+    out["collective_gates"] = {
+        "wire_conserved": c["wire_received"] == items_per_phase,
+        "collective_conserved":
+            c["collective_received"] == items_per_phase,
+        "zero_fallbacks": c["fallback_cycles"] == 0,
+        "zero_bad_blocks": c["bad_blocks"] == 0,
+        "ledger_balanced": c["ledger_imbalanced"] == 0,
+    }
+    out.update(_backend_info())
+    out["captured_unix"] = round(time.time(), 1)
+    _save_artifact("collective_forward", out)
+    return out
+
+
 def _chaos_local_loop(name: str, globals_: list, wires: list[bytes],
                       n_iters: int, results: dict,
                       inject: bool) -> None:
@@ -3961,6 +4279,10 @@ def _assemble(configs: dict, t_start: float,
         "num_devices": stamp.get("num_devices"),
         "jax_version": stamp.get("jax_version"),
         "platform_pin": _PLATFORM_PIN or None,
+        # host provenance without importing jax (see gates note
+        # below): os-only stamps are always safe in the parent
+        "kernel_release": os.uname().release,
+        "cpu_count": os.cpu_count(),
         # headline gates carry the resolved merge mode + fallback like
         # the config rows — resolved from the subprocess-captured
         # platform stamp via tdigest's pure rule, NOT _backend_info():
@@ -4021,6 +4343,15 @@ def _summary_line(out: dict) -> str:
             "value": out.get("value"),
             "vs_baseline": out.get("vs_baseline"),
             "platform": out.get("platform"),
+            # provenance travels on the one-line record too (ISSUE
+            # 18): the driver's bounded tail capture must never yield
+            # a rate divorced from the host that produced it
+            "platform_pin": out.get("platform_pin"),
+            "kernel_release": out.get("kernel_release"),
+            "cpu_count": out.get("cpu_count"),
+            "device_kind": out.get("device_kind"),
+            "merge_resolved": (out.get("gates") or {}).get(
+                "merge_resolved"),
             "error": (str(out["error"])[:120]
                       if out.get("error") else None),
             "configs": cfgs}
@@ -4045,14 +4376,21 @@ def _summary_line(out: dict) -> str:
     # rate and the uring-over-recvmmsg ratio, so the one-line record
     # names what kernel/backend produced the number
     if out.get("mode") == "sockets":
-        line["platform_pin"] = out.get("platform_pin")
-        line["kernel_release"] = out.get("kernel_release")
         line["effective_rcvbuf"] = out.get("effective_rcvbuf")
         line["ingest_backend"] = out.get("ingest_backend")
         line["single_line_pkts_per_sec"] = out.get(
             "single_line", {}).get("packets_per_sec")
         line["uring_speedup_single_line"] = out.get(
             "uring_speedup_single_line")
+    # collective-forward verdict: present only for
+    # --collective-forward artifacts (ISSUE 18)
+    if out.get("collective_items_per_sec") is not None:
+        line["collective_items_per_sec"] = \
+            out["collective_items_per_sec"]
+        line["wire_items_per_sec"] = out.get("wire_items_per_sec")
+        line["collective_speedup_vs_wire"] = out.get(
+            "collective_speedup_vs_wire")
+        line["mesh_procs"] = out.get("mesh_procs")
     return json.dumps(line, separators=(",", ":"))
 
 
@@ -4167,6 +4505,10 @@ if __name__ == "__main__":
         print(json.dumps(global_merge_import()))
     elif "--cluster" in sys.argv:
         out = cluster_bench()
+        print(json.dumps(out))
+        print(_summary_line(out))
+    elif "--collective-forward" in sys.argv:
+        out = collective_forward_bench()
         print(json.dumps(out))
         print(_summary_line(out))
     elif "--chaos" in sys.argv:

@@ -101,16 +101,18 @@ def dsn_server():
 # failure here, not a slow accumulation across the suite.  ISSUE 16
 # extends it to the flight recorder's dump writer ("flight-dump-*",
 # joined by FlightRecorder.stop()) and vtop's per-round scraper
-# threads ("vtop-scrape-*", joined every scrape round).
+# threads ("vtop-scrape-*", joined every scrape round).  ISSUE 18
+# adds the collective forward plane-exchange worker
+# ("collective-exchange-*", joined by CollectiveTransport.stop()).
 
 _WORKER_PREFIXES = ("proxy-dest-", "sink-flush-", "flight-dump-",
-                    "vtop-scrape-")
+                    "vtop-scrape-", "collective-exchange-")
 
 _GUARDED_MODULES = ("test_breaker", "test_spool", "test_retry_budget",
                     "test_proxy_columnar", "test_sink_fanout",
                     "test_sharded_forward", "test_drain_handoff",
                     "test_live_reshard", "test_flight", "test_vtop",
-                    "test_signals")
+                    "test_signals", "test_collective_forward")
 
 
 def _worker_threads():

@@ -1,0 +1,710 @@
+"""Collective forward plane-exchange (tpu_collective_forward).
+
+The PR's contracts, pinned here:
+
+- **Bit parity.** A block packed by ``pack_block`` and folded by
+  ``fold_block`` leaves the receiving table bit-identical to the
+  gRPC-wire oracle (``apply_metric_list_bytes``) applying the same
+  rows — counter sums, gauge planes, digest centroids, HLL registers.
+  Verified in-process AND at 2 real mesh processes over gloo CPU
+  collectives, where the planes actually ride ``all_to_all``.
+- **Fail-open.** An injected exchange failure re-routes the whole
+  cycle's peer rows onto the wire: the fallback counter is named
+  (``collective_forward_fallbacks``), every row still lands, and the
+  ledger balances with zero unattributed loss.
+- **Conservation.** With a mixed wire+collective split the interval
+  seals on ``forwarded == Σ wire split + Σ collective split +
+  attributed drops``.
+- **Reshard crossing.** A membership swap mid-stream credits moved
+  arcs against the pre-swap ring on BOTH transports.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytest.importorskip("grpc")
+
+from veneur_tpu.core.config import read_config
+from veneur_tpu.core.flusher import ForwardRow
+from veneur_tpu.core.server import Server
+from veneur_tpu.core.table import MetricTable, RowMeta, TableConfig
+from veneur_tpu.forward.collective import (CollectiveExchangeError,
+                                           CollectiveTransport,
+                                           parse_peers)
+from veneur_tpu.ops import hll, segment
+from veneur_tpu.parallel import collective_forward as cplanes
+from veneur_tpu.protocol import dogstatsd as dsd
+from veneur_tpu.sinks.simple import CaptureSink
+
+TIMEOUT_S = 420
+
+
+def _meta(name, mtype, tags=(), scope=dsd.SCOPE_DEFAULT):
+    return RowMeta(name=name, tags=tuple(tags), scope=scope,
+                   type=mtype)
+
+
+def _mixed_rows(n_counter=24, n_gauge=12, n_histo=8, n_set=4, seed=3):
+    """Deterministic rows of all four classes, centroid planes and
+    registers included — the same builder the 2-process worker
+    embeds."""
+    rng = np.random.default_rng(seed)
+    C = 616  # capacity_for(100.0)
+    rows = []
+    for i in range(n_counter):
+        rows.append(ForwardRow(
+            _meta(f"coll.ctr.{i}", dsd.COUNTER, (f"k:{i % 5}",)),
+            "counter", value=float(i * 3 + 1)))
+    for i in range(n_gauge):
+        rows.append(ForwardRow(
+            _meta(f"coll.g.{i}", dsd.GAUGE), "gauge",
+            value=float(rng.normal() * 100)))
+    for i in range(n_histo):
+        k = int(rng.integers(1, 40))
+        means = np.zeros(C, np.float32)
+        weights = np.zeros(C, np.float32)
+        means[:k] = rng.normal(size=k).astype(np.float32) * 50
+        weights[:k] = rng.integers(1, 9, size=k).astype(np.float32)
+        vals = means[:k].astype(np.float64)
+        w = weights[:k].astype(np.float64)
+        stats = np.array([w.sum(), vals.min(), vals.max(),
+                          (vals * w).sum(),
+                          (1.0 / np.abs(vals + 100.0)).sum()],
+                         np.float32)
+        rows.append(ForwardRow(
+            _meta(f"coll.h.{i}", dsd.HISTOGRAM, ("t:h",)), "histo",
+            stats=stats, means=means, weights=weights))
+    for i in range(n_set):
+        regs = rng.integers(0, 20, size=hll.M).astype(np.uint8)
+        rows.append(ForwardRow(
+            _meta(f"coll.s.{i}", dsd.SET), "set", regs=regs))
+    return rows
+
+
+def _wire_oracle_apply(table, rows, compression=100.0):
+    from veneur_tpu.forward.grpc_forward import (
+        apply_metric_list_bytes, rows_to_metric_list)
+    data = rows_to_metric_list(rows, compression).SerializeToString()
+    return apply_metric_list_bytes(table, data)
+
+
+def _assert_tables_bit_identical(t1, t2):
+    assert np.array_equal(t1._counter_dense, t2._counter_dense)
+    assert np.array_equal(t1._gauge_dense, t2._gauge_dense)
+    if t1._set_import_plane is not None or \
+            t2._set_import_plane is not None:
+        assert np.array_equal(t1._set_import_plane,
+                              t2._set_import_plane)
+    p1, p2 = t1._stats_import_parts, t2._stats_import_parts
+    assert len(p1) == len(p2)
+    if p1:
+        a = np.concatenate([np.asarray(x[1]) for x in p1])
+        b = np.concatenate([np.asarray(x[1]) for x in p2])
+        assert np.array_equal(a, b)
+    d1, d2 = t1._wire_digest_parts, t2._wire_digest_parts
+    assert len(d1) == len(d2)
+    for x, y in zip(d1, d2):
+        for ax, ay in zip(x, y):
+            assert np.array_equal(np.asarray(ax), np.asarray(ay))
+
+
+# ----------------------------------------------------------------------
+# schema + codec units
+
+
+def test_identity_roundtrip():
+    schema = cplanes.PlaneSchema(max_rows=16, key_bytes=96)
+    meta = _meta("a.metric", dsd.HISTOGRAM,
+                 ("env:prod", "zone:us"), dsd.SCOPE_GLOBAL)
+    buf = cplanes.encode_identity(meta, schema.key_bytes)
+    assert buf is not None and len(buf) <= schema.key_bytes
+    name, mtype, scope, tags = cplanes.decode_identity(buf)
+    assert (name, mtype, scope, tags) == (
+        "a.metric", dsd.HISTOGRAM, dsd.SCOPE_GLOBAL,
+        ("env:prod", "zone:us"))
+    # oversize identity -> None (rejected to the wire, not truncated)
+    big = _meta("x" * 200, dsd.COUNTER)
+    assert cplanes.encode_identity(big, 96) is None
+
+
+def test_pack_unpack_roundtrip_and_counts():
+    schema = cplanes.PlaneSchema(compression=100.0, max_rows=64,
+                                 key_bytes=128)
+    rows = _mixed_rows()
+    block, n, rejected = cplanes.pack_block(rows, schema)
+    assert n == len(rows) and not rejected
+    assert cplanes.block_counts(block) == (24, 12, 8, 4)
+    back = cplanes.unpack_block(block, schema)
+    assert [r.meta.name for r in back] == [r.meta.name for r in rows]
+    # an all-zero block is an empty rendezvous slot, not an error
+    empty = np.zeros(schema.block_size, np.uint8)
+    assert cplanes.block_counts(empty) == (0, 0, 0, 0)
+    # garbage is named, never folded
+    junk = np.full(schema.block_size, 7, np.uint8)
+    with pytest.raises(cplanes.PlaneFormatError):
+        cplanes.block_counts(junk)
+
+
+def test_capacity_rejects_to_wire_never_truncates():
+    schema = cplanes.PlaneSchema(max_rows=4, key_bytes=128)
+    rows = _mixed_rows(n_counter=7, n_gauge=0, n_histo=0, n_set=0)
+    block, n, rejected = cplanes.pack_block(rows, schema)
+    assert n == 4 and len(rejected) == 3
+    assert cplanes.block_counts(block)[0] == 4
+    # the rejected rows are the originals, intact
+    assert all(r in rows for r in rejected)
+
+
+def test_parse_peers():
+    assert parse_peers("a:1=1,b:2=2") == {"a:1": 1, "b:2": 2}
+    assert parse_peers("") == {}
+    with pytest.raises(ValueError):
+        parse_peers("noindex")
+    with pytest.raises(ValueError):
+        parse_peers("a:1=1,a:1=2")
+    with pytest.raises(ValueError):
+        parse_peers("a:1=x")
+
+
+def test_fold_block_bit_parity_vs_wire_oracle():
+    """In-process parity: fold_block's staged state is bit-identical
+    to the gRPC wire oracle applying the same rows."""
+    schema = cplanes.PlaneSchema(compression=100.0, max_rows=64,
+                                 key_bytes=128)
+    rows = _mixed_rows()
+    block, n, rejected = cplanes.pack_block(rows, schema)
+    assert n == len(rows) and not rejected
+    t1 = MetricTable(TableConfig())
+    t2 = MetricTable(TableConfig())
+    acc1, drop1 = cplanes.fold_block(t1, block, schema)
+    acc2, drop2 = _wire_oracle_apply(t2, rows)
+    assert (acc1, drop1) == (acc2, drop2) == (len(rows), 0)
+    _assert_tables_bit_identical(t1, t2)
+
+
+# ----------------------------------------------------------------------
+# transport-level behavior (injected exchanges, no mesh)
+
+
+def test_transport_deadline_falls_open_and_hands_late_planes():
+    import threading
+    import time as _time
+    schema = cplanes.PlaneSchema(max_rows=8, key_bytes=96)
+    release = threading.Event()
+    late: list = []
+
+    def slow_exchange(local):
+        release.wait(10)
+        return local
+
+    tr = CollectiveTransport(schema, peers={"d:1": 1},
+                            exchange=slow_exchange, deadline=0.2,
+                            on_late=late.append)
+    rows = _mixed_rows(n_counter=3, n_gauge=0, n_histo=0, n_set=0)
+    with pytest.raises(CollectiveExchangeError):
+        tr.send_cycle({"d:1": rows})
+    assert tr.counters["fallback_cycles"] == 1
+    # the orphaned exchange lands late: planes are handed off, never
+    # silently discarded
+    release.set()
+    deadline = _time.monotonic() + 5
+    while not late and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    assert late and late[0].shape == (2, schema.block_size)
+    assert tr.counters["late_landed"] == 1
+    tr.stop()
+
+
+def test_transport_error_raises_and_stop_joins_worker():
+    schema = cplanes.PlaneSchema(max_rows=8, key_bytes=96)
+
+    def bad_exchange(local):
+        raise RuntimeError("mesh torn down")
+
+    tr = CollectiveTransport(schema, peers={"d:1": 1},
+                            exchange=bad_exchange, deadline=2.0)
+    with pytest.raises(CollectiveExchangeError):
+        tr.send_cycle({"d:1": _mixed_rows(2, 0, 0, 0)})
+    assert tr.counters["fallback_cycles"] == 1
+    tr.stop()
+
+
+# ----------------------------------------------------------------------
+# server-level: loopback hub exchange, real ledger + spans
+
+
+def _server(data, sinks=None):
+    srv = Server(read_config(data=dict(data)), extra_sinks=sinks or [])
+    return srv
+
+
+def test_server_collective_cycle_ledger_and_spans():
+    """All rows ride the collective; the interval seals balanced on
+    the collective split; one flush.forward.collective child span
+    hangs under flush.forward; the receiving global folds the landed
+    planes and serves them."""
+    cap = CaptureSink()
+    glob = _server({"interval": "10s", "hostname": "g",
+                    "tpu_collective_forward": "on"}, [cap])
+    dest = "127.0.0.1:9990"
+    local = _server({
+        "statsd_listen_addresses": [],
+        "forward_address": dest,
+        "forward_use_grpc": True,
+        "tpu_sharded_global": True,
+        "tpu_collective_peers": f"{dest}=1",
+        "interval": "10s", "hostname": "l"})
+
+    def hub(local_blocks):
+        landed_g = np.zeros_like(local_blocks)
+        landed_g[0] = local_blocks[1]
+        glob._collective_transport()
+        glob.apply_collective_blocks(landed_g)
+        return np.zeros_like(local_blocks)
+
+    local.collective_exchange = hub
+    try:
+        n = 40
+        for i in range(n):
+            local.handle_packet(
+                f"coll.e2e.{i}:{i}|c|#veneurglobalonly".encode())
+        local.flush_once()
+
+        assert local.stats["collective_forward_cycles"] == 1
+        assert local.stats["collective_forward_rows"] == n
+        assert local.stats.get("collective_forward_fallbacks", 0) == 0
+        assert local.stats.get("forward_shard_wires", 0) == 0
+        rec = local.ledger.last()
+        assert rec.sealed and rec.balanced and rec.split_owed == 0
+        assert rec.forward_collective == {dest: n}
+        assert rec.forward_split == {}
+        assert rec.forwarded_rows == n
+
+        assert glob.stats["collective_items_received"] == n
+        assert glob.stats["imports_received"] == n
+        grec = glob.ledger.last()
+        glob.flush_once()
+        got = {m.name: m.value for m in cap.metrics}
+        assert len(got) == n
+        for i in range(n):
+            assert got[f"coll.e2e.{i}"] == float(i)
+        # the global's intake ledger names the collective protocol
+        found = any("collective-import" in r.received
+                    for r in glob.ledger.records())
+        assert found
+
+        # trace: flush.forward -> flush.forward.collective child
+        tid = next(t for t in reversed(local.trace_index.trace_ids())
+                   if any(s["name"] == "flush.forward"
+                          for s in local.trace_index.get(t)))
+        spans = local.trace_index.get(tid)
+        fwd = next(s for s in spans if s["name"] == "flush.forward")
+        colls = [s for s in spans
+                 if s["name"] == "flush.forward.collective"]
+        assert len(colls) == 1
+        assert colls[0]["parent_id"] == fwd["span_id"]
+        assert int(colls[0]["tags"]["rows"]) == n
+    finally:
+        local.shutdown()
+        glob.shutdown()
+
+
+def test_fail_open_to_wire_zero_unattributed_loss():
+    """Injected exchange failure: the whole cycle's peer rows ride
+    the wire instead, the fallback counter is named, every row lands
+    on the real global, and the ledger balances — zero unattributed
+    loss."""
+    cap = CaptureSink()
+    glob = _server({"grpc_listen_addresses": ["tcp://127.0.0.1:0"],
+                    "interval": "10s", "hostname": "g"}, [cap])
+    glob.start()
+    try:
+        dest = f"127.0.0.1:{glob.grpc_ports[0]}"
+        local = _server({
+            "statsd_listen_addresses": [],
+            "forward_address": dest,
+            "forward_use_grpc": True,
+            "tpu_sharded_global": True,
+            "tpu_collective_peers": f"{dest}=1",
+            "interval": "10s", "hostname": "l"})
+
+        def exploding(local_blocks):
+            raise RuntimeError("injected exchange fault")
+
+        local.collective_exchange = exploding
+        try:
+            n = 30
+            for i in range(n):
+                local.handle_packet(
+                    f"coll.fo.{i}:{i}|c|#veneurglobalonly".encode())
+            local.flush_once()
+
+            assert local.stats["collective_forward_fallbacks"] == 1
+            assert local.stats["collective_fallback_rows"] == n
+            assert local.stats.get("collective_forward_cycles", 0) == 0
+            # the wire carried the cycle
+            assert local.stats["forward_shard_wires"] == 1
+            rec = local.ledger.last()
+            assert rec.sealed and rec.balanced
+            assert rec.forward_collective == {}
+            assert rec.forward_split == {dest: n}
+            assert rec.forwarded_rows == n
+            glob.flush_once()
+            got = {m.name: m.value for m in cap.metrics}
+            assert len(got) == n
+        finally:
+            local.shutdown()
+    finally:
+        glob.shutdown()
+
+
+def test_mixed_wire_and_collective_split_balances():
+    """Two destinations, one a mesh peer: the flush splits across
+    BOTH transports and seals on forwarded == Σ wire split +
+    Σ collective split; every key lands exactly once."""
+    wire_cap, coll_cap = CaptureSink(), CaptureSink()
+    wire_glob = _server(
+        {"grpc_listen_addresses": ["tcp://127.0.0.1:0"],
+         "interval": "10s", "hostname": "gw"}, [wire_cap])
+    wire_glob.start()
+    coll_glob = _server({"interval": "10s", "hostname": "gc",
+                         "tpu_collective_forward": "on"}, [coll_cap])
+    try:
+        wire_dest = f"127.0.0.1:{wire_glob.grpc_ports[0]}"
+        coll_dest = "127.0.0.1:9991"
+        local = _server({
+            "statsd_listen_addresses": [],
+            "forward_address": f"{wire_dest},{coll_dest}",
+            "forward_use_grpc": True,
+            "tpu_sharded_global": True,
+            "tpu_collective_peers": f"{coll_dest}=1",
+            "interval": "10s", "hostname": "l"})
+
+        def hub(local_blocks):
+            landed_g = np.zeros_like(local_blocks)
+            landed_g[0] = local_blocks[1]
+            coll_glob._collective_transport()
+            coll_glob.apply_collective_blocks(landed_g)
+            return np.zeros_like(local_blocks)
+
+        local.collective_exchange = hub
+        try:
+            n = 200
+            for i in range(n):
+                local.handle_packet(
+                    f"coll.mix.{i}:{i}|c|#veneurglobalonly".encode())
+            local.flush_once()
+
+            rec = local.ledger.last()
+            assert rec.sealed and rec.balanced
+            n_coll = sum(rec.forward_collective.values())
+            n_wire = sum(rec.forward_split.values())
+            # 200 keys over 2 ring members never lands one-sided
+            assert n_coll > 0 and n_wire > 0
+            assert set(rec.forward_collective) == {coll_dest}
+            assert set(rec.forward_split) == {wire_dest}
+            assert n_coll + n_wire == rec.forwarded_rows == n
+            assert local.stats["collective_forward_rows"] == n_coll
+            assert local.stats["forward_shard_wires"] == 1
+            # the flush-result split saw both transports
+            summ = local.ledger.summary()
+            assert summ["forward_collective_total"] == n_coll
+            assert summ["forward_split_total"] == n_wire
+
+            wire_glob.flush_once()
+            coll_glob.flush_once()
+            merged = {}
+            for capt in (wire_cap, coll_cap):
+                for m in capt.metrics:
+                    assert m.name not in merged, "key owned twice"
+                    merged[m.name] = m.value
+            assert len(merged) == n
+            for i in range(n):
+                assert merged[f"coll.mix.{i}"] == float(i)
+        finally:
+            local.shutdown()
+    finally:
+        wire_glob.shutdown()
+        coll_glob.shutdown()
+
+
+def test_reshard_crossing_credits_moved_on_both_transports():
+    """Membership swap mid-stream: a peer destination joining the
+    ring moves arcs from the wire member onto the collective — the
+    crossing flush credits the moved rows against the pre-swap ring
+    and still balances."""
+    wire_glob = _server(
+        {"grpc_listen_addresses": ["tcp://127.0.0.1:0"],
+         "interval": "10s", "hostname": "gw"}, [CaptureSink()])
+    wire_glob.start()
+    coll_glob = _server({"interval": "10s", "hostname": "gc",
+                         "tpu_collective_forward": "on"},
+                        [CaptureSink()])
+    try:
+        wire_dest = f"127.0.0.1:{wire_glob.grpc_ports[0]}"
+        coll_dest = "127.0.0.1:9992"
+        local = _server({
+            "statsd_listen_addresses": [],
+            "forward_address": wire_dest,
+            "forward_use_grpc": True,
+            "tpu_sharded_global": True,
+            "tpu_collective_peers": f"{coll_dest}=1",
+            "interval": "10s", "hostname": "l"})
+
+        def hub(local_blocks):
+            landed_g = np.zeros_like(local_blocks)
+            landed_g[0] = local_blocks[1]
+            coll_glob._collective_transport()
+            coll_glob.apply_collective_blocks(landed_g)
+            return np.zeros_like(local_blocks)
+
+        local.collective_exchange = hub
+        try:
+            n = 120
+            mk = [f"coll.rs.{i}:{i}|c|#veneurglobalonly".encode()
+                  for i in range(n)]
+            for p in mk:
+                local.handle_packet(p)
+            # flush 1: single wire member owns everything
+            local.flush_once()
+            rec1 = local.ledger.last()
+            assert rec1.balanced
+            assert sum(rec1.forward_split.values()) == n
+            assert rec1.forward_collective == {}
+
+            # the peer joins the ring; the crossing flush re-routes
+            # its arcs onto the collective and credits the move
+            assert local._sharded_fwd.set_members(
+                [wire_dest, coll_dest])
+            for p in mk:
+                local.handle_packet(p)
+            local.flush_once()
+            rec2 = local.ledger.last()
+            assert rec2.sealed and rec2.balanced
+            n_coll = sum(rec2.forward_collective.values())
+            n_wire = sum(rec2.forward_split.values())
+            assert n_coll > 0 and n_wire > 0
+            assert n_coll + n_wire == rec2.forwarded_rows == n
+            # the arcs that moved off the wire member are exactly the
+            # collective-owned rows, credited as a reshard
+            assert rec2.reshard_epoch > 0
+            assert coll_dest in rec2.reshard_added
+            assert rec2.reshard_moved_rows == n_coll
+            assert local.stats["forward_reshards"] == 1
+        finally:
+            local.shutdown()
+    finally:
+        wire_glob.shutdown()
+        coll_glob.shutdown()
+
+
+def test_drain_flush_never_takes_the_collective():
+    """Shutdown drain rides the wire only — the recovery path
+    contract.  The drain flush must not touch the exchange."""
+    cap = CaptureSink()
+    glob = _server({"grpc_listen_addresses": ["tcp://127.0.0.1:0"],
+                    "interval": "10s", "hostname": "g"}, [cap])
+    glob.start()
+    try:
+        dest = f"127.0.0.1:{glob.grpc_ports[0]}"
+        local = _server({
+            "statsd_listen_addresses": [],
+            "forward_address": dest,
+            "forward_use_grpc": True,
+            "tpu_sharded_global": True,
+            "tpu_collective_peers": f"{dest}=1",
+            "interval": "10s", "hostname": "l"})
+        calls = []
+
+        def hub(local_blocks):
+            calls.append(1)
+            return np.zeros_like(local_blocks)
+
+        local.collective_exchange = hub
+        try:
+            for i in range(10):
+                local.handle_packet(
+                    f"coll.drain.{i}:{i}|c|#veneurglobalonly".encode())
+        finally:
+            # shutdown runs the drain flush; staged rows must ship
+            # on drain-flagged wires, not the exchange
+            local.shutdown()
+        assert not calls
+        assert local.stats.get("drain_items_sent", 0) == 10
+        assert glob.stats.get("drain_items_received", 0) == 10
+    finally:
+        glob.shutdown()
+
+
+# ----------------------------------------------------------------------
+# 2 real mesh processes: the planes actually ride all_to_all
+
+
+_WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import numpy as np
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+os.environ["VENEUR_TPU_DIST_COORDINATOR"] = f"127.0.0.1:{port}"
+os.environ["VENEUR_TPU_DIST_NUM_PROCS"] = "2"
+os.environ["VENEUR_TPU_DIST_PROCESS_ID"] = str(pid)
+
+from veneur_tpu.parallel import sharded
+assert sharded.init_process_mesh()
+import jax
+assert jax.process_count() == 2, jax.process_count()
+
+from veneur_tpu.core.flusher import ForwardRow
+from veneur_tpu.core.table import MetricTable, RowMeta, TableConfig
+from veneur_tpu.forward.collective import CollectiveTransport
+from veneur_tpu.ops import hll
+from veneur_tpu.parallel import collective_forward as cplanes
+from veneur_tpu.protocol import dogstatsd as dsd
+
+def meta(name, mtype, tags=(), scope=dsd.SCOPE_DEFAULT):
+    return RowMeta(name=name, tags=tuple(tags), scope=scope,
+                   type=mtype)
+
+def mixed_rows():
+    rng = np.random.default_rng(3)
+    C = 616
+    rows = []
+    for i in range(24):
+        rows.append(ForwardRow(
+            meta(f"coll.ctr.{i}", dsd.COUNTER, (f"k:{i % 5}",)),
+            "counter", value=float(i * 3 + 1)))
+    for i in range(12):
+        rows.append(ForwardRow(
+            meta(f"coll.g.{i}", dsd.GAUGE), "gauge",
+            value=float(rng.normal() * 100)))
+    for i in range(8):
+        k = int(rng.integers(1, 40))
+        means = np.zeros(C, np.float32)
+        weights = np.zeros(C, np.float32)
+        means[:k] = rng.normal(size=k).astype(np.float32) * 50
+        weights[:k] = rng.integers(1, 9, size=k).astype(np.float32)
+        vals = means[:k].astype(np.float64)
+        w = weights[:k].astype(np.float64)
+        stats = np.array([w.sum(), vals.min(), vals.max(),
+                          (vals * w).sum(),
+                          (1.0 / np.abs(vals + 100.0)).sum()],
+                         np.float32)
+        rows.append(ForwardRow(
+            meta(f"coll.h.{i}", dsd.HISTOGRAM, ("t:h",)), "histo",
+            stats=stats, means=means, weights=weights))
+    for i in range(4):
+        regs = rng.integers(0, 20, size=hll.M).astype(np.uint8)
+        rows.append(ForwardRow(
+            meta(f"coll.s.{i}", dsd.SET), "set", regs=regs))
+    return rows
+
+schema = cplanes.PlaneSchema(compression=100.0, max_rows=64,
+                             key_bytes=128)
+rows = mixed_rows()  # both processes build the SAME rows (the oracle)
+
+if pid == 0:
+    # the local: pack + exchange to process 1
+    tr = CollectiveTransport(schema, peers={"g:1": 1}, deadline=300.0)
+    sent, rejected, landed = tr.send_cycle({"g:1": rows})
+    assert sent == {"g:1": len(rows)}, sent
+    assert not rejected
+    # nothing is addressed back to the local
+    assert not landed.any()
+    tr.stop()
+else:
+    # the global: rendezvous empty, fold what lands, compare against
+    # the gRPC wire oracle applied to the SAME rows
+    tr = CollectiveTransport(schema, n_slots=2, deadline=300.0)
+    landed = tr.exchange_empty(timeout=300.0)
+    assert cplanes.block_counts(landed[0]) == (24, 12, 8, 4)
+    assert not landed[1].any()
+    t1 = MetricTable(TableConfig())
+    acc, dropped = cplanes.fold_block(t1, landed[0], schema)
+    assert (acc, dropped) == (len(rows), 0), (acc, dropped)
+
+    from veneur_tpu.forward.grpc_forward import (
+        apply_metric_list_bytes, rows_to_metric_list)
+    t2 = MetricTable(TableConfig())
+    data = rows_to_metric_list(rows, 100.0).SerializeToString()
+    acc2, dropped2 = apply_metric_list_bytes(t2, data)
+    assert (acc2, dropped2) == (len(rows), 0)
+
+    assert np.array_equal(t1._counter_dense, t2._counter_dense), \
+        "counter sums diverged"
+    assert np.array_equal(t1._gauge_dense, t2._gauge_dense)
+    assert np.array_equal(t1._set_import_plane,
+                          t2._set_import_plane), "HLL registers"
+    p1 = np.concatenate([np.asarray(x[1])
+                         for x in t1._stats_import_parts])
+    p2 = np.concatenate([np.asarray(x[1])
+                         for x in t2._stats_import_parts])
+    assert np.array_equal(p1, p2), "histo stats diverged"
+    assert len(t1._wire_digest_parts) == len(t2._wire_digest_parts)
+    for x, y in zip(t1._wire_digest_parts, t2._wire_digest_parts):
+        for ax, ay in zip(x, y):
+            assert np.array_equal(np.asarray(ax), np.asarray(ay)), \
+                "digest centroids diverged"
+    tr.stop()
+
+print(f"PARITY-OK {pid}", flush=True)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_collective_bit_parity_vs_wire_oracle():
+    """The acceptance pin: at 2 REAL mesh processes the planes ride
+    jax.lax.all_to_all over gloo CPU collectives, and the receiving
+    fold is bit-identical to the gRPC wire oracle for digest
+    centroids, HLL registers and counter sums."""
+    try:
+        port = _free_port()
+    except OSError as e:  # pragma: no cover - sandboxed runners
+        pytest.skip(f"cannot allocate a loopback port: {e}")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(i), str(port)],
+            env=env, cwd=os.path.dirname(here),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True) for i in range(2)]
+    except OSError as e:  # pragma: no cover - spawn-less platforms
+        pytest.skip(f"cannot spawn distributed workers: {e}")
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=TIMEOUT_S)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0 and (
+                "gloo" in out.lower()
+                or "collectives" in out.lower()
+                or "DEADLINE_EXCEEDED" in out):
+            # platform can't host CPU cross-process collectives:
+            # skip with the reason named, never fail tier-1
+            pytest.skip(f"distributed CPU collectives unavailable: "
+                        f"{out[-500:]}")
+        assert p.returncode == 0, f"worker {i}:\n{out[-4000:]}"
+        assert f"PARITY-OK {i}" in out

@@ -431,3 +431,56 @@ def test_operations_runbook_covers_ingest_backend():
             "ulimit -l",
     ):
         assert needle in ops, needle
+
+
+def test_collective_forward_metrics_documented():
+    """ISSUE 18 names, pinned explicitly: the plane-exchange cycle /
+    row / fallback counters and the global's collective intake."""
+    for name in (
+            "veneur.forward.collective.cycles_total",
+            "veneur.forward.collective.rows_total",
+            "veneur.forward.collective.rejected_rows_total",
+            "veneur.forward.collective.fallback_total",
+            "veneur.forward.collective.fallback_rows_total",
+            "veneur.import.collective_items_total",
+    ):
+        assert name in DOCS, name
+        assert any(name in (ROOT / m).read_text() for m in SCANNED), \
+            name
+    # the ledger split formula with the collective arm
+    assert ("forwarded == Σ wire split + Σ collective split" in DOCS)
+    assert "collective-import" in DOCS
+    assert "forward_collective_total" in DOCS
+
+
+def test_collective_forward_env_vars_documented():
+    """ISSUE 18 knobs: gate, peer map, and plane-schema sizing must
+    appear in the README env table, the performance doc that explains
+    the transport matrix, AND docs/observability.md."""
+    readme = (ROOT / "README.md").read_text()
+    perf = (ROOT / "docs" / "performance.md").read_text()
+    for var in ("VENEUR_TPU_COLLECTIVE_FORWARD",
+                "VENEUR_TPU_COLLECTIVE_PEERS",
+                "VENEUR_TPU_COLLECTIVE_MAX_ROWS",
+                "VENEUR_TPU_COLLECTIVE_KEY_BYTES"):
+        assert var in readme, var
+        assert var in perf, var
+        assert var in DOCS, var
+
+
+def test_performance_doc_covers_collective_forward():
+    """The 'Collective forward' section: transport matrix, plane
+    schema, the fall-open contract, and the platform-relative bench
+    artifact."""
+    perf = (ROOT / "docs" / "performance.md").read_text()
+    for needle in (
+            "Collective forward",
+            "Transport matrix",
+            "Plane schema",
+            "Fallback contract",
+            "all_to_all",
+            "rejected to the wire",
+            "the wire is the only recovery path",
+            "bench_results/collective_forward.json",
+    ):
+        assert needle in perf, needle

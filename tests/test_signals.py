@@ -186,9 +186,14 @@ def test_debug_signals_thirty_plus_named_signals(server):
     # the load-bearing subsystems are all represented
     for prefix in ("ingest.", "flush.", "pressure.", "shed.",
                    "ledger.", "breaker.", "spool.", "table.",
-                   "sink.", "forward."):
+                   "sink.", "forward.", "forward.collective."):
         assert any(n.startswith(prefix) for n in out["signals"]), \
             prefix
+    # the collective plane-exchange group is in the frozen schema
+    # even when the transport never builds (zeros, not absence)
+    assert "forward.collective.cycles" in out["signals"]
+    assert "forward.collective.fallback_cycles" in out["signals"]
+    assert "forward.collective.items_received" in out["signals"]
     # cumulative counters carry real deltas
     proc = out["signals"]["ingest.metrics_processed"]
     assert proc["v"] == [1, 2]

@@ -513,6 +513,31 @@ class Config:
     # serves its globals' summaries with zero extra config.
     # VENEUR_TPU_CLUSTER_PEERS overrides.
     tpu_cluster_peers: str = ""
+    # collective forward plane-exchange: when this local and its
+    # global destinations are processes of one init_process_mesh, the
+    # sharded forward hop ships each mesh peer's routed rows as fixed
+    # -schema tensor planes over ONE all_to_all per cycle instead of
+    # serialize->gRPC->decode (parallel/collective_forward.py).
+    # "auto" (default) engages iff tpu_collective_peers names at
+    # least one destination; "on" / "off" force.  The gob/gRPC wire
+    # stays the cross-slice fallback, the bit-parity oracle, and the
+    # only recovery path — drain/replay/checkpoint wires never take
+    # the collective, and any exchange failure falls open to the wire
+    # with a named counter.  VENEUR_TPU_COLLECTIVE_FORWARD overrides.
+    tpu_collective_forward: str = "auto"
+    # which forward ring destinations are mesh peers: comma list of
+    # dest_addr=mesh_process_index (e.g.
+    # "10.0.0.2:8128=1,10.0.0.3:8128=2").  Destinations not listed
+    # always ride the wire.  Requires tpu_sharded_global +
+    # forward_use_grpc.  VENEUR_TPU_COLLECTIVE_PEERS overrides.
+    tpu_collective_peers: str = ""
+    # fixed plane-schema capacity per destination block: rows per
+    # metric class, and identity bytes per row (type + scope + name +
+    # tags, length-prefixed).  Rows over either cap are REJECTED to
+    # the wire (never truncated).  VENEUR_TPU_COLLECTIVE_MAX_ROWS /
+    # VENEUR_TPU_COLLECTIVE_KEY_BYTES override.
+    tpu_collective_max_rows: int = 512
+    tpu_collective_key_bytes: int = 192
 
     def resolve_aliases(self) -> None:
         """Fold the reference's deprecated alias keys into their
@@ -660,6 +685,29 @@ class Config:
                         "consul_refresh_interval must be positive")
             except ValueError as e:
                 problems.append(str(e))
+        if str(self.tpu_collective_forward).lower() not in (
+                "auto", "on", "off", "1", "0", "true", "false",
+                "yes", "no"):
+            problems.append(
+                "tpu_collective_forward must be auto, on or off")
+        if self.tpu_collective_peers:
+            if not self.tpu_sharded_global:
+                problems.append(
+                    "tpu_collective_peers needs tpu_sharded_global "
+                    "(the collective rides the sharded ring split)")
+            if not self.forward_use_grpc:
+                problems.append(
+                    "tpu_collective_peers needs forward_use_grpc "
+                    "(the wire fallback is gRPC-only)")
+            try:
+                from veneur_tpu.forward.collective import parse_peers
+                parse_peers(self.tpu_collective_peers)
+            except ValueError as e:
+                problems.append(str(e))
+        for n in ("tpu_collective_max_rows",
+                  "tpu_collective_key_bytes"):
+            if getattr(self, n) <= 0:
+                problems.append(f"{n} must be positive")
         if self.tpu_breaker_threshold < 0:
             problems.append("tpu_breaker_threshold must be >= 0")
         try:

@@ -388,6 +388,14 @@ class Server:
         # forward_address members (or a discovered Consul service),
         # lazily built on first forward
         self._sharded_fwd = None
+        # collective forward plane-exchange (tpu_collective_forward):
+        # mesh-peer destinations leave the gRPC wire and ride one
+        # all_to_all per cycle; lazily built on first forward.
+        # ``collective_exchange`` is the injectable exchange seam —
+        # tests set it to a loopback hub or a failure injector before
+        # the first flush
+        self._collective_fwd = None
+        self.collective_exchange = None
         # discovery refresh throttle for the sharded ring (0 = static
         # membership, never polls)
         self._fwd_refresh_interval = 0.0
@@ -1904,6 +1912,14 @@ class Server:
                             server._sharded_fwd.discovery_stats()
                             if server._sharded_fwd is not None
                             else {}),
+                        # collective forward plane-exchange: cycle/
+                        # row/fallback counters, pack+exchange time,
+                        # the peer map and the block schema (None
+                        # until the transport first builds)
+                        "forward.collective": (
+                            server._collective_fwd.stats()
+                            if server._collective_fwd is not None
+                            else None),
                         # per-destination circuit breaker state
                         # (closed/half_open/open + trip counts) for
                         # the sharded forward workers
@@ -2651,6 +2667,93 @@ class Server:
         self.bump("replay_wires_sent")
         self.bump("replay_items_sent", n_items)
 
+    def _collective_transport(self):
+        """The lazily-built CollectiveTransport when the
+        tpu_collective_forward gate resolves on; None keeps every
+        destination on the wire.  "auto" engages iff
+        tpu_collective_peers names at least one mesh peer — a node
+        with no peer map has nothing to exchange with."""
+        gate = str(getattr(self.config, "tpu_collective_forward",
+                           "auto")).lower()
+        if gate in ("off", "0", "false", "no"):
+            return None
+        peers_spec = getattr(self.config, "tpu_collective_peers", "")
+        if gate == "auto" and not peers_spec:
+            return None
+        if self._collective_fwd is None:
+            from veneur_tpu.forward.collective import (
+                CollectiveTransport, parse_peers)
+            from veneur_tpu.parallel.collective_forward import \
+                PlaneSchema
+            schema = PlaneSchema(
+                compression=float(self.config.tpu_compression),
+                max_rows=int(getattr(
+                    self.config, "tpu_collective_max_rows", 512)),
+                key_bytes=int(getattr(
+                    self.config, "tpu_collective_key_bytes", 192)))
+            self._collective_fwd = CollectiveTransport(
+                schema, peers=parse_peers(peers_spec),
+                exchange=self.collective_exchange,
+                deadline=max(self.interval * 0.9, 1.0),
+                on_late=self.apply_collective_blocks)
+        return self._collective_fwd
+
+    def collective_receive_cycle(self, timeout=None) -> tuple:
+        """One receive-side rendezvous: participate in the mesh's
+        plane exchange with nothing to send and fold whatever lands.
+        A receiving global drives this in a loop paced by the
+        senders' flush cycles (the collective blocks until they
+        arrive); returns (accepted, dropped)."""
+        coll = self._collective_transport()
+        if coll is None:
+            raise RuntimeError(
+                "collective forward is off (gate/peers)")
+        landed = coll.exchange_empty(timeout)
+        return self.apply_collective_blocks(landed)
+
+    def apply_collective_blocks(self, landed) -> tuple:
+        """Fold every non-empty landed plane block into the local
+        table — the collective twin of the gRPC import's
+        _send_metrics, with the same ledger discipline: intake is
+        credited under protocol "collective-import" with the
+        overflow delta splitting drops into overflow vs invalid.
+        Thread-safe (takes the ingest lock per block), so the
+        late-land path may call it off the exchange worker."""
+        from veneur_tpu.parallel import collective_forward as cplanes
+        coll = self._collective_fwd
+        schema = coll.schema
+        total_acc = total_drop = blocks = 0
+        for s in range(landed.shape[0]):
+            block = landed[s]
+            try:
+                counts = cplanes.block_counts(block)
+            except cplanes.PlaneFormatError:
+                self.bump("collective_bad_blocks")
+                continue
+            if not any(counts):
+                continue
+            blocks += 1
+            with self.lock:
+                ov0 = self.table.overflow_total()
+                acc, dropped = cplanes.fold_block(
+                    self.table, block, schema)
+                ov = self.table.overflow_total() - ov0
+                self.ledger.ingest(
+                    "collective-import", processed=acc + dropped,
+                    staged=acc, overflow=ov, invalid=dropped - ov)
+                work = self._maybe_device_step_locked()
+            self._apply_staged(work)
+            self.bump("imports_received", acc)
+            self.bump("collective_items_received", acc)
+            self.bump("collective_blocks_received")
+            if dropped:
+                self.bump("metrics_dropped", dropped)
+            total_acc += acc
+            total_drop += dropped
+        if blocks:
+            coll.note_landed(blocks)
+        return total_acc, total_drop
+
     def _forward_sharded(self, fwd, rows, trace_ctx, led, cyc,
                          span) -> dict:
         """Split the flush's forward wire by route-key hash across the
@@ -2677,10 +2780,80 @@ class Server:
                     fwd.refresh()
                 except Exception:
                     log.exception("forward discovery refresh failed")
+        # ONE ring snapshot per flush: the collective grouping below
+        # and the wire routing must hash against the same membership
+        # epoch even while discovery swaps underneath
+        ring = fwd.ring
+        # collective-first stage: mesh-peer destinations leave the
+        # wire and ride the plane exchange.  Drain flushes never take
+        # the collective (the wire is the only recovery path), and
+        # any failure here falls open to the wire — counted, never a
+        # lost flush.
+        coll = None if self._draining else self._collective_transport()
+        coll_groups: dict[str, list] = {}
+        coll_split: dict[str, int] = {}
+        if coll is not None and rows:
+            from veneur_tpu.forward.shard import row_route_key
+            wire_rows = []
+            for row in rows:
+                dest = ring.get(row_route_key(row))
+                if coll.is_peer(dest):
+                    coll_groups.setdefault(dest, []).append(row)
+                else:
+                    wire_rows.append(row)
+            if coll_groups:
+                rows = wire_rows
+        if coll_groups:
+            ch = None
+            if cyc is not None and span is not None:
+                ch = cyc.child(span, "forward.collective",
+                               {"dests": str(len(coll_groups)),
+                                "rows": str(sum(
+                                    len(g)
+                                    for g in coll_groups.values()))})
+            try:
+                sent, rejected, landed_planes = \
+                    coll.send_cycle(coll_groups)
+            except Exception as e:
+                # fall open: the whole cycle's peer rows re-merge
+                # onto the wire, named by the fallback counter
+                n_back = sum(len(g) for g in coll_groups.values())
+                self.bump("collective_forward_fallbacks")
+                self.bump("collective_fallback_rows", n_back)
+                log.warning("collective forward fell open to the "
+                            "wire (%d rows): %s", n_back, e)
+                rows = list(rows) + [r for g in coll_groups.values()
+                                     for r in g]
+                coll_groups = {}
+                if ch is not None:
+                    ch.set_error(e)
+                    if cyc is not None:
+                        cyc.finish(ch)
+            else:
+                self.bump("collective_forward_cycles")
+                for dest, n in sent.items():
+                    coll_split[dest] = n
+                    self.bump("collective_forward_rows", n)
+                    if led is not None:
+                        self.ledger.credit_forward_collective(
+                            led, dest, n)
+                if rejected:
+                    # schema-capacity rejects ship on the wire this
+                    # cycle (rejected, never truncated)
+                    self.bump("collective_rejected_rows",
+                              len(rejected))
+                    rows = list(rows) + list(rejected)
+                # planes mesh peers addressed to US this rendezvous
+                self.apply_collective_blocks(landed_planes)
+                if ch is not None:
+                    if rejected:
+                        ch.add_tag("rejected", str(len(rejected)))
+                    if cyc is not None:
+                        cyc.finish(ch)
         data = fwd.serialize(rows)
         routed = None
         try:
-            routed = fwd.route(data)
+            routed = fwd.route(data, ring=ring)
         except Exception:
             log.exception("columnar forward route failed; falling "
                           "back to the per-row path")
@@ -2722,6 +2895,19 @@ class Server:
                         max(0, new_counts.get(m, 0)
                             - old_counts.get(m, 0))
                         for m in set(new_counts) | set(old_counts))
+            if coll_groups:
+                # the collective rows re-route against the pre-swap
+                # ring too: their moved arcs are the same rebalance,
+                # counted scalar-wise over the grouped subset
+                from veneur_tpu.forward.shard import row_route_key
+                old_cc: dict[str, int] = {}
+                for g in coll_groups.values():
+                    for row in g:
+                        d = prev_ring.get(row_route_key(row))
+                        old_cc[d] = old_cc.get(d, 0) + 1
+                moved += sum(
+                    max(0, len(g) - old_cc.get(d, 0))
+                    for d, g in coll_groups.items())
             if led is not None:
                 self.ledger.credit_reshard(
                     led, epoch, added, removed, moved)
@@ -2854,6 +3040,8 @@ class Server:
             self._spool_ledger.seal_snapshot(
                 fwd.spool.stats(),
                 seq=led.seq if led is not None else 0)
+        for dest, n in coll_split.items():
+            split[dest] = split.get(dest, 0) + n
         return split
 
     def _forward_http(self, rows, trace_ctx=None, led=None) -> None:
@@ -3254,6 +3442,20 @@ class Server:
                     "replayed_items", "expired_items",
                     "inflight_items"):
             row[f"spool.{key}"] = (sp or {}).get(key, 0)
+        # collective forward plane-exchange (zeros until the
+        # transport builds — the schema is fixed at construction)
+        coll = getattr(self, "_collective_fwd", None)
+        cst = coll.stats() if coll is not None else {}
+        row["forward.collective.cycles"] = cst.get("cycles", 0)
+        row["forward.collective.rows"] = cst.get("sent_rows", 0)
+        row["forward.collective.rejected_rows"] = cst.get(
+            "rejected_rows", 0)
+        row["forward.collective.fallback_cycles"] = cst.get(
+            "fallback_cycles", 0)
+        row["forward.collective.landed_blocks"] = cst.get(
+            "landed_blocks", 0)
+        row["forward.collective.items_received"] = st.get(
+            "collective_items_received", 0)
         fan = (self._fanout.stats()
                if getattr(self, "_fanout", None) is not None else {})
         row["sink.flushes"] = sum(
@@ -3440,6 +3642,8 @@ class Server:
             self._grpc_client.close()
         if self._sharded_fwd is not None:
             self._sharded_fwd.stop()
+        if self._collective_fwd is not None:
+            self._collective_fwd.stop()
         if self.flight is not None:
             self.flight.stop()
         for s in self.metric_sinks + self.span_sinks:

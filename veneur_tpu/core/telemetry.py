@@ -194,6 +194,23 @@ class Telemetry:
         count("veneur.forward.shard.fallback_total",
               self._delta("sharded_forward_fallbacks"),
               ("reason:forward",))
+        # collective forward plane-exchange: cycles and rows that
+        # rode the mesh instead of the wire, schema-capacity rows
+        # rejected back onto the wire, whole cycles that fell open
+        # (exchange error/deadline), and items a global folded off
+        # landed planes (the collective twin of imports_received)
+        count("veneur.forward.collective.cycles_total",
+              self._delta("collective_forward_cycles"))
+        count("veneur.forward.collective.rows_total",
+              self._delta("collective_forward_rows"))
+        count("veneur.forward.collective.rejected_rows_total",
+              self._delta("collective_rejected_rows"))
+        count("veneur.forward.collective.fallback_total",
+              self._delta("collective_forward_fallbacks"))
+        count("veneur.forward.collective.fallback_rows_total",
+              self._delta("collective_fallback_rows"))
+        count("veneur.import.collective_items_total",
+              self._delta("collective_items_received"))
         # live-reshard + deadline accounting (zero-downtime ops):
         # membership swaps, the rows they moved, and per-interval rows
         # dropped because a send missed the interval deadline

@@ -117,6 +117,13 @@ class LedgerRecord:
     #    ``forwarded == sum(dests) + dropped`` below
     forward_split: dict[str, int] = field(default_factory=dict)
     forward_split_dropped: int = 0
+    # rows that shipped to a mesh-peer destination over the collective
+    # plane-exchange INSTEAD of its wire (synchronous at pack time,
+    # like the wire split) — the seal treats both transports as one
+    # conservation: ``forwarded == Σ wire split + Σ collective split
+    # + spooled + dropped``.  A collective fall-open re-credits the
+    # cycle's rows to the wire split, never here.
+    forward_collective: dict[str, int] = field(default_factory=dict)
     # rows whose wire went to the outage spool INSTEAD of a worker
     # (breaker open at route time) — synchronous like the split, so
     # the seal extends to ``forwarded == sum(dests) + spooled +
@@ -216,6 +223,8 @@ class LedgerRecord:
                      "retained": self.retained_rows},
             "emitted_per_sink": dict(self.emitted_per_sink),
             "forward_split": {"per_dest": dict(self.forward_split),
+                              "collective_per_dest": dict(
+                                  self.forward_collective),
                               "dropped": self.forward_split_dropped,
                               "spooled": self.forward_spooled,
                               "owed": self.split_owed},
@@ -379,6 +388,18 @@ class Ledger:
                     rec.forward_split.get(dest, 0) + int(rows))
             rec.forward_split_dropped += int(dropped)
 
+    def credit_forward_collective(self, rec: LedgerRecord, dest: str,
+                                  rows: int) -> None:
+        """Credit rows shipped to a mesh peer over the collective
+        plane-exchange — synchronous at pack time, the collective twin
+        of :meth:`credit_forward_split`.  Seal conserves the two
+        transports together: ``forwarded == Σ wire split +
+        Σ collective split + spooled + dropped``."""
+        with self._lock:
+            if rows:
+                rec.forward_collective[dest] = (
+                    rec.forward_collective.get(dest, 0) + int(rows))
+
     def credit_forward_spooled(self, rec: LedgerRecord,
                                rows: int = 0) -> None:
         """Credit rows routed INTO the outage spool at route time
@@ -471,10 +492,12 @@ class Ledger:
             # overran the interval budget can't fake an imbalance.
             # Spooled rows are a full-fledged split outcome: an
             # outage the spool absorbed balances instead of owing.
-            if (rec.forward_split or rec.forward_split_dropped
+            if (rec.forward_split or rec.forward_collective
+                    or rec.forward_split_dropped
                     or rec.forward_spooled):
                 rec.split_owed = rec.forwarded_rows - (
                     sum(rec.forward_split.values())
+                    + sum(rec.forward_collective.values())
                     + rec.forward_spooled
                     + rec.forward_split_dropped)
             rec.recovered_owed = rec.recovered - sum(
@@ -562,6 +585,13 @@ class Ledger:
             out["forward_split_total"] = sum(per_dest.values())
             out["forward_split_dropped_total"] = sum(
                 r.forward_split_dropped for r in recs)
+        if any(r.forward_collective for r in recs):
+            per_dest = {}
+            for r in recs:
+                for dest, n in r.forward_collective.items():
+                    per_dest[dest] = per_dest.get(dest, 0) + n
+            out["forward_collective_per_dest"] = per_dest
+            out["forward_collective_total"] = sum(per_dest.values())
         spooled = sum(r.forward_spooled for r in recs)
         spooled_async = sum(r.forward_spooled_async for r in recs)
         replayed = sum(r.forward_replayed for r in recs)
