@@ -4174,6 +4174,260 @@ def overload_bench() -> dict:
     return out
 
 
+def cardinality_bench() -> dict:
+    """``--cardinality``: the adaptive-precision tier soak — ISSUE
+    19's deliverable.  Drives a tiered Server (VENEUR_TPU_PLANE_TIERS
+    forced on) with Zipf-distributed histogram + set traffic at a
+    cardinality far past the wide pool, so the head of the
+    distribution promotes to device-width sketches while the tail
+    stays compact (host raw samples / sparse HLL).  Passes when
+    ``device_bytes_per_series`` holds >= 4x below the analytic
+    all-wide baseline AND flat across steady intervals, the accuracy
+    pins on tracked hot (promoted) and cold (compact) series hold,
+    promotions AND demotions both fire and are named in the ledger,
+    and nothing is lost unattributed."""
+    from veneur_tpu.core.config import read_config
+    from veneur_tpu.core.server import Server
+    from veneur_tpu.protocol import columnar
+
+    if QUICK:
+        n_histo, n_set, h_rows, s_rows = 5_000, 1_600, 8_192, 2_048
+        n_samples, n_items, steady = 40_000, 25_000, 3
+    else:
+        n_histo, n_set, h_rows, s_rows = 40_000, 12_000, 65_536, 16_384
+        n_samples, n_items, steady = 300_000, 120_000, 3
+    idle_intervals = 3
+
+    # tier knobs pinned explicitly: the artifact must not drift when
+    # defaults move, and "auto" would resolve on dense-plane size
+    tier_env = {"VENEUR_TPU_PLANE_TIERS": "2",
+                "VENEUR_TPU_PROMOTE_HISTO_SAMPLES": "64",
+                "VENEUR_TPU_PROMOTE_SET_ENTRIES": "512",
+                "VENEUR_TPU_DEMOTE_IDLE_INTERVALS": "2"}
+    saved = {k: os.environ.get(k) for k in tier_env}
+    os.environ.update(tier_env)
+    try:
+        # 10s interval: flushes are manual (flush_once), and a wall
+        # interval shorter than a CPU flush would score as lag and
+        # engage overload pressure — this soak measures tiering, not
+        # shedding, so the pressure thresholds must stay non-binding
+        srv = Server(read_config(data={
+            "interval": "10s", "hostname": "bench-cardinality",
+            "percentiles": [0.5, 0.99],
+            "aggregates": ["max", "count"],
+            "tpu_histo_rows": h_rows,
+            "tpu_set_rows": s_rows,
+        }))
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    parser = columnar.ColumnarParser()
+    if not parser.available:
+        parser = None
+    rng = np.random.default_rng(20260808)
+
+    def feed(lines):
+        if parser is not None:
+            # the drained= path is the pre-validated recvmmsg chunk
+            # entry: every line here is tiny, so big joined chunks
+            # amortize the per-batch lock/apply cost at soak scale
+            for i in range(0, len(lines), 8192):
+                srv.handle_packet_batch(
+                    [], parser,
+                    drained=b"\n".join(lines[i:i + 8192]),
+                    drained_pkts=1)
+        else:
+            for ln in lines:
+                srv.handle_packet(ln)
+
+    uid = 0
+
+    def zipf_interval():
+        """One interval of Zipf head-heavy traffic over the full
+        series population (every draw is a fresh set member, so a
+        set's per-interval distinct count == its draw count)."""
+        nonlocal uid
+        lines = []
+        hz = np.minimum(rng.zipf(1.15, size=n_samples), n_histo) - 1
+        vals = rng.uniform(0.0, 1000.0, size=n_samples)
+        for i, v in zip(hz, vals):
+            lines.append(b"card.h.%d:%.4f|ms" % (i, v))
+        sz = np.minimum(rng.zipf(1.15, size=n_items), n_set) - 1
+        for i in sz:
+            lines.append(b"card.s.%d:m%d|s" % (i, uid))
+            uid += 1
+        return lines
+
+    def tracked_interval():
+        """Controlled-accuracy series riding every hot interval: hot
+        crosses the promote thresholds (device sketch), cold stays
+        under them (compact).  Returns the hot histo sample list."""
+        # rounded to the %.4f wire precision so exact pins (max)
+        # compare the value the server actually saw
+        hot_vals = np.round(rng.uniform(0.0, 1000.0, size=3_000), 4)
+        cold_vals = np.round(rng.uniform(0.0, 1000.0, size=24), 4)
+        lines = [b"card.h.hot:%.4f|ms" % v for v in hot_vals]
+        lines += [b"card.h.cold:%.4f|ms" % v for v in cold_vals]
+        lines += [b"card.s.hot:mh%d|s" % i for i in range(5_000)]
+        lines += [b"card.s.cold:mc%d|s" % i for i in range(60)]
+        return lines, hot_vals, cold_vals
+
+    out: dict = {"mode": "cardinality_soak", "quick": QUICK,
+                 "histo_series": n_histo, "set_series": n_set,
+                 "samples_per_interval": n_samples,
+                 "set_items_per_interval": n_items,
+                 "steady_intervals": steady,
+                 "idle_intervals": idle_intervals,
+                 "native_parser": parser is not None}
+
+    recs = []
+    intervals = []
+
+    def flush():
+        res = srv.flush_once()
+        rec = srv.ledger.last()
+        recs.append(rec)
+        pb = srv.table.plane_bytes()
+        intervals.append({
+            "total_bytes": pb["total"],
+            "device_bytes_per_series": round(
+                pb["device_bytes_per_series"], 3),
+            "occupancy": pb["occupancy"],
+            "histo_wide_rows": pb["tiers"]["occupancy"]["histo"][
+                "wide"],
+            "set_wide_rows": pb["tiers"]["occupancy"]["set"]["wide"],
+        })
+        return res, pb
+
+    # ---- steady phase: Zipf churn, head promotes ---------------------
+    t0 = time.perf_counter()
+    # interval 1 touches the WHOLE population once so the occupancy
+    # (the denominator of device_bytes_per_series, and the baseline's
+    # row count) is the advertised cardinality, not the Zipf reach
+    feed([b"card.h.%d:1|ms" % i for i in range(n_histo)])
+    feed([b"card.s.%d:seed|s" % i for i in range(n_set)])
+    res = pb = hot_vals = cold_vals = None
+    for _ in range(steady):
+        lines, hot_vals, cold_vals = tracked_interval()
+        feed(lines)
+        feed(zipf_interval())
+        res, pb = flush()
+    out["ingest_flush_seconds_steady"] = round(
+        time.perf_counter() - t0, 3)
+
+    # accuracy pins read from the LAST steady flush, against the
+    # exact per-interval feed (histos and sets reset each interval)
+    emitted = {m.name: m.value for m in res.metrics
+               if m.name.startswith(("card.h.hot", "card.h.cold",
+                                     "card.s.hot", "card.s.cold"))}
+    hot_p99_true = float(np.quantile(hot_vals, 0.99))
+    cold_p99_true = float(np.quantile(cold_vals, 0.99))
+    acc = {
+        "hot_p99": emitted.get("card.h.hot.99percentile"),
+        "hot_p99_true": round(hot_p99_true, 4),
+        "cold_p99": emitted.get("card.h.cold.99percentile"),
+        "cold_p99_true": round(cold_p99_true, 4),
+        "hot_count": emitted.get("card.h.hot.count"),
+        "hot_max": emitted.get("card.h.hot.max"),
+        "hot_max_true": round(float(hot_vals.max()), 4),
+        "set_hot_est": emitted.get("card.s.hot"),
+        "set_hot_true": 5_000,
+        "set_cold_est": emitted.get("card.s.cold"),
+        "set_cold_true": 60,
+    }
+    out["accuracy"] = acc
+
+    def _rel(got, want):
+        if got is None:
+            return float("inf")
+        return abs(float(got) - want) / max(abs(want), 1e-9)
+
+    # measured memory vs the analytic all-wide baseline: same
+    # occupancy, every occupied histo/set row carrying a full-width
+    # device sketch instead of a pooled slot
+    occ_h = srv.table.histo_idx.occupancy()
+    occ_s = srv.table.set_idx.occupancy()
+    ti = pb["tiers"]["occupancy"]
+    h_slot_b = pb["histo"]["wide"] / max(1, ti["histo"]["wide_slots"])
+    s_slot_b = pb["set"]["wide"] / max(1, ti["set"]["wide_slots"])
+    baseline_total = (pb["counter"]["wide"] + pb["gauge"]["wide"] +
+                      pb["histo"]["stats"] + occ_h * h_slot_b +
+                      occ_s * s_slot_b)
+    baseline_dbps = baseline_total / max(1, pb["occupancy"])
+    measured_dbps = pb["device_bytes_per_series"]
+    out["baseline_all_wide_bytes"] = int(baseline_total)
+    out["baseline_device_bytes_per_series"] = round(baseline_dbps, 3)
+    out["device_bytes_per_series"] = round(measured_dbps, 3)
+    out["dbps_reduction_x"] = round(
+        baseline_dbps / max(measured_dbps, 1e-9), 2)
+
+    # ---- idle phase: the head goes quiet, demotions fire -------------
+    for j in range(idle_intervals):
+        feed([b"card.h.tail%d:1|ms" % (j * 500 + i)
+              for i in range(500)])
+        flush()
+    out["intervals"] = intervals
+
+    mv = srv.table.plane_bytes()["tiers"]["movements"]
+    out["movements"] = mv
+    promotions_total = sum(c["promotions"] for c in mv.values())
+    demotions_total = sum(c["demotions"] for c in mv.values())
+    out["promotions_total"] = promotions_total
+    out["demotions_total"] = demotions_total
+    led_promotions = sum(r.tier_promotions for r in recs)
+    led_demotions = sum(r.tier_demotions for r in recs)
+
+    ledsum = srv.ledger.summary()
+    srv.shutdown()
+    unattributed = (ledsum["imbalanced"] + ledsum["owed_total"]
+                    + ledsum.get("shed_owed_total", 0))
+    out["ledger"] = ledsum
+    out["unattributed_lost"] = int(unattributed)
+
+    steadies = [iv["total_bytes"] for iv in intervals[:steady]]
+    gates = {
+        # the tentpole number: tiering holds device memory >= 4x
+        # under what all-wide sketches would cost at this occupancy
+        "dbps_bounded_4x": out["dbps_reduction_x"] >= 4.0,
+        # pooled planes are preallocated: steady-state totals stay
+        # flat (only the O(rows) directory grows with new series)
+        "dbps_flat_steady": (max(steadies) <= 1.10 * min(steadies)),
+        # accuracy pins: promoted head rides the device digest,
+        # compact tail interpolates its exact raw samples
+        "histo_hot_p99_pinned": _rel(acc["hot_p99"],
+                                     hot_p99_true) <= 0.02,
+        "histo_cold_p99_pinned": _rel(acc["cold_p99"],
+                                      cold_p99_true) <= 0.05,
+        "histo_hot_count_exact": acc["hot_count"] == 3_000,
+        "histo_hot_max_exact": acc["hot_max"] is not None and
+            float(acc["hot_max"]) == np.float32(hot_vals.max()),
+        "set_hot_est_pinned": _rel(acc["set_hot_est"],
+                                   5_000.0) <= 0.04,
+        "set_cold_est_pinned": _rel(acc["set_cold_est"],
+                                    60.0) <= 0.02,
+        # both movements fired, and the ledger names every one
+        "promotions_fired": mv["histo"]["promotions"] > 0
+            and mv["set"]["promotions"] > 0,
+        "demotions_fired": demotions_total > 0,
+        "ledger_names_movements": (
+            led_promotions == promotions_total
+            and led_demotions == demotions_total),
+        # conservation: precision moved, mass never did
+        "unattributed_zero": unattributed == 0,
+        "ledgers_balanced": ledsum["imbalanced"] == 0,
+    }
+    gates = {k: bool(v) for k, v in gates.items()}
+    out["cardinality_gates"] = gates
+    out["cardinality_pass"] = all(gates.values())
+    out.update(_backend_info())
+    out["captured_unix"] = round(time.time(), 1)
+    _save_artifact("cardinality_soak", out)
+    return out
+
+
 CONFIGS = (
     ("0_counters_1k_names", bench_counters),
     ("1_cardinality_100k", bench_cardinality),
@@ -4382,6 +4636,15 @@ def _summary_line(out: dict) -> str:
             "single_line", {}).get("packets_per_sec")
         line["uring_speedup_single_line"] = out.get(
             "uring_speedup_single_line")
+    # adaptive-tier verdict: present only for --cardinality
+    # artifacts (ISSUE 19)
+    if out.get("cardinality_pass") is not None:
+        line["cardinality_pass"] = out["cardinality_pass"]
+        line["device_bytes_per_series"] = out.get(
+            "device_bytes_per_series")
+        line["dbps_reduction_x"] = out.get("dbps_reduction_x")
+        line["promotions_total"] = out.get("promotions_total")
+        line["demotions_total"] = out.get("demotions_total")
     # collective-forward verdict: present only for
     # --collective-forward artifacts (ISSUE 18)
     if out.get("collective_items_per_sec") is not None:
@@ -4532,6 +4795,24 @@ if __name__ == "__main__":
                           "flight_bundles": out.get("flight_bundles"),
                           "signal_rows": out.get("signal_rows"),
                           "gates": out.get("overload_gates")},
+                         separators=(",", ":")))
+    elif "--cardinality" in sys.argv:
+        out = cardinality_bench()
+        print(json.dumps(out))
+        print(json.dumps({"cardinality_summary": True,
+                          "cardinality_pass": out.get(
+                              "cardinality_pass"),
+                          "device_bytes_per_series": out.get(
+                              "device_bytes_per_series"),
+                          "dbps_reduction_x": out.get(
+                              "dbps_reduction_x"),
+                          "promotions_total": out.get(
+                              "promotions_total"),
+                          "demotions_total": out.get(
+                              "demotions_total"),
+                          "unattributed_lost": out.get(
+                              "unattributed_lost"),
+                          "gates": out.get("cardinality_gates")},
                          separators=(",", ":")))
     elif "--config" in sys.argv:
         _run_one_config(sys.argv[sys.argv.index("--config") + 1])

@@ -1,0 +1,284 @@
+"""Adaptive-precision tier smoke (tier-1, <30s): the per-series
+plane-pool ladder of core/tiers.py exercised end to end through a
+real Server.
+
+Four guarantees ride here; the 10M-series soak behind ``bench.py
+--cardinality`` scales them, this file pins them:
+
+- promote -> demote -> re-promote is a NAMED, balanced movement:
+  every ledger record seals balanced, the per-interval tier fields
+  sum to the directory's cumulative counters, and no mass is lost
+  across any flip;
+- single-tier parity: a tiered server and a wide-only server fed the
+  same traffic emit bit-identical scalars, compact-row quantiles and
+  set estimates (compact rows below the t-digest singleton bound ARE
+  the digest the wide tier would build); promoted rows agree within
+  digest batching tolerance (merge order differs by design);
+- a mid-interval checkpoint of MIXED-tier staged state recovers into
+  a fresh incarnation exactly once, balanced, with mass conserved —
+  tier bits are routing, never wire state;
+- the pressure ladder composes: level >= 2 freezes promotions
+  (compact rows stay exact, nothing shrinks twice), release restores
+  each series' own tier.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from veneur_tpu.core.config import read_config
+from veneur_tpu.core.server import Server
+
+TIER_ENV = {
+    "VENEUR_TPU_PLANE_TIERS": "2",
+    "VENEUR_TPU_PROMOTE_HISTO_SAMPLES": "16",
+    "VENEUR_TPU_PROMOTE_SET_ENTRIES": "16",
+    "VENEUR_TPU_DEMOTE_IDLE_INTERVALS": "1",
+}
+
+
+def _server(monkeypatch, env=TIER_ENV, **extra):
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    data = {"statsd_listen_addresses": [],
+            "grpc_listen_addresses": [],
+            "interval": "10s", "hostname": "ap",
+            "percentiles": [0.5], "aggregates": ["min", "max",
+                                                 "count"],
+            "tpu_histo_rows": 1024, "tpu_set_rows": 512}
+    data.update(extra)
+    return Server(read_config(data=data))
+
+
+def _feed(srv, lines):
+    for i in range(0, len(lines), 8):
+        for ln in lines[i:i + 8]:
+            srv.handle_packet(ln)
+
+
+def _movements(srv):
+    return srv.table.plane_bytes()["tiers"]["movements"]
+
+
+def _wide_counts(srv):
+    ti = srv.table.plane_bytes()["tiers"]["occupancy"]
+    return ti["histo"]["wide"], ti["set"]["wide"]
+
+
+# ----------------------------------------------------------------------
+# promote -> demote -> re-promote, ledger-attributed
+
+
+def test_promote_demote_repromote_balanced(monkeypatch):
+    srv = _server(monkeypatch)
+    try:
+        hot = [b"ap.hot:%d|ms" % i for i in range(32)]
+        hot_set = [b"ap.s:m%d|s" % i for i in range(32)]
+        cold = [b"ap.cold:1|ms", b"ap.cold:2|ms"]
+
+        # interval 1: hot series cross the promote thresholds while
+        # compact; the boundary flips them for interval 2
+        _feed(srv, hot + hot_set + cold)
+        res1 = srv.flush_once()
+        assert _wide_counts(srv) == (1, 1)
+        mv = _movements(srv)
+        assert mv["histo"]["promotions"] == 1
+        assert mv["set"]["promotions"] == 1
+        v1 = {m.name: m.value for m in res1.metrics}
+        # the promoting interval itself emitted from the exact
+        # compact state: nothing dropped on the way up
+        assert v1["ap.hot.count"] == 32
+        assert v1["ap.s"] == 32
+
+        # interval 2: the hot rows ride the wide pool
+        _feed(srv, hot + hot_set)
+        res2 = srv.flush_once()
+        v2 = {m.name: m.value for m in res2.metrics}
+        assert v2["ap.hot.count"] == 32
+        assert v2["ap.hot.max"] == 31.0
+        assert v2["ap.s"] == 32
+
+        # interval 3: hot goes quiet -> idle crosses demote_idle=1
+        _feed(srv, cold)
+        srv.flush_once()
+        mv = _movements(srv)
+        assert _wide_counts(srv) == (0, 0)
+        assert mv["histo"]["demotions"] == 1
+        assert mv["set"]["demotions"] == 1
+
+        # interval 4: traffic returns -> boundary re-promotes
+        _feed(srv, hot + hot_set)
+        res4 = srv.flush_once()
+        v4 = {m.name: m.value for m in res4.metrics}
+        assert v4["ap.hot.count"] == 32
+        srv.flush_once()  # seal the re-promotion boundary's record
+        mv = _movements(srv)
+        assert mv["histo"]["promotions"] == 2
+        assert mv["set"]["promotions"] == 2
+
+        # the ledger names every movement: per-interval fields sum to
+        # the directory's cumulative counters, and nothing imbalances
+        recs = srv.ledger.records()
+        led_p = sum(r.tier_promotions for r in recs)
+        led_d = sum(r.tier_demotions for r in recs)
+        assert led_p == (mv["histo"]["promotions"]
+                         + mv["set"]["promotions"])
+        assert led_d == (mv["histo"]["demotions"]
+                         + mv["set"]["demotions"])
+        for r in recs:
+            assert r.balanced, r.to_dict()
+        summ = srv.ledger.summary()
+        assert summ["imbalanced"] == 0
+        assert summ["owed_total"] == 0
+    finally:
+        srv.shutdown()
+
+
+# ----------------------------------------------------------------------
+# bit parity vs the forced single-tier oracle
+
+
+def test_parity_tiered_vs_wide_only(monkeypatch):
+    rng = np.random.default_rng(7)
+    # compact rows stay under the t-digest singleton bound (31 unit-
+    # weight samples for delta=100): below it the compact raw-sample
+    # plane IS the digest the wide tier would have built, so their
+    # quantiles must match BITWISE.  The hot row crosses the promote
+    # threshold; its quantiles may differ by merge batching only.
+    compact_feeds = {f"pr.h{i}": np.round(
+        rng.uniform(0, 100, size=int(rng.integers(3, 31))), 3)
+        for i in range(6)}
+    hot_feed = np.round(rng.uniform(0, 100, size=200), 3)
+    set_feeds = {f"pr.s{i}": int(rng.integers(5, 40))
+                 for i in range(4)}
+    hot_set_n = 300
+
+    def lines():
+        out = []
+        for name, vals in compact_feeds.items():
+            out += [b"%s:%.3f|ms" % (name.encode(), v)
+                    for v in vals]
+        out += [b"pr.hot:%.3f|ms" % v for v in hot_feed]
+        for name, n in set_feeds.items():
+            out += [b"%s:m%d|s" % (name.encode(), j)
+                    for j in range(n)]
+        out += [b"pr.shot:m%d|s" % j for j in range(hot_set_n)]
+        return out
+
+    def run(mode):
+        env = dict(TIER_ENV)
+        env["VENEUR_TPU_PLANE_TIERS"] = mode
+        env["VENEUR_TPU_PROMOTE_HISTO_SAMPLES"] = "100"
+        env["VENEUR_TPU_PROMOTE_SET_ENTRIES"] = "100"
+        srv = _server(monkeypatch, env=env,
+                      percentiles=[0.5, 0.99])
+        try:
+            out = []
+            for _ in range(2):  # interval 2 exercises the wide pool
+                _feed(srv, lines())
+                res = srv.flush_once()
+                out.append({m.name: m.value for m in res.metrics
+                            if m.name.startswith("pr.")})
+            if mode == "2":
+                assert _wide_counts(srv) == (1, 1)
+            else:
+                assert srv.table.tiers is None
+            return out
+        finally:
+            srv.shutdown()
+
+    tiered, oracle = run("2"), run("off")
+    tolerant = {"pr.hot.50percentile", "pr.hot.99percentile"}
+    for ti, orc in zip(tiered, oracle):
+        assert set(ti) == set(orc)
+        for name in orc:
+            if name in tolerant:
+                assert ti[name] == pytest.approx(orc[name],
+                                                 rel=2e-2), name
+            else:
+                # bitwise: scalars, compact quantiles, set estimates
+                assert ti[name] == orc[name], name
+
+
+# ----------------------------------------------------------------------
+# checkpoint round-trip of mixed-tier state
+
+
+def test_checkpoint_roundtrip_mixed_tier(monkeypatch, tmp_path):
+    pytest.importorskip("grpc")
+    d = str(tmp_path)
+
+    def mk():
+        s = _server(monkeypatch,
+                    tpu_checkpoint_dir=d,
+                    tpu_checkpoint_interval="30s")
+        s.start()  # checkpointer + recovery replay live in start()
+        return s
+
+    s1 = mk()
+    try:
+        # interval 1 promotes the hot histo; interval 2 then stages
+        # MIXED-tier state: a wide hot row + compact cold rows + set
+        # members, captured mid-interval
+        _feed(s1, [b"ck.hot:%d|ms" % i for i in range(20)])
+        s1.flush_once()
+        assert _wide_counts(s1)[0] == 1
+        _feed(s1, [b"ck.hot:%d|ms" % i for i in range(20)]
+              + [b"ck.cold:%d|ms" % i for i in range(5)]
+              + [b"ck.s:m%d|s" % i for i in range(12)])
+        assert s1._checkpointer.run_once()
+    finally:
+        s1.shutdown()  # stands in for the crash
+
+    s2 = mk()
+    try:
+        assert s2.stats.get("recovery_segments_replayed", 0) == 1
+        res = s2.flush_once()
+        rec = s2.ledger.last()
+        assert rec.sealed and rec.balanced, rec.to_dict()
+        assert rec.recovered > 0
+        assert rec.recovered_owed == 0
+        vals = {m.name: m.value for m in res.metrics}
+        # mass conserved through the mixed-tier capture (recovery
+        # rides the wire-import path, which emits percentiles and
+        # set estimates; count/max are local-stats aggregates)
+        assert vals["ck.hot.50percentile"] == pytest.approx(
+            9.5, abs=1.0)
+        assert vals["ck.cold.50percentile"] == pytest.approx(
+            2.0, abs=1.0)
+        assert vals["ck.s"] == pytest.approx(12, abs=1)
+    finally:
+        s2.shutdown()
+
+
+# ----------------------------------------------------------------------
+# pressure-ladder composition
+
+
+def test_pressure_freeze_composes_with_tiers(monkeypatch):
+    srv = _server(monkeypatch)
+    try:
+        hot = [b"pf.hot:%d|ms" % i for i in range(32)]
+
+        # level >= 2: promotions freeze; the row stays compact (and
+        # EXACT) rather than shrinking twice under the width ladder
+        srv.table.set_pressure_level(2)
+        assert srv.table.tiers.promote_frozen
+        _feed(srv, hot)
+        res1 = srv.flush_once()
+        assert _wide_counts(srv)[0] == 0
+        assert _movements(srv)["histo"]["promotions"] == 0
+        v1 = {m.name: m.value for m in res1.metrics}
+        assert v1["pf.hot.count"] == 32  # frozen != lossy
+
+        # release restores the series' own tier trajectory: the next
+        # over-threshold interval promotes normally
+        srv.table.set_pressure_level(0)
+        assert not srv.table.tiers.promote_frozen
+        _feed(srv, hot)
+        srv.flush_once()
+        assert _wide_counts(srv)[0] == 1
+        assert _movements(srv)["histo"]["promotions"] == 1
+    finally:
+        srv.shutdown()

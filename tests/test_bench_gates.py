@@ -928,3 +928,86 @@ def test_collective_forward_quick_rerun():
     line = json.loads(out.stdout.strip().splitlines()[-1])
     assert line["collective_items_per_sec"] > 0
     assert line["mesh_procs"] == 2
+
+
+# ----------------------------------------------------------------------
+# adaptive-precision tier soak (ISSUE 19)
+
+
+def test_cardinality_soak_artifact_committed():
+    """bench.py --cardinality: the adaptive-tier soak.  Zipf traffic
+    at 52k series against pooled wide slots — the committed artifact
+    must hold device_bytes_per_series >= 4x under the analytic
+    all-wide baseline, FLAT across steady intervals, with the
+    accuracy pins (promoted p99, compact p99, exact count/max, HLL
+    estimates) intact, both movements fired and ledger-named, and
+    zero unattributed loss."""
+    d = _committed_artifact("cardinality_soak.json")
+    assert d["mode"] == "cardinality_soak" and d["quick"] is False
+    assert d["cardinality_pass"] is True
+    for gate, ok in d["cardinality_gates"].items():
+        assert ok is True, gate
+
+    assert d["dbps_reduction_x"] >= 4.0
+    assert (d["device_bytes_per_series"]
+            < d["baseline_device_bytes_per_series"] / 4.0)
+    # flat: every interval's pooled total within 10% of the smallest
+    totals = [iv["total_bytes"] for iv in d["intervals"]]
+    assert max(totals) <= 1.10 * min(totals)
+    # both movements fired, attributed per class, refusals included
+    mv = d["movements"]
+    assert mv["histo"]["promotions"] > 0 and mv["set"][
+        "promotions"] > 0
+    assert d["demotions_total"] > 0
+    assert d["promotions_total"] == sum(
+        c["promotions"] for c in mv.values())
+    # idle tail demoted the whole wide pool back to compact
+    assert d["intervals"][-1]["histo_wide_rows"] == 0
+    assert d["intervals"][-1]["set_wide_rows"] == 0
+    # conservation: precision moved, mass never did
+    assert d["unattributed_lost"] == 0
+    assert d["ledger"]["imbalanced"] == 0
+    # provenance travels on the artifact
+    assert "platform" in d and "kernel_release" in d
+
+
+@pytest.mark.slow
+def test_cardinality_soak_quick_rerun():
+    """Re-run the adaptive-tier soak end to end (quick scale) — the
+    committed artifact's gates must be reproducible, not a lucky
+    capture."""
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--cardinality", "--quick"],
+        env={**_ENV, "VENEUR_BENCH_PLATFORM": "cpu"},
+        capture_output=True, text=True, timeout=560,
+        cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr[-2000:]
+    d = json.loads(out.stdout.strip().splitlines()[-1])
+    assert d["cardinality_summary"] is True
+    assert d["cardinality_pass"] is True, d["gates"]
+    assert d["dbps_reduction_x"] >= 4.0
+    assert d["unattributed_lost"] == 0
+
+
+def test_summary_line_cardinality_fields():
+    """The --cardinality summary line carries exactly its verdict
+    (and the normal line never grows the cardinality fields)."""
+    m = _bench_module()
+    cline = m._summary_line({
+        "cardinality_pass": True,
+        "device_bytes_per_series": 1489.1,
+        "dbps_reduction_x": 5.12,
+        "promotions_total": 334,
+        "demotions_total": 334,
+        "platform": "cpu"})
+    assert len(cline) < 1024
+    cd = json.loads(cline)
+    assert cd["cardinality_pass"] is True
+    assert cd["dbps_reduction_x"] == 5.12
+    assert cd["promotions_total"] == 334
+
+    nline = m._summary_line({"platform": "cpu"})
+    nd = json.loads(nline)
+    assert "cardinality_pass" not in nd
+    assert "dbps_reduction_x" not in nd

@@ -484,3 +484,60 @@ def test_performance_doc_covers_collective_forward():
             "bench_results/collective_forward.json",
     ):
         assert needle in perf, needle
+
+
+def test_adaptive_tier_metrics_documented():
+    """ISSUE 19 names, pinned explicitly: the per-class/per-tier
+    sketch byte gauges and the boundary's movement counters."""
+    for name in (
+            "veneur.device.plane_bytes",
+            "veneur.device.plane_bytes_per_series",
+            "veneur.tier.promotions_total",
+            "veneur.tier.demotions_total",
+            "veneur.tier.escalations_total",
+            "veneur.tier.promote_refused_total",
+            "veneur.tier.wide_rows",
+            "veneur.tier.free_slots",
+    ):
+        assert name in DOCS, name
+        assert any(name in (ROOT / m).read_text() for m in SCANNED), \
+            name
+    # the three sibling surfaces the same accounting rides
+    assert "planes" in DOCS
+    assert "table.plane_bytes_total" in DOCS
+    assert "table.plane_bytes_per_series" in DOCS
+    assert "table.tier_promotions" in DOCS
+
+
+def test_adaptive_tier_env_vars_documented():
+    """ISSUE 19 knobs: the tier gate, pool sizing, and promote/demote
+    economics must appear in the README env table, the performance
+    doc that explains the mechanism, AND docs/observability.md."""
+    readme = (ROOT / "README.md").read_text()
+    perf = (ROOT / "docs" / "performance.md").read_text()
+    for var in ("VENEUR_TPU_PLANE_TIERS",
+                "VENEUR_TPU_TIER_AUTO_BYTES",
+                "VENEUR_TPU_TIER_WIDE_SLOTS",
+                "VENEUR_TPU_PROMOTE_HISTO_SAMPLES",
+                "VENEUR_TPU_PROMOTE_SET_ENTRIES",
+                "VENEUR_TPU_DEMOTE_IDLE_INTERVALS"):
+        assert var in readme, var
+        assert var in perf, var
+        assert var in DOCS, var
+
+
+def test_performance_doc_covers_adaptive_tiers():
+    """The 'Adaptive sketch tiers' section: the tier table, the
+    boundary semantics, the lossless-upgrade contract, the ledger
+    naming, and the committed cardinality soak."""
+    perf = (ROOT / "docs" / "performance.md").read_text()
+    for needle in (
+            "Adaptive sketch tiers",
+            "singleton bound",
+            "named ledger movement",
+            "routing, never wire state",
+            "device_bytes_per_series",
+            "bench_results/cardinality_soak.json",
+            "unattributed_lost == 0",
+    ):
+        assert needle in perf, needle

@@ -78,6 +78,24 @@ def _dist(est: np.ndarray, n: int) -> dict:
             "max_abs": float(np.abs(rel).max())}
 
 
+def _sparse_rows(rng: np.random.Generator, n: int):
+    """The same TRIALS x n member draw routed through the compact
+    tier's SparseSetStore: returns (store, dense_plane) where the
+    dense plane is np.maximum.at ground truth over the same hashes —
+    the store's materialize() must reproduce it bit-for-bit."""
+    from veneur_tpu.core.tiers import SparseSetStore
+    store = SparseSetStore(TRIALS)
+    plane = np.zeros((TRIALS, hll.M), np.uint8)
+    for r in range(TRIALS):
+        h = rng.integers(0, 2**64, n, dtype=np.uint64)
+        idx, rank = hashing.hll_position(h)
+        np.maximum.at(plane[r], idx, rank.astype(np.uint8))
+        packed = ((idx.astype(np.int64) << 6)
+                  | rank.astype(np.int64)).astype(np.int32)
+        store.append(np.full(n, r, np.int32), packed)
+    return store, plane
+
+
 @pytest.fixture(scope="module")
 def sweep():
     import jax
@@ -95,6 +113,19 @@ def sweep():
         est32 = np.asarray(hll.estimate(jax.numpy.asarray(plane)))
         est16 = hll.estimate_from_stats(
             ez.astype(np.float16), inv.astype(np.float16))
+        # compact-tier arm (ISSUE 19): the same members held as a
+        # sparse (index,rank) list — its sufficient statistics and
+        # its dense materialization against the same estimator
+        store, splane = _sparse_rows(rng, n)
+        sstats = np.array([store.stats(r) for r in range(TRIALS)])
+        est_sparse = hll.estimate_from_stats(sstats[:, 0],
+                                             sstats[:, 1])
+        est_dense64 = hll.estimate_from_stats(*_stats(splane))
+        promoted = np.array([store.materialize(r)
+                             for r in range(TRIALS)], np.uint8)
+        exact_upgrade = bool((promoted == splane).all())
+        est_promoted = np.asarray(hll.estimate(
+            jax.numpy.asarray(promoted)))
         out[n] = {
             "occupancy": occupancy,
             "f64": _dist(est64, n),
@@ -102,6 +133,14 @@ def sweep():
             "f16_stats_bound": _dist(est16, n),
             "f32_vs_f64_max_rel": float(
                 (np.abs(est32.astype(np.float64) - est64) / n).max()),
+            "sparse_tier": _dist(est_sparse, n),
+            "sparse_vs_dense_max_rel": float(
+                (np.abs(est_sparse - est_dense64) / n).max()),
+            "promotion_boundary": _dist(est_promoted, n),
+            "promotion_exact_upgrade": exact_upgrade,
+            "promotion_vs_sparse_max_rel": float((np.abs(
+                est_promoted.astype(np.float64) - est_sparse)
+                / n).max()),
         }
     return out
 
@@ -146,6 +185,31 @@ def test_f16_stats_bound_recorded(sweep):
     for n in REGIMES:
         d = sweep[n]["f16_stats_bound"]
         assert abs(d["mean"]) < 0.05, (n, d)
+
+
+@pytest.mark.parametrize("n", REGIMES)
+def test_sparse_tier_matches_dense_stats(sweep, n):
+    """The compact set tier is EXACT: the sparse (index,rank) list's
+    sufficient statistics equal the dense fold's, so the LogLog-Beta
+    estimate is identical whichever tier holds the row — the tier
+    choice is a memory decision, never an accuracy one."""
+    assert sweep[n]["sparse_vs_dense_max_rel"] < 1e-9
+    d = sweep[n]["sparse_tier"]
+    assert abs(d["mean"]) < MEAN_TOL, d
+    assert d["std"] < 0.025, d
+
+
+@pytest.mark.parametrize("n", REGIMES)
+def test_promotion_boundary_continuity(sweep, n):
+    """The promotion upgrade is lossless: materializing the sparse
+    list reproduces the dense register row bit-for-bit, and the
+    device (f32) estimate over the promoted plane sits within f32
+    accumulation noise of the pre-promotion sparse estimate — mean
+    error continuity ~0 across the boundary."""
+    assert sweep[n]["promotion_exact_upgrade"]
+    assert sweep[n]["promotion_vs_sparse_max_rel"] < 1e-3
+    d = sweep[n]["promotion_boundary"]
+    assert abs(d["mean"]) < MEAN_TOL, d
 
 
 def test_artifact_written(sweep):

@@ -123,6 +123,25 @@ _histo_readout_rows = observe.instrument("flusher.histo_readout_rows",
                                          _histo_readout_rows_jit)
 
 
+@partial(jax.jit, static_argnames=("method",))
+def _histo_quantiles_slots_jit(stats, imp, means, weights, qs,
+                               row_idx, slot_idx, method="interp"):
+    """Tiered variant of the quantile readout: the stat planes stay
+    row-space while the centroid planes live in the wide-slot pool,
+    so min/max gather at ``row_idx`` and centroids at ``slot_idx``
+    (same padded length, position-aligned)."""
+    comb = _combine_stats_fn(stats[row_idx], imp[row_idx])
+    qfn = (tdigest._quantile if method == "reference"
+           else tdigest._quantile_interp)
+    return qfn(means[slot_idx], weights[slot_idx], qs,
+               comb[:, segment.STAT_MIN],
+               comb[:, segment.STAT_MAX])
+
+
+_histo_quantiles_slots = observe.instrument(
+    "flusher.histo_quantiles_slots", _histo_quantiles_slots_jit)
+
+
 @jax.jit
 def _gather_rows_jit(plane, idx):
     """Compact selected rows on device before readback — d2h over the
@@ -320,6 +339,12 @@ class Flusher:
                 full = np.zeros(shape, out.dtype)
                 full[rows] = out[:len(rows)]
                 pre[out_key] = full
+            # tiered snapshots register host-side assembly steps that
+            # need the readback in hand (compact-row quantiles,
+            # mixed-tier forward planes) — run them after the expand
+            # so they see full row-space arrays
+            for fn in pre.pop("_tier_post", []):
+                fn(pre)
         return pre
 
     def _dispatch(self, snap: Snapshot) -> tuple[dict, dict, list]:
@@ -365,57 +390,69 @@ class Flusher:
             need_q = bool(all_pcts) and (
                 emit_pcts or "median" in self.aggregates or
                 any_local_scope)
-            sparse = len(histo_rows) * 2 < snap.histo_stats.shape[0]
-            if sparse:
-                # slice the touched rows on device FIRST: the stat
-                # planes and the quantile kernel (a batched sort over
-                # every digest row) then cost O(touched), and the d2h
-                # readback shrinks the same way
-                idx, _ = _pad_idx(histo_rows)
-                if need_q:
-                    qs = np.asarray(all_pcts, np.float32)
-                    st_g, comb_g, qvals_g = _histo_readout_rows(
-                        snap.histo_stats, snap.histo_import_stats,
-                        snap.histo_means, snap.histo_weights,
-                        jnp.asarray(qs), idx,
-                        method=self.quantile_interpolation)
-                    devs["qvals_g"] = qvals_g
-                    expand.append(("qvals_g", "qvals", histo_rows,
-                                   (snap.histo_stats.shape[0],
-                                    len(all_pcts))))
-                else:
-                    st_g = _gather_rows(snap.histo_stats, idx)
-                    comb_g = _combine_stats(
-                        st_g, _gather_rows(snap.histo_import_stats,
-                                           idx))
-                devs["stats_g"] = st_g
-                devs["comb_g"] = comb_g
-                shape5 = (snap.histo_stats.shape[0],
-                          segment.HISTO_STAT_COLS)
-                expand.append(("stats_g", "stats", histo_rows, shape5))
-                expand.append(("comb_g", "comb", histo_rows, shape5))
+            if snap.tiers is not None:
+                self._dispatch_histos_tiered(
+                    snap, histo_rows, all_pcts, need_q, devs, pre,
+                    expand)
             else:
-                if need_q:
-                    qs = np.asarray(all_pcts, np.float32)
-                    comb, qvals = _histo_readout(
-                        snap.histo_stats, snap.histo_import_stats,
-                        snap.histo_means, snap.histo_weights,
-                        jnp.asarray(qs),
-                        method=self.quantile_interpolation)
-                    devs["qvals"] = qvals
+                sparse = (len(histo_rows) * 2 <
+                          snap.histo_stats.shape[0])
+                if sparse:
+                    # slice the touched rows on device FIRST: the
+                    # stat planes and the quantile kernel (a batched
+                    # sort over every digest row) then cost
+                    # O(touched), and the d2h readback shrinks the
+                    # same way
+                    idx, _ = _pad_idx(histo_rows)
+                    if need_q:
+                        qs = np.asarray(all_pcts, np.float32)
+                        st_g, comb_g, qvals_g = _histo_readout_rows(
+                            snap.histo_stats, snap.histo_import_stats,
+                            snap.histo_means, snap.histo_weights,
+                            jnp.asarray(qs), idx,
+                            method=self.quantile_interpolation)
+                        devs["qvals_g"] = qvals_g
+                        expand.append(("qvals_g", "qvals", histo_rows,
+                                       (snap.histo_stats.shape[0],
+                                        len(all_pcts))))
+                    else:
+                        st_g = _gather_rows(snap.histo_stats, idx)
+                        comb_g = _combine_stats(
+                            st_g,
+                            _gather_rows(snap.histo_import_stats,
+                                         idx))
+                    devs["stats_g"] = st_g
+                    devs["comb_g"] = comb_g
+                    shape5 = (snap.histo_stats.shape[0],
+                              segment.HISTO_STAT_COLS)
+                    expand.append(("stats_g", "stats", histo_rows,
+                                   shape5))
+                    expand.append(("comb_g", "comb", histo_rows,
+                                   shape5))
                 else:
-                    comb = _combine_stats(snap.histo_stats,
-                                          snap.histo_import_stats)
-                devs["stats"] = snap.histo_stats
-                devs["comb"] = comb
-            fwd = [int(r) for r in histo_rows
-                   if self._forwardable(snap.histo_meta[r], always=True)]
-            pre["histo_fwd"] = fwd
-            if fwd:
-                idx, _ = _pad_idx(fwd)
-                devs["fwd_means"] = _gather_rows(snap.histo_means, idx)
-                devs["fwd_weights"] = _gather_rows(snap.histo_weights,
-                                                   idx)
+                    if need_q:
+                        qs = np.asarray(all_pcts, np.float32)
+                        comb, qvals = _histo_readout(
+                            snap.histo_stats, snap.histo_import_stats,
+                            snap.histo_means, snap.histo_weights,
+                            jnp.asarray(qs),
+                            method=self.quantile_interpolation)
+                        devs["qvals"] = qvals
+                    else:
+                        comb = _combine_stats(snap.histo_stats,
+                                              snap.histo_import_stats)
+                    devs["stats"] = snap.histo_stats
+                    devs["comb"] = comb
+                fwd = [int(r) for r in histo_rows
+                       if self._forwardable(snap.histo_meta[r],
+                                            always=True)]
+                pre["histo_fwd"] = fwd
+                if fwd:
+                    idx, _ = _pad_idx(fwd)
+                    devs["fwd_means"] = _gather_rows(snap.histo_means,
+                                                     idx)
+                    devs["fwd_weights"] = _gather_rows(
+                        snap.histo_weights, idx)
 
         set_rows = np.nonzero(snap.set_touched[:len(snap.set_meta)])[0]
         pre["set_rows"] = set_rows
@@ -427,7 +464,19 @@ class Flusher:
             need_est = any(int(r) not in fwd_set and
                            self._emit_local(snap.set_meta[r])
                            for r in set_rows)
-            if snap.host_only_sets:
+            if snap.tiers is not None:
+                # tiered interval: the host plane is SLOT-indexed and
+                # compact rows live in the sparse store, so both the
+                # estimates and the forward registers go through the
+                # tier snapshot (upgrade-on-pack: compact rows
+                # materialize to dense u8[M] for the frozen wire)
+                if fwd:
+                    pre["fwd_regs"] = [
+                        snap.tiers.set_row_regs(snap, r) for r in fwd]
+                if need_est:
+                    pre["ests"] = snap.tiers.set_estimates(snap,
+                                                           set_rows)
+            elif snap.host_only_sets:
                 # whole interval's set state lives on host: estimate
                 # and gather forward rows with zero device round trips
                 if fwd:
@@ -448,6 +497,149 @@ class Flusher:
                 if need_est:
                     devs["ests"] = hll.estimate(regs)
         return devs, pre, expand
+
+    # ------------------------------------------------------------------
+    # tiered dispatch: a tier snapshot keeps the stat planes row-space
+    # (aggregates read back exactly as single-tier) but the centroid
+    # planes are a wide-slot pool and compact rows hold raw host
+    # samples.  Quantiles therefore split by tier: wide rows run the
+    # device kernel at their pool slots, compact rows run the SAME
+    # kernel over host-built singleton planes once the combined stats
+    # are back (their true min/max live there) — one math path for
+    # both tiers, so a compact row in its singleton regime is
+    # bit-compatible with the wide-only oracle.
+
+    def _dispatch_histos_tiered(self, snap: Snapshot, histo_rows,
+                                all_pcts, need_q, devs: dict,
+                                pre: dict, expand: list) -> None:
+        ti = snap.tiers
+        R = snap.histo_stats.shape[0]
+        shape5 = (R, segment.HISTO_STAT_COLS)
+        sparse = len(histo_rows) * 2 < R
+        if sparse:
+            idx, _ = _pad_idx(histo_rows)
+            st_g = _gather_rows(snap.histo_stats, idx)
+            comb_g = _combine_stats(
+                st_g, _gather_rows(snap.histo_import_stats, idx))
+            devs["stats_g"] = st_g
+            devs["comb_g"] = comb_g
+            expand.append(("stats_g", "stats", histo_rows, shape5))
+            expand.append(("comb_g", "comb", histo_rows, shape5))
+        else:
+            devs["stats"] = snap.histo_stats
+            devs["comb"] = _combine_stats(snap.histo_stats,
+                                          snap.histo_import_stats)
+        wide = ti.histo_tier[histo_rows].astype(bool)
+        wrows = histo_rows[wide]
+        crows = histo_rows[~wide]
+        if need_q:
+            qs = np.asarray(all_pcts, np.float32)
+            if len(wrows):
+                ridx, _ = _pad_idx(list(wrows))
+                sl = np.zeros(int(ridx.shape[0]), np.int32)
+                sl[:len(wrows)] = ti.histo_slot[wrows]
+                qv_w = _histo_quantiles_slots(
+                    snap.histo_stats, snap.histo_import_stats,
+                    snap.histo_means, snap.histo_weights,
+                    jnp.asarray(qs), ridx, jnp.asarray(sl),
+                    method=self.quantile_interpolation)
+                devs["qvals_w"] = qv_w
+                expand.append(("qvals_w", "qvals", wrows,
+                               (R, len(all_pcts))))
+            method = self.quantile_interpolation
+
+            def _compact_quantiles(pre, crows=crows, qs=qs,
+                                   store=ti.histo_compact,
+                                   npcts=len(all_pcts), R=R,
+                                   method=method):
+                qv = pre.get("qvals")
+                if qv is None:
+                    qv = np.zeros((R, npcts), np.float32)
+                    pre["qvals"] = qv
+                if not len(crows):
+                    return
+                planes = [store.samples(int(r)) if store is not None
+                          else (np.empty(0, np.float32),) * 2
+                          for r in crows]
+                comb = pre["comb"]
+                qfn = (tdigest._quantile if method == "reference"
+                       else tdigest._quantile_interp)
+                # bucket rows by sample count: padding the whole
+                # batch to the global max would square up to rows x
+                # max_count (a still-compact Zipf head row can carry
+                # tens of thousands of samples pre-promotion, turning
+                # that into gigabytes).  Pow-2 caps and row counts
+                # keep every device shape on a small reusable lattice
+                counts = np.array([len(v) for v, _ in planes],
+                                  np.int64)
+                order = np.argsort(counts, kind="stable")
+                qv_c = np.zeros((len(crows), npcts), np.float32)
+                qsj = jnp.asarray(qs)
+                lo = 0
+                while lo < len(order):
+                    c = int(max(counts[order[lo]], 1))
+                    cap = 1 << max(6, (c - 1).bit_length())
+                    hi = lo
+                    while hi < len(order) and counts[order[hi]] <= cap:
+                        hi += 1
+                    sel = order[lo:hi]
+                    n = 1 << max(3, int(len(sel) - 1).bit_length())
+                    cm = np.zeros((n, cap), np.float32)
+                    cw = np.zeros((n, cap), np.float32)
+                    for k, i in enumerate(sel):
+                        v, w = planes[i]
+                        cm[k, :len(v)] = v
+                        cw[k, :len(v)] = w
+                    rr = crows[sel]
+                    mn = np.zeros(n, np.float32)
+                    mx = np.zeros(n, np.float32)
+                    mn[:len(sel)] = comb[rr, segment.STAT_MIN]
+                    mx[:len(sel)] = comb[rr, segment.STAT_MAX]
+                    cq = qfn(jnp.asarray(cm), jnp.asarray(cw), qsj,
+                             jnp.asarray(mn), jnp.asarray(mx))
+                    qv_c[sel] = np.asarray(cq)[:len(sel)]
+                    lo = hi
+                qv[crows] = qv_c
+
+            pre.setdefault("_tier_post", []).append(_compact_quantiles)
+        fwd = [int(r) for r in histo_rows
+               if self._forwardable(snap.histo_meta[r], always=True)]
+        pre["histo_fwd"] = fwd
+        if fwd:
+            fwide = ti.histo_tier[np.asarray(fwd, np.int64)] != 0
+            wf = [r for r, w in zip(fwd, fwide) if w]
+            if wf:
+                sidx, _ = _pad_idx(list(ti.histo_slot[
+                    np.asarray(wf, np.int64)]))
+                devs["fwd_means_w"] = _gather_rows(snap.histo_means,
+                                                   sidx)
+                devs["fwd_weights_w"] = _gather_rows(
+                    snap.histo_weights, sidx)
+
+            def _assemble_fwd(pre, fwd=fwd, fwide=fwide,
+                              store=ti.histo_compact):
+                mw = pre.pop("fwd_means_w", None)
+                ww = pre.pop("fwd_weights_w", None)
+                means, weights = [], []
+                j = 0
+                for i, r in enumerate(fwd):
+                    if fwide[i]:
+                        means.append(np.asarray(mw[j]))
+                        weights.append(np.asarray(ww[j]))
+                        j += 1
+                    else:
+                        v, w = (store.samples(r) if store is not None
+                                else (np.empty(0, np.float32),) * 2)
+                        # mean-sorted like a digest plane, so the
+                        # wire's live-centroid list reads the same
+                        # either tier
+                        o = np.argsort(v, kind="stable")
+                        means.append(np.ascontiguousarray(v[o]))
+                        weights.append(np.ascontiguousarray(w[o]))
+                pre["fwd_means"] = means
+                pre["fwd_weights"] = weights
+
+            pre.setdefault("_tier_post", []).append(_assemble_fwd)
 
     # ------------------------------------------------------------------
 

@@ -287,6 +287,11 @@ class Server:
             node="local" if self.is_local else "global",
             on_imbalance=lambda rec: self.bump("ledger_imbalance"))
         self._ledger_fanout_last = (0, 0, 0)
+        # adaptive-tier byte accounting captured at the last boundary
+        # (None until the first tiered flush, and always None when the
+        # table resolved single-tier) — /debug/vars and the signal row
+        # read the snapshot instead of re-walking live planes
+        self._last_plane_bytes = None
         # cross-interval conservation for the outage spool: one
         # snapshot sealed per flush from WireSpool.stats(); strict
         # mode escalates a leaking spool exactly like an interval
@@ -1934,6 +1939,12 @@ class Server:
                             server._sharded_fwd.spool_stats()
                             if server._sharded_fwd is not None
                             else None),
+                        # per-class/per-tier sketch-memory accounting
+                        # (core/table.plane_bytes): live byte totals,
+                        # wide-pool occupancy, and the cumulative
+                        # promotion/demotion counters — `tiers` inside
+                        # is None when the table resolved single-tier
+                        "planes": server.table.plane_bytes(),
                         # conservation at a glance; full per-interval
                         # records live at /debug/ledger
                         "ledger": server.ledger.summary(),
@@ -2297,6 +2308,15 @@ class Server:
         acct = getattr(res, "row_accounting", None)
         if acct:
             self.ledger.credit_rows(led, acct)
+        # adaptive-tier boundary movements for the sealed interval:
+        # promotions/demotions are named on the record (never balance
+        # inputs — a moved row's mass balances through the normal
+        # arms), and the post-boundary byte accounting feeds the
+        # signal row below
+        tsnap = getattr(snap, "tiers", None)
+        if tsnap is not None:
+            self.ledger.credit_tiers(led, tsnap.movements)
+            self._last_plane_bytes = tsnap.plane_bytes
         # the interval's reads are done (forward rows hold copies);
         # recycle the host set plane into the table's reuse pool
         snap.release()
@@ -3466,6 +3486,30 @@ class Server:
             w.get("busy_drops", 0) for w in fan.values())
         row["sink.timeouts"] = sum(
             w.get("timeouts", 0) for w in fan.values())
+        # adaptive sketch tiers (core/tiers.py): the boundary's byte
+        # accounting and this interval's ledger-attributed movements.
+        # Zeros when the table resolved single-tier — the schema is
+        # frozen at construction either way
+        pb = self._last_plane_bytes or {}
+        row["table.plane_bytes_total"] = pb.get("total", 0)
+        row["table.plane_bytes_histo_wide"] = pb.get(
+            "histo", {}).get("wide", 0)
+        row["table.plane_bytes_histo_compact"] = pb.get(
+            "histo", {}).get("compact", 0)
+        row["table.plane_bytes_set_wide"] = pb.get(
+            "set", {}).get("wide", 0)
+        row["table.plane_bytes_set_compact"] = pb.get(
+            "set", {}).get("compact", 0)
+        row["table.plane_bytes_per_series"] = round(
+            pb.get("device_bytes_per_series", 0.0), 3)
+        row["table.tier_promotions"] = (
+            led.tier_promotions if led is not None else 0)
+        row["table.tier_demotions"] = (
+            led.tier_demotions if led is not None else 0)
+        row["table.tier_escalations"] = (
+            led.tier_escalations if led is not None else 0)
+        row["table.tier_promote_refused"] = (
+            led.tier_promote_refused if led is not None else 0)
         return row
 
     def _sample_signals(self, led, record, flush_ns: int) -> None:

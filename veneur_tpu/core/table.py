@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from veneur_tpu import native, observe
+from veneur_tpu.core import tiers as tiersmod
 from veneur_tpu.observe.ledger import ClassDropTally
 from veneur_tpu.ops import hll, segment, tdigest
 from veneur_tpu.protocol import columnar, dogstatsd as dsd
@@ -192,7 +193,9 @@ class _IntervalState:
     __slots__ = ("gen", "pending", "fresh", "counters", "gauges",
                  "histo_stats", "histo_import_stats", "histo_means",
                  "histo_weights", "hll_regs", "hll_host_plane",
-                 "hll_host_ez", "hll_host_inv", "hll_device_touched")
+                 "hll_host_ez", "hll_host_inv", "hll_device_touched",
+                 "histo_compact", "set_sparse", "set_dense_overflow",
+                 "tier_frozen")
 
     def __init__(self, gen: int):
         self.gen = gen
@@ -202,6 +205,15 @@ class _IntervalState:
         self.hll_host_ez: np.ndarray | None = None
         self.hll_host_inv: np.ndarray | None = None
         self.hll_device_touched = False
+        # tiered-mode per-interval state: compact-tier stores (exact
+        # host-side sketches for below-threshold series) and the
+        # (tier, slot) maps frozen at begin_swap so late pipelined
+        # applies route by the assignments this interval's earlier
+        # data used (see tiers.TierSnapshot)
+        self.histo_compact: Any = None
+        self.set_sparse: Any = None
+        self.set_dense_overflow: dict[int, np.ndarray] | None = None
+        self.tier_frozen: dict | None = None
 
 
 class _StagedWork:
@@ -222,7 +234,7 @@ class _PendingSwap:
     __slots__ = ("work", "state", "counter_meta", "counter_touched",
                  "gauge_meta", "gauge_touched", "histo_meta",
                  "histo_touched", "set_meta", "set_touched",
-                 "overflow", "ingested")
+                 "overflow", "ingested", "row_maps")
 
 
 @dataclass
@@ -313,22 +325,28 @@ class _ClassIndex:
     def occupancy(self) -> int:
         return len(self.meta)
 
-    def compact(self, keep_gen: int) -> None:
+    def compact(self, keep_gen: int) -> np.ndarray:
         """Drop keys untouched since ``keep_gen``; renumber survivors.
-        Only legal at a swap boundary (device state is fresh zeros)."""
+        Only legal at a swap boundary (device state is fresh zeros).
+        Returns the old-row -> new-row mapping (-1 for dropped rows)
+        so tier directories and other row-keyed sidecars can follow
+        the renumbering."""
         new_rows: dict[tuple, int] = {}
         new_meta: list[RowMeta] = []
         new_gen = np.zeros(self.capacity, dtype=np.int64)
+        mapping = np.full(self.capacity, -1, np.int32)
         for key, row in self.rows.items():
             if self.last_gen[row] >= keep_gen:
                 new_row = len(new_meta)
                 new_rows[key] = new_row
                 new_gen[new_row] = self.last_gen[row]
                 new_meta.append(self.meta[row])
+                mapping[row] = new_row
         self.rows = new_rows
         self.meta = new_meta
         self.last_gen = new_gen
         self.touched = np.zeros(self.capacity, dtype=bool)
+        return mapping
 
     def reset_interval(self) -> None:
         self.touched = np.zeros(self.capacity, dtype=bool)
@@ -416,20 +434,35 @@ class Snapshot:
     # set by swap(): hands the host set plane back to the table's
     # reuse pool (see Snapshot.release)
     recycle: Any = None
+    # tiered-mode view (tiers.TierSnapshot): the frozen per-row
+    # (tier, slot) assignments this interval's data was routed under
+    # plus the compact-tier stores.  None in single-tier mode — every
+    # consumer that dispatches on it first falls through to today's
+    # exact code paths when absent.
+    tiers: Any = None
 
     @property
     def host_only_sets(self) -> bool:
         """True when the interval's entire set state is the host
         plane — the single definition the flusher and bench dispatch
-        on to skip the device for set reads."""
+        on to skip the device for set reads.  Tiered intervals are
+        always host-only (the sparse store and the wide pool both
+        live host-side), but their plane is SLOT-indexed, so tiered
+        consumers must go through Snapshot.tiers helpers instead."""
+        if self.tiers is not None:
+            return True
         return (self.hll_host_plane is not None and
                 not self.hll_device_touched)
 
     def host_set_estimates(self) -> np.ndarray:
         """Cardinality estimates f32[set_rows] for a host-only-sets
         interval — O(rows) from the fold-maintained statistics when
-        available, full-plane rescan otherwise."""
+        available, full-plane rescan otherwise.  Row-indexed in both
+        modes (the tiered helper translates slots internally)."""
         from veneur_tpu.ops import hll as _hll
+        if self.tiers is not None:
+            return self.tiers.set_estimates(
+                self, np.nonzero(self.set_touched)[0])
         if self.hll_host_ez is not None:
             return _hll.estimate_from_stats(self.hll_host_ez,
                                             self.hll_host_inv)
@@ -451,7 +484,11 @@ class Snapshot:
         """Effective HLL registers for the interval as a host array:
         the host-folded plane unioned with any device-resident state
         (global-tier import merges).  Reads the device plane back only
-        when it was actually touched."""
+        when it was actually touched.  Tiered intervals materialize
+        the full row-space dense plane (parity/interop view; O(rows *
+        16 KiB), meant for tests and small tables)."""
+        if self.tiers is not None:
+            return self.tiers.materialize_registers(self)
         if self.host_only_sets:
             return self.hll_host_plane
         regs = np.asarray(self.hll_regs)
@@ -471,6 +508,25 @@ class MetricTable:
         self.gauge_idx = _ClassIndex(c.gauge_rows)
         self.histo_idx = _ClassIndex(c.histo_rows)
         self.set_idx = _ClassIndex(c.set_rows)
+
+        # Adaptive sketch tiers (core/tiers.py): when the dense wide
+        # allocation for sketch classes would blow the auto budget
+        # (or VENEUR_TPU_PLANE_TIERS forces it), histogram centroid
+        # planes and HLL register rows are pooled at a FRACTION of
+        # the row table and per-series tier bits route each row to
+        # the wide pool or an exact compact-tier store.  Single-tier
+        # mode keeps self.tiers None and every tiered branch below is
+        # dead code — bit-identical to the untiered table.
+        dense_bytes = (c.set_rows * hll.M +
+                       c.histo_rows * 2 * self.capacity * 4)
+        self.tiers = (tiersmod.TierDirectory(c.histo_rows, c.set_rows)
+                      if tiersmod.tiers_enabled(dense_bytes) else None)
+        if self.tiers is not None:
+            self._histo_pool_rows = self.tiers.histo.wide_slots
+            self._set_pool_rows = self.tiers.set.wide_slots
+        else:
+            self._histo_pool_rows = c.histo_rows
+            self._set_pool_rows = c.set_rows
 
         # Counters and gauges stage as DENSE per-row host buffers —
         # every ingest path combines into them directly (counter merge
@@ -649,10 +705,13 @@ class MetricTable:
             st.histo_stats = segment.empty_histo_stats(c.histo_rows)
             st.histo_import_stats = segment.empty_histo_stats(
                 c.histo_rows)
+            # stats planes stay ROW-indexed in both modes (exact
+            # aggregates are cheap: 5 floats/row); only the centroid
+            # planes pool down to wide slots under tiering
             st.histo_means, st.histo_weights = tdigest.empty_state(
-                c.histo_rows, self.capacity)
+                self._histo_pool_rows, self.capacity)
         elif kind == "hll":
-            st.hll_regs = hll.empty_state(c.set_rows)
+            st.hll_regs = hll.empty_state(self._set_pool_rows)
 
     def _ensure_fresh(self, st: _IntervalState, kind: str) -> None:
         """Lazy per-type state reinit.  After a swap the old planes
@@ -1105,6 +1164,19 @@ class MetricTable:
         else:
             self._eff_histo_slots = _ladder_floor(
                 max(base >> level, 1))
+        # Composition with per-series tiers: the emergency ladder
+        # narrows MERGE WIDTH on the wide pool only — compact-tier
+        # series hold raw samples / sparse registers that never pass
+        # through the merge, so a level-3 narrow cannot double-shrink
+        # an already-compact series below its accuracy floor.  Levels
+        # >= 2 additionally pause BOUNDARY promotions (steady-state
+        # economics defer to the emergency; correctness escalations
+        # still run so compact stores stay bounded), and because the
+        # per-row tier bits are never touched here, release restores
+        # each series' own tier, not a global base.
+        if self.tiers is not None:
+            with self.tiers.lock:
+                self.tiers.promote_frozen = level >= 2
 
     def _note_staged(self, n: int) -> None:
         """Staged-sample bookkeeping shared by every DSD ingest path:
@@ -1567,13 +1639,26 @@ class MetricTable:
         if w.histo is not None:
             batch = w.histo.take()
             if batch is not None:
-                self._histo_device_step(st, *batch, with_stats=True)
+                if self.tiers is None:
+                    self._histo_device_step(st, *batch,
+                                            with_stats=True)
+                else:
+                    self._tiered_histo_step(st, *batch,
+                                            with_stats=True)
         if w.digest is not None:
             batch = w.digest.take()
             if batch is not None:
-                self._histo_device_step(st, *batch, with_stats=False)
+                if self.tiers is None:
+                    self._histo_device_step(st, *batch,
+                                            with_stats=False)
+                else:
+                    self._tiered_histo_step(st, *batch,
+                                            with_stats=False)
         if w.wire_parts:
-            self._wire_digest_step(st, w.wire_parts)
+            if self.tiers is None:
+                self._wire_digest_step(st, w.wire_parts)
+            else:
+                self._tiered_wire_digest_step(st, w.wire_parts)
         if w.set_parts is not None:
             set_rows, set_members, pos_rows, pos = w.set_parts
             parts_rows, parts_pos = [], []
@@ -1585,7 +1670,9 @@ class MetricTable:
             parts_pos.extend(pos)
             srows = np.concatenate(parts_rows)
             spos = np.concatenate(parts_pos)
-            if c.set_rows * hll.M <= c.host_set_plane_max_bytes:
+            if self.tiers is not None:
+                self._tiered_set_step(st, srows, spos)
+            elif c.set_rows * hll.M <= c.host_set_plane_max_bytes:
                 # device-free path: fold into the host plane; the
                 # flusher estimates/forwards from it directly
                 self._hll_host_fold(st, srows, spos)
@@ -1595,7 +1682,8 @@ class MetricTable:
                 b = _bucket_len(len(srows))
                 st.hll_regs = _hll_step_packed(
                     st.hll_regs,
-                    jnp.asarray(_pad_np(srows, b, c.set_rows)),
+                    jnp.asarray(_pad_np(srows, b,
+                                        self._set_pool_rows)),
                     jnp.asarray(_pad_np(spos, b, 0)))
         if w.stats_parts is not None:
             rows = np.concatenate([p[0] for p in w.stats_parts])
@@ -1618,6 +1706,9 @@ class MetricTable:
             # planes for U series ship as U rows, not K)
             rows = np.nonzero(touched)[0].astype(np.int32)
             regs = plane[rows]
+            if self.tiers is not None:
+                self._tiered_set_import(st, rows, regs)
+                return
             st.hll_device_touched = True
             # wide rows (16 KiB each): small bucket floor, padding a
             # 256-row plane for one import would cost 4 MiB of
@@ -1630,6 +1721,239 @@ class MetricTable:
                 st.hll_regs,
                 jnp.asarray(_pad_np(rows, b, c.set_rows)),
                 jnp.asarray(padded))
+
+    # ------------------------------------------------------------------
+    # tiered apply routing (self.tiers is not None; every entry point
+    # here is reached only in tiered mode, so single-tier behavior is
+    # bit-identical to the untiered table)
+
+    def _tiered_histo_step(self, st: _IntervalState, rows, vals, wts,
+                           with_stats: bool) -> None:
+        """Tiered histogram apply: exact row-space aggregate fold
+        first (stats planes are row-indexed in both modes), then
+        partition the batch by tier bit — wide rows translate to pool
+        slots and take the normal ranked device merge; compact rows
+        retain their raw weighted samples host-side (below the
+        promote threshold that sample list IS the digest: singleton
+        regime of arxiv 1903.09921).  Rows crossing the threshold
+        escalate mid-interval: slot alloc + drain of the retained
+        samples through the same merge kernels — the exact lossless
+        upgrade.  Escalation is skipped for frozen (post-begin_swap)
+        states: the data stays in the exact compact store instead,
+        and the boundary promotes the row for the next interval."""
+        dirs = self.tiers
+        th = dirs.thresholds
+        rows = np.ascontiguousarray(rows, np.int32)
+        vals = np.ascontiguousarray(vals, np.float32)
+        wts = np.ascontiguousarray(wts, np.float32)
+        if with_stats:
+            self._host_stats_fold(st, rows, vals, wts)
+        dev_parts = []
+        with dirs.lock:
+            frozen = st.tier_frozen
+            if frozen is not None:
+                ftier, fslot = frozen["histo"]
+                mask = ftier[rows] != 0
+                wpos = np.nonzero(mask)[0]
+                wslots = fslot[rows[wpos]]
+                cpos = np.nonzero(~mask)[0]
+            else:
+                wpos, wslots, cpos = tiersmod.split_by_tier(
+                    rows, dirs.histo, self._lib)
+            if len(wpos):
+                dev_parts.append((np.asarray(wslots, np.int32),
+                                  vals[wpos], wts[wpos]))
+            if len(cpos):
+                store = st.histo_compact
+                if store is None:
+                    store = st.histo_compact = \
+                        tiersmod.CompactHistoStore(
+                            self.config.histo_rows)
+                crows = rows[cpos]
+                store.append(crows, vals[cpos], wts[cpos])
+                if frozen is None:
+                    cand = np.unique(crows)
+                    cand = cand[store.counts[cand] >=
+                                th.histo_samples]
+                    for r in cand:
+                        s = dirs.histo.ensure_wide(int(r),
+                                                   escalation=True)
+                        if s is None:
+                            # pool exhausted: the row stays compact —
+                            # exact, just host-resident; counted as a
+                            # refused promotion, never a loss
+                            continue
+                        dv, dw = store.drain_row(int(r))
+                        dev_parts.append(
+                            (np.full(len(dv), s, np.int32), dv, dw))
+        if dev_parts:
+            self._histo_device_step(
+                st, np.concatenate([p[0] for p in dev_parts]),
+                np.concatenate([p[1] for p in dev_parts]),
+                np.concatenate([p[2] for p in dev_parts]),
+                with_stats=False)
+
+    def _tiered_set_step(self, st: _IntervalState, srows,
+                         spos) -> None:
+        """Tiered set apply: wide rows fold into the slot-indexed
+        host register plane; compact rows append to the sparse
+        (index,value) register list — exact, since the dense row is a
+        pure function of the deduped list.  Register occupancy
+        crossing the promote threshold escalates: the sparse list
+        scatters into a freshly allocated pool slot (lossless by
+        construction)."""
+        dirs = self.tiers
+        th = dirs.thresholds
+        srows = np.ascontiguousarray(srows, np.int32)
+        spos = np.ascontiguousarray(spos, np.int32)
+        fold_rows, fold_pos = [], []
+        with dirs.lock:
+            frozen = st.tier_frozen
+            if frozen is not None:
+                ftier, fslot = frozen["set"]
+                mask = ftier[srows] != 0
+                wpos = np.nonzero(mask)[0]
+                wslots = fslot[srows[wpos]]
+                cpos = np.nonzero(~mask)[0]
+            else:
+                wpos, wslots, cpos = tiersmod.split_by_tier(
+                    srows, dirs.set, self._lib)
+            if len(wpos):
+                fold_rows.append(np.asarray(wslots, np.int32))
+                fold_pos.append(spos[wpos])
+            if len(cpos):
+                store = st.set_sparse
+                if store is None:
+                    store = st.set_sparse = tiersmod.SparseSetStore(
+                        self.config.set_rows)
+                crows = srows[cpos]
+                store.append(crows, spos[cpos])
+                if frozen is None:
+                    cand = np.unique(crows)
+                    cand = cand[store.counts[cand] >= th.set_entries]
+                    if len(cand):
+                        # raw append counts over-estimate occupancy;
+                        # consolidate (dedup) before deciding
+                        store.consolidate()
+                        for r in cand:
+                            if store.counts[r] < th.set_entries:
+                                continue
+                            s = dirs.set.ensure_wide(int(r),
+                                                     escalation=True)
+                            if s is None:
+                                continue
+                            p = store.drain_row(int(r))
+                            fold_rows.append(
+                                np.full(len(p), s, np.int32))
+                            fold_pos.append(p)
+        if fold_rows:
+            self._hll_host_fold(st, np.concatenate(fold_rows),
+                                np.concatenate(fold_pos))
+
+    def _tiered_set_import(self, st: _IntervalState, rows,
+                           regs) -> None:
+        """Forwarded dense register planes in tiered mode: the target
+        row force-promotes (a peer already holds dense state — the
+        series is wide by definition) and the plane unions host-side
+        into its slot, with the fold statistics recomputed exactly.
+        Pool-refused rows keep their dense regs in a per-interval
+        overflow sidecar: exact, never lost, just unpromoted."""
+        self._ensure_host_plane(st)
+        plane = st.hll_host_plane
+        dirs = self.tiers
+        for i, r in enumerate(np.asarray(rows, np.int64)):
+            r = int(r)
+            with dirs.lock:
+                frozen = st.tier_frozen
+                if frozen is not None:
+                    ftier, fslot = frozen["set"]
+                    s = int(fslot[r]) if ftier[r] else -1
+                else:
+                    s0 = dirs.set.ensure_wide(r, escalation=True)
+                    s = -1 if s0 is None else int(s0)
+                    if s >= 0 and st.set_sparse is not None and \
+                            st.set_sparse.counts[r] > 0:
+                        p = st.set_sparse.drain_row(r)
+                        if len(p):
+                            plane[s, p >> 6] = np.maximum(
+                                plane[s, p >> 6],
+                                (p & 0x3F).astype(np.uint8))
+            if s < 0:
+                ov = st.set_dense_overflow
+                if ov is None:
+                    ov = st.set_dense_overflow = {}
+                prev = ov.get(r)
+                ov[r] = (regs[i].copy() if prev is None
+                         else np.maximum(prev, regs[i]))
+                continue
+            prow = plane[s]
+            np.maximum(prow, regs[i], out=prow)
+            if st.hll_host_ez is not None:
+                ez = int((prow == 0).sum())
+                st.hll_host_ez[s] = ez
+                nz = prow[prow != 0].astype(np.int64)
+                st.hll_host_inv[s] = float(ez) + float(
+                    np.ldexp(1.0, -nz).sum())
+
+    def _tiered_wire_digest_step(self, st: _IntervalState,
+                                 parts: list[tuple]) -> None:
+        """Forwarded centroid parts translate row -> slot before the
+        fused wire merge: forwarded digests are wide-tier traffic by
+        definition, so their rows force-promote (draining any compact
+        samples through the merge on the way).  Pool-refused rows'
+        centroids retain as weighted samples in the compact store —
+        a centroid IS a weighted sample, so the mass is conserved."""
+        dirs = self.tiers
+        out_parts = []
+        extra = []
+        with dirs.lock:
+            frozen = st.tier_frozen
+            store = st.histo_compact
+            smap = np.full(self.config.histo_rows, -1, np.int32)
+            smapped = np.zeros(self.config.histo_rows, bool)
+            for rows, means, wts in parts:
+                if not len(rows):
+                    continue
+                rows = np.ascontiguousarray(rows, np.int32)
+                for r in np.unique(rows):
+                    r = int(r)
+                    if smapped[r]:
+                        continue
+                    smapped[r] = True
+                    if frozen is not None:
+                        ftier, fslot = frozen["histo"]
+                        smap[r] = fslot[r] if ftier[r] else -1
+                        continue
+                    s = dirs.histo.ensure_wide(r, escalation=True)
+                    if s is None:
+                        continue
+                    smap[r] = s
+                    if store is not None and store.counts[r] > 0:
+                        dv, dw = store.drain_row(r)
+                        if len(dv):
+                            extra.append(
+                                (np.full(len(dv), s, np.int32),
+                                 dv, dw))
+                slots = smap[rows]
+                ok = slots >= 0
+                if not ok.all():
+                    if store is None:
+                        store = st.histo_compact = \
+                            tiersmod.CompactHistoStore(
+                                self.config.histo_rows)
+                    bad = ~ok
+                    store.append(rows[bad],
+                                 np.asarray(means, np.float32)[bad],
+                                 np.asarray(wts, np.float32)[bad])
+                if ok.any():
+                    out_parts.append((slots[ok],
+                                      np.asarray(means)[ok],
+                                      np.asarray(wts)[ok]))
+        if out_parts:
+            self._wire_digest_step(st, out_parts)
+        for erows, ev, ew in extra:
+            self._histo_device_step(st, erows, ev, ew,
+                                    with_stats=False)
 
     def _histo_device_step(self, st: _IntervalState, rows: np.ndarray,
                            vals: np.ndarray, wts: np.ndarray,
@@ -1860,24 +2184,33 @@ class MetricTable:
                 else ov_wts[:spill].copy())
         return True, None
 
+    def _ensure_host_plane(self, st: _IntervalState) -> None:
+        """Lazy host register plane + fold statistics for the
+        interval.  POOL-sized: in single-tier mode the pool is the
+        whole row table; under tiering it is the wide-slot pool and
+        rows are slot ids."""
+        if st.hll_host_plane is not None:
+            return
+        pool = self._set_pool_rows
+        if self._plane_pool:
+            st.hll_host_plane = self._plane_pool.pop()
+        else:
+            st.hll_host_plane = np.zeros((pool, hll.M), np.uint8)
+        if self._lib is not None:
+            # all-zero row: every register counts in ez and
+            # contributes 2^0 to the inverse-power sum
+            st.hll_host_ez = np.full(pool, hll.M, np.int32)
+            st.hll_host_inv = np.full(pool, float(hll.M),
+                                      np.float64)
+
     def _hll_host_fold(self, st: _IntervalState, rows: np.ndarray,
                        pos: np.ndarray) -> None:
         """Fold packed member positions into the persistent host
         register plane for this interval — no device dispatch at all
-        (see TableConfig.host_set_plane_max_bytes)."""
-        c = self.config
-        if st.hll_host_plane is None:
-            if self._plane_pool:
-                st.hll_host_plane = self._plane_pool.pop()
-            else:
-                st.hll_host_plane = np.zeros((c.set_rows, hll.M),
-                                             np.uint8)
-            if self._lib is not None:
-                # all-zero row: every register counts in ez and
-                # contributes 2^0 to the inverse-power sum
-                st.hll_host_ez = np.full(c.set_rows, hll.M, np.int32)
-                st.hll_host_inv = np.full(c.set_rows, float(hll.M),
-                                          np.float64)
+        (see TableConfig.host_set_plane_max_bytes).  ``rows`` are
+        pool-space ids (row == slot in single-tier mode)."""
+        self._ensure_host_plane(st)
+        pool = self._set_pool_rows
         rows = np.ascontiguousarray(rows, np.int32)
         pos = np.ascontiguousarray(pos, np.int32)
         if self._lib is not None:
@@ -1885,7 +2218,7 @@ class MetricTable:
             i32p = ct.POINTER(ct.c_int32)
             self._lib.vtpu_hll_plane_stats(
                 rows.ctypes.data_as(i32p), pos.ctypes.data_as(i32p),
-                len(rows), c.set_rows, hll.M,
+                len(rows), pool, hll.M,
                 st.hll_host_plane.ctypes.data_as(
                     ct.POINTER(ct.c_uint8)),
                 st.hll_host_inv.ctypes.data_as(
@@ -1894,7 +2227,7 @@ class MetricTable:
             return
         idx = pos >> 6
         rank = (pos & 0x3F).astype(np.uint8)
-        live = (rows >= 0) & (rows < c.set_rows)
+        live = (rows >= 0) & (rows < pool)
         np.maximum.at(st.hll_host_plane,
                       (rows[live], idx[live]), rank[live])
 
@@ -1903,9 +2236,8 @@ class MetricTable:
         cleared.  Runs on the releasing (flusher) thread, keeping the
         memset off the ingest path.  Bounded: FLUSH_LAG snapshots can
         be in flight, more than that is a leak, not a pool."""
-        c = self.config
         if (len(self._plane_pool) < 4 and
-                plane.shape == (c.set_rows, hll.M)):
+                plane.shape == (self._set_pool_rows, hll.M)):
             plane.fill(0)
             self._plane_pool.append(plane)
 
@@ -2267,6 +2599,21 @@ class MetricTable:
         ingest lock so ingest into the new interval proceeds while
         the old interval's final merge and readback are in flight."""
         st = self._state
+        # Freeze the outgoing interval's tier routing BEFORE anything
+        # else: late pipelined applies pinned to this state partition
+        # by these copies (escalations re-check tier_frozen under the
+        # same directory lock, so an escalation either lands before
+        # the freeze — and the copy sees the flip — or is skipped).
+        # Copies are in CURRENT (pre-compaction) row space, matching
+        # the pend metadata captured below.
+        if self.tiers is not None:
+            with self.tiers.lock:
+                st.tier_frozen = {
+                    "histo": (self.tiers.histo.tier.copy(),
+                              self.tiers.histo.slot.copy()),
+                    "set": (self.tiers.set.tier.copy(),
+                            self.tiers.set.slot.copy()),
+                }
         work = self._detach_staged(final=True)
         # the native ingest marks touched[] but defers last_gen (gen is
         # constant within an interval, so one vectorized stamp here is
@@ -2316,6 +2663,7 @@ class MetricTable:
         self._state = ns
         self.gen += 1
         compacted = False
+        pend.row_maps = {}
         for idx in (self.counter_idx, self.gauge_idx, self.histo_idx,
                     self.set_idx):
             idx.drops.take()
@@ -2333,12 +2681,28 @@ class MetricTable:
                 # low-yield compaction costs nothing until capacity
                 if (freed >= max(1, idx.capacity // 8) or
                         (occ >= idx.capacity and freed > 0)):
-                    idx.compact(keep_gen=self.gen - 1)
+                    mapping = idx.compact(keep_gen=self.gen - 1)
+                    if idx is self.histo_idx:
+                        pend.row_maps["histo"] = mapping
+                    elif idx is self.set_idx:
+                        pend.row_maps["set"] = mapping
                     compacted = True
                 else:
                     idx.reset_interval()
             else:
                 idx.reset_interval()
+        if compacted and self.tiers is not None:
+            # the tier directory is row-keyed: follow the renumbering
+            # (dropped wide rows hand their slots back — a named
+            # demotion).  The outgoing state's FROZEN copies stay in
+            # old row space on purpose: they pair with the pend
+            # metadata, and the boundary pass translates through
+            # pend.row_maps.
+            with self.tiers.lock:
+                if "histo" in pend.row_maps:
+                    self.tiers.histo.renumber(pend.row_maps["histo"])
+                if "set" in pend.row_maps:
+                    self.tiers.set.renumber(pend.row_maps["set"])
         if compacted:
             # compaction renumbered rows: rebuild the fast-path index
             # from surviving metas (rows the fast path never saw have
@@ -2378,6 +2742,13 @@ class MetricTable:
             with self._device_lock:
                 self._apply_work(pend.work)
         st = pend.state
+        snap_tiers = None
+        if self.tiers is not None:
+            # every apply pinned to this state has landed (pending
+            # drained above), so the boundary sees the interval's
+            # final stores and no apply can race the tier flips
+            with self._device_lock:
+                snap_tiers = self._tier_boundary(pend, st)
         return Snapshot(
             gen=st.gen,
             counters=st.counters,
@@ -2402,7 +2773,144 @@ class MetricTable:
             recycle=self._recycle_plane,
             overflow=pend.overflow,
             ingested=pend.ingested,
+            tiers=snap_tiers,
         )
+
+    def _tier_boundary(self, pend: _PendingSwap,
+                       st: _IntervalState):
+        """End-of-interval promotion/demotion boundary + capture of
+        the interval's tier view.  Runs under _device_lock after the
+        final apply, so directory flips here affect the NEXT interval
+        only.  Rows that already have next-interval data in flight
+        (live touched) skip their flip until the following boundary —
+        that is what makes every flip lossless: a flipped row never
+        has one interval's data on both sides of the tier.  Boundary
+        promotions are tier flips only (interval planes reset at every
+        swap, so there is nothing to migrate); mid-interval
+        escalations did the in-place lossless upgrades."""
+        dirs = self.tiers
+        th = dirs.thresholds
+        if st.histo_compact is not None:
+            st.histo_compact.consolidate()
+        if st.set_sparse is not None:
+            st.set_sparse.consolidate()
+        with dirs.lock:
+            for name, cls, idx, store, thresh in (
+                    ("histo", dirs.histo, self.histo_idx,
+                     st.histo_compact, th.histo_samples),
+                    ("set", dirs.set, self.set_idx,
+                     st.set_sparse, th.set_entries)):
+                mapping = pend.row_maps.get(name)
+                touched = (pend.histo_touched if name == "histo"
+                           else pend.set_touched)
+                if mapping is not None:
+                    tn = np.zeros(cls.rows, bool)
+                    live = np.nonzero(mapping >= 0)[0]
+                    tn[mapping[live]] = touched[live]
+                    touched = tn
+                wide = cls.tier != 0
+                cls.idle[wide & touched] = 0
+                cls.idle[wide & ~touched] += 1
+                for r in np.nonzero(
+                        wide & (cls.idle >= th.demote_idle) &
+                        ~idx.touched)[0]:
+                    cls.demote(int(r))
+                if store is not None and not dirs.promote_frozen:
+                    for ro in np.nonzero(
+                            store.counts >= thresh)[0]:
+                        rn = (int(ro) if mapping is None
+                              else int(mapping[ro]))
+                        if rn < 0 or cls.tier[rn] or idx.touched[rn]:
+                            continue
+                        cls.ensure_wide(rn)
+            frozen = st.tier_frozen or {}
+            fh = frozen.get("histo") or (dirs.histo.tier.copy(),
+                                         dirs.histo.slot.copy())
+            fs = frozen.get("set") or (dirs.set.tier.copy(),
+                                       dirs.set.slot.copy())
+            movements = {"histo": dirs.histo.take_delta(),
+                         "set": dirs.set.take_delta()}
+            occupancy = {"histo": dirs.histo.occupancy(),
+                         "set": dirs.set.occupancy()}
+        pb = self.plane_bytes()
+        return tiersmod.TierSnapshot(
+            histo_tier=fh[0], histo_slot=fh[1],
+            set_tier=fs[0], set_slot=fs[1],
+            histo_compact=st.histo_compact,
+            set_sparse=st.set_sparse,
+            set_dense_overflow=st.set_dense_overflow or {},
+            movements=movements,
+            occupancy=occupancy,
+            plane_bytes=pb,
+            device_bytes_per_series=pb["device_bytes_per_series"],
+            pool_rows={"histo": self._histo_pool_rows,
+                       "set": self._set_pool_rows})
+
+    def plane_bytes(self) -> dict:
+        """Per-class, per-tier sketch-memory accounting: the `planes`
+        block in /debug/vars, the veneur.device.plane_bytes{class,
+        tier} gauges, and the table.plane_bytes_* signal-history
+        columns all read THIS one dict.  Values are computed from the
+        actual live allocations (current interval state), so a
+        promotion/demotion is visible the flush after it happens.
+        Reads race ingest benignly — these are gauges, not
+        invariants."""
+        st = self._state
+
+        def _b(x) -> int:
+            return int(sum(getattr(leaf, "nbytes", 0)
+                           for leaf in jax.tree_util.tree_leaves(x)))
+
+        counter_b = _b(st.counters) + self._counter_dense.nbytes
+        gauge_b = (_b(st.gauges) + self._gauge_dense.nbytes +
+                   self._gauge_mask.nbytes)
+        histo_wide = _b(st.histo_means) + _b(st.histo_weights)
+        histo_stats = _b(st.histo_stats) + _b(st.histo_import_stats)
+        histo_compact = (st.histo_compact.nbytes()
+                         if st.histo_compact is not None else 0)
+        set_wide = _b(st.hll_regs)
+        for arr in (st.hll_host_plane, st.hll_host_ez,
+                    st.hll_host_inv):
+            if arr is not None:
+                set_wide += arr.nbytes
+        set_compact = (st.set_sparse.nbytes()
+                       if st.set_sparse is not None else 0)
+        ov = st.set_dense_overflow
+        if ov:
+            set_compact += sum(r.nbytes for r in ov.values())
+        directory = 0
+        tier_info = None
+        if self.tiers is not None:
+            with self.tiers.lock:
+                for cls in (self.tiers.histo, self.tiers.set):
+                    directory += (cls.tier.nbytes + cls.slot.nbytes +
+                                  cls.idle.nbytes +
+                                  cls.slot_row.nbytes)
+                tier_info = {
+                    "occupancy": {
+                        "histo": self.tiers.histo.occupancy(),
+                        "set": self.tiers.set.occupancy()},
+                    "movements": self.tiers.counters(),
+                    "promote_frozen": self.tiers.promote_frozen,
+                }
+        total = (counter_b + gauge_b + histo_wide + histo_stats +
+                 histo_compact + set_wide + set_compact + directory)
+        occ = (self.counter_idx.occupancy() +
+               self.gauge_idx.occupancy() +
+               self.histo_idx.occupancy() +
+               self.set_idx.occupancy())
+        return {
+            "counter": {"wide": counter_b, "compact": 0},
+            "gauge": {"wide": gauge_b, "compact": 0},
+            "histo": {"wide": histo_wide, "stats": histo_stats,
+                      "compact": histo_compact},
+            "set": {"wide": set_wide, "compact": set_compact},
+            "directory": directory,
+            "total": total,
+            "occupancy": occ,
+            "device_bytes_per_series": total / max(1, occ),
+            "tiers": tier_info,
+        }
 
     def take_status(self):
         out = self.status

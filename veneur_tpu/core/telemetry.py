@@ -380,6 +380,41 @@ class Telemetry:
             dev["readback_bytes_total"]
         count("veneur.device.readback_bytes_total",
               self._delta("device_readback_bytes"))
+        # adaptive sketch tiers (core/tiers.py): per-class/per-tier
+        # sketch memory as gauges and the boundary's cumulative
+        # movement counters as deltas.  Absent entirely when the
+        # table resolved single-tier (_last_plane_bytes stays None)
+        pb = getattr(self.server, "_last_plane_bytes", None)
+        if pb is not None:
+            for cls in ("counter", "gauge", "histo", "set"):
+                for tier_name, nbytes in sorted(
+                        pb.get(cls, {}).items()):
+                    gauge("veneur.device.plane_bytes", int(nbytes),
+                          (f"class:{cls}", f"tier:{tier_name}"))
+            gauge("veneur.device.plane_bytes_per_series",
+                  float(pb.get("device_bytes_per_series", 0.0)))
+            ti = pb.get("tiers") or {}
+            for cls, mv in sorted((ti.get("movements") or {}).items()):
+                for mname, metric in (
+                        ("promotions",
+                         "veneur.tier.promotions_total"),
+                        ("demotions",
+                         "veneur.tier.demotions_total"),
+                        ("escalations",
+                         "veneur.tier.escalations_total"),
+                        ("promote_refused",
+                         "veneur.tier.promote_refused_total")):
+                    key = f"tier_{cls}_{mname}"
+                    self.server.stats[key] = int(mv.get(mname, 0))
+                    count(metric, self._delta(key),
+                          (f"class:{cls}",))
+            for cls, occ in sorted(
+                    (ti.get("occupancy") or {}).items()):
+                gauge("veneur.tier.wide_rows", int(occ.get("wide", 0)),
+                      (f"class:{cls}",))
+                gauge("veneur.tier.free_slots",
+                      int(occ.get("free_slots", 0)),
+                      (f"class:{cls}",))
         # persistent compilation cache traffic: hits are compiles the
         # disk cache absorbed (startup/restart cost, not steady-state)
         self.server.stats["xla_cache_hits"] = dev["compile_cache_hits"]

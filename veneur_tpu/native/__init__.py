@@ -163,6 +163,9 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.vtpu_hll_plane_stats.argtypes = [
         i32p, i32p, i64, ctypes.c_int32, ctypes.c_int32, u8p, f64p,
         i32p]
+    lib.vtpu_tier_split.restype = i64
+    lib.vtpu_tier_split.argtypes = [i32p, i64, u8p, i32p, i32p,
+                                    i32p]
     lib.vtpu_ingest.restype = None
     lib.vtpu_ingest.argtypes = [
         vp, u64p, u8p, f64p, u64p, f32p, i64, i64p, i64, i64,

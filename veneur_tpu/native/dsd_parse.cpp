@@ -1523,6 +1523,34 @@ void vtpu_hll_plane_stats(const int32_t* rows, const int32_t* packed,
 }
 
 // ---------------------------------------------------------------------
+// adaptive sketch tiers (core/tiers.py): single-pass stable partition
+// of a batch's row ids by per-row tier bit, so the combine kernels
+// scatter into the right pool without a second host pass.  Output:
+// the first n_wide entries are wide-tier samples with out_rows =
+// slot[row] (pool-slot space), the remainder compact-tier samples
+// with out_rows = row (table-row space); out_idx carries the original
+// batch position for gathering the sample columns.  Returns n_wide.
+int64_t vtpu_tier_split(const int32_t* rows, int64_t n,
+                        const uint8_t* tier, const int32_t* slot,
+                        int32_t* out_idx, int32_t* out_rows) {
+  int64_t w = 0;
+  for (int64_t i = 0; i < n; i++)
+    if (tier[rows[i]]) {
+      out_idx[w] = (int32_t)i;
+      out_rows[w] = slot[rows[i]];
+      w++;
+    }
+  int64_t c = w;
+  for (int64_t i = 0; i < n; i++)
+    if (!tier[rows[i]]) {
+      out_idx[c] = (int32_t)i;
+      out_rows[c] = rows[i];
+      c++;
+    }
+  return w;
+}
+
+// ---------------------------------------------------------------------
 // forwardrpc.MetricList wire walker (the global tier's decode hot
 // path: importsrv/server.go:102 SendMetrics).  Parses the serialized
 // proto DIRECTLY — field numbers per forward/protos/{forward,metric,

@@ -171,6 +171,19 @@ class LedgerRecord:
     #    credit_reshard): items accepted under the handoff flag from
     #    an incumbent global shipping arcs this node now owns
     reshard_received_items: int = 0
+    # -- adaptive sketch tiers (core/tiers.py): series that moved
+    #    between the compact and wide plane pools this interval.  A
+    #    promotion/demotion is a NAMED movement of a row's precision,
+    #    never of its mass — these are informational attribution, not
+    #    balance inputs (the row's samples stay staged/emitted/
+    #    forwarded exactly as before).  ``tier_promote_refused``
+    #    counts escalations the full wide pool turned down; the row's
+    #    data stays exact in the compact store, so a refusal is
+    #    pressure, not loss.
+    tier_promotions: int = 0
+    tier_demotions: int = 0
+    tier_escalations: int = 0
+    tier_promote_refused: int = 0
     # -- verdict (filled by seal) --------------------------------------
     sealed: bool = False
     balanced: bool = True
@@ -246,6 +259,10 @@ class LedgerRecord:
             "fanout": {"busy_drops": self.fanout_busy_drops,
                        "retries": self.fanout_retries,
                        "timeouts": self.fanout_timeouts},
+            "tiers": {"promotions": self.tier_promotions,
+                      "demotions": self.tier_demotions,
+                      "escalations": self.tier_escalations,
+                      "promote_refused": self.tier_promote_refused},
             "balanced": self.balanced,
             "owed": self.owed,
             "staged_drift": self.staged_drift,
@@ -468,6 +485,21 @@ class Ledger:
             rec.fanout_busy_drops += int(busy_drops)
             rec.fanout_retries += int(retries)
             rec.fanout_timeouts += int(timeouts)
+
+    def credit_tiers(self, rec: LedgerRecord, movements: dict) -> None:
+        """Attribute the interval's tier-boundary movements (see
+        core/tiers.py take_delta): ``movements`` is the per-class
+        {promotions, demotions, escalations, promote_refused} delta
+        dict from the tier snapshot.  Named movements, never balance
+        inputs — a promoted row's mass already balances through the
+        normal staged/emitted arms."""
+        with self._lock:
+            for cls in movements.values():
+                rec.tier_promotions += int(cls.get("promotions", 0))
+                rec.tier_demotions += int(cls.get("demotions", 0))
+                rec.tier_escalations += int(cls.get("escalations", 0))
+                rec.tier_promote_refused += int(
+                    cls.get("promote_refused", 0))
 
     # -- seal ----------------------------------------------------------
     def seal(self, rec: LedgerRecord) -> LedgerRecord:
