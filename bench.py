@@ -78,9 +78,10 @@ _GATES = {
     "merge": os.environ.get("VENEUR_TPU_MERGE", "auto"),
     "tail_refine": os.environ.get("VENEUR_TPU_TAIL_REFINE", "1"),
     "f16_plane": os.environ.get("VENEUR_TPU_F16_PLANE", "1"),
+    "superbatch": os.environ.get("VENEUR_TPU_SUPERBATCH", "auto"),
 }
 _GATES_DEFAULT = {"merge": "auto", "tail_refine": "1",
-                  "f16_plane": "1"}
+                  "f16_plane": "1", "superbatch": "auto"}
 _GATE_TAG = "".join(f".{k}-{v}" for k, v in sorted(_GATES.items())
                     if v != _GATES_DEFAULT[k])
 
@@ -493,6 +494,166 @@ def bench_sets() -> dict:
     res["hll_err_mean"] = float(err.mean())
     res["hll_err_max"] = float(err.max())
     return res
+
+
+def superbatch_bench() -> dict:
+    """``--superbatch``: ISSUE 20 tentpole A/B — the fused
+    one-buffer/one-dispatch apply path against the per-class oracle,
+    in one process (the gate is read at table construction, so the
+    two arms share every compiled kernel and the comparison isolates
+    the apply path).
+
+    Leg A is the sets config with the device route forced
+    (host_set_plane_max_bytes=0): the per-class arm pays the packed
+    XLA scatter per interval, the superbatch arm the fused
+    plane-union — same registers bit-for-bit, so the artifact also
+    records estimate equality.  Leg B is a mixed four-class interval
+    sized so every class rides the fused buffer; its per-cycle apply
+    dispatch counts pin the 4-to-1 collapse."""
+    from veneur_tpu import observe
+    from veneur_tpu.ops import hll
+    from veneur_tpu.protocol import columnar
+    import jax
+
+    out: dict = {"mode": "superbatch", "quick": QUICK}
+    out.update(_backend_info())
+    out["platform"] = jax.default_backend()
+    intervals = 3 if QUICK else 5
+
+    def _kernel_calls():
+        snap = observe.REGISTRY.snapshot()
+        return {k: v["calls"] for k, v in snap["kernels"].items()}
+
+    def _apply_delta(k0, k1):
+        return sum(k1.get(k, 0) - k0.get(k, 0) for k in k1
+                   if k.startswith("table."))
+
+    # ---- leg A: sets, device route forced -------------------------
+    n = 1_000_000 // SCALE
+    lines = [f"uniq.{i % 1000}:m{i}|s".encode() for i in range(n)]
+    chunk = 1 << 20
+    bufs = [b"\n".join(lines[i:i + chunk])
+            for i in range(0, n, chunk)]
+
+    def run_sets(arm: str) -> tuple[dict, np.ndarray]:
+        os.environ["VENEUR_TPU_SUPERBATCH"] = arm
+        try:
+            parser = columnar.ColumnarParser()
+            table = _mk_table(set_rows=1024,
+                              host_set_plane_max_bytes=0)
+
+            def one():
+                t0 = time.perf_counter()
+                got = _ingest_interval(table, bufs, parser)
+                snap = table.swap()
+                est = hll.estimate(snap.hll_regs)
+                _async_np(est)
+                est = np.asarray(est)
+                _block(table)
+                return got, time.perf_counter() - t0, est
+
+            one()
+            one()  # absorb second-pass compiles (see _run_config)
+            d0 = observe.REGISTRY.totals()
+            k0 = _kernel_calls()
+            per, total, est = [], 0, None
+            for _ in range(intervals):
+                got, dt, est = one()
+                total += got
+                per.append(dt)
+            d1 = observe.REGISTRY.totals()
+            k1 = _kernel_calls()
+            return {
+                "superbatch": arm,
+                "samples": total,
+                "intervals": len(per),
+                "interval_seconds": [round(x, 4) for x in per],
+                "samples_per_sec": round(
+                    total / len(per) / sorted(per)[len(per) // 2],
+                    1),
+                "warm_mean_samples_per_sec": round(
+                    total / sum(per), 1),
+                "apply_dispatches_per_interval":
+                    _apply_delta(k0, k1) / len(per),
+                "device_dispatches_per_interval":
+                    (d1["dispatch_total"] - d0["dispatch_total"])
+                    / len(per),
+                "h2d_bytes_per_interval":
+                    (d1["h2d_bytes_total"] - d0["h2d_bytes_total"])
+                    // len(per),
+            }, est
+        finally:
+            os.environ.pop("VENEUR_TPU_SUPERBATCH", None)
+
+    sets_off, est_off = run_sets("off")
+    sets_on, est_on = run_sets("on")
+    out["sets_off"] = sets_off
+    out["sets_on"] = sets_on
+    out["sets_speedup_warm"] = round(
+        sets_on["warm_mean_samples_per_sec"]
+        / max(sets_off["warm_mean_samples_per_sec"], 1e-9), 3)
+    # registers are bit-identical across arms, so the estimates must
+    # be too — recorded as evidence, gated in tests
+    out["sets_estimates_equal"] = bool(
+        np.array_equal(est_off, est_on))
+
+    # ---- leg B: mixed four-class interval -------------------------
+    nm = 200_000 // SCALE
+    rng = np.random.default_rng(20)
+    hvals = rng.gamma(2.0, 30.0, nm // 40).astype(np.float32)
+    mlines = []
+    for i in range(nm):
+        j = i % 1000
+        mlines.append(f"c.{j}:{(i % 7) + 1}|c".encode())
+        if i < nm // 4:
+            mlines.append(f"g.{j}:{i % 97}|g".encode())
+        if i < nm // 40:
+            # histo SPARSE vs the row pool (~1 sample per row over
+            # 4000 rows): the host-densified plane declines
+            # (_plane_choice) and the batch takes the ranked shallow
+            # path — the fused buffer's shape.  Denser batches route
+            # to the plane per-class step by design; this leg pins
+            # the collapse on the shape the superbatch owns.
+            mlines.append(
+                f"h.{i % 4000}:{hvals[i]:.4f}|h".encode())
+        mlines.append(f"s.{j}:m{i}|s".encode())
+    mixed_buf = b"\n".join(mlines)
+
+    def run_mixed(arm: str) -> dict:
+        os.environ["VENEUR_TPU_SUPERBATCH"] = arm
+        try:
+            parser = columnar.ColumnarParser()
+            table = _mk_table(histo_rows=4096, set_rows=1024,
+                              host_set_plane_max_bytes=0,
+                              histo_merge_samples=1 << 30)
+
+            def one():
+                t0 = time.perf_counter()
+                pb = parser.parse(mixed_buf, copy=False)
+                table.ingest_columns(pb)
+                table.device_step(final=True)
+                table.swap()
+                _block(table)
+                return time.perf_counter() - t0
+
+            one()
+            one()
+            k0 = _kernel_calls()
+            per = [one() for _ in range(intervals)]
+            k1 = _kernel_calls()
+            return {
+                "superbatch": arm,
+                "interval_seconds": [round(x, 4) for x in per],
+                "apply_dispatches_per_cycle":
+                    _apply_delta(k0, k1) / len(per),
+            }
+        finally:
+            os.environ.pop("VENEUR_TPU_SUPERBATCH", None)
+
+    out["mixed_off"] = run_mixed("off")
+    out["mixed_on"] = run_mixed("on")
+    _save_artifact("superbatch_apply", out)
+    return out
 
 
 def bench_global_merge() -> dict:
@@ -4654,6 +4815,18 @@ def _summary_line(out: dict) -> str:
         line["collective_speedup_vs_wire"] = out.get(
             "collective_speedup_vs_wire")
         line["mesh_procs"] = out.get("mesh_procs")
+    # superbatch verdict: present only for --superbatch artifacts
+    # (ISSUE 20)
+    if out.get("mode") == "superbatch":
+        line["sets_speedup_warm"] = out.get("sets_speedup_warm")
+        line["sets_estimates_equal"] = out.get(
+            "sets_estimates_equal")
+        line["sets_on_samples_per_sec"] = out.get(
+            "sets_on", {}).get("warm_mean_samples_per_sec")
+        line["mixed_dispatches_off"] = out.get(
+            "mixed_off", {}).get("apply_dispatches_per_cycle")
+        line["mixed_dispatches_on"] = out.get(
+            "mixed_on", {}).get("apply_dispatches_per_cycle")
     return json.dumps(line, separators=(",", ":"))
 
 
@@ -4772,6 +4945,13 @@ if __name__ == "__main__":
         print(_summary_line(out))
     elif "--collective-forward" in sys.argv:
         out = collective_forward_bench()
+        print(json.dumps(out))
+        print(_summary_line(out))
+    elif "--superbatch" in sys.argv:
+        if not _PLATFORM_PIN:
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        out = superbatch_bench()
         print(json.dumps(out))
         print(_summary_line(out))
     elif "--chaos" in sys.argv:

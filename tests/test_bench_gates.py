@@ -990,6 +990,87 @@ def test_cardinality_soak_quick_rerun():
     assert d["unattributed_lost"] == 0
 
 
+# ----------------------------------------------------------------------
+# superbatch fused apply (ISSUE 20)
+
+
+def test_superbatch_artifact_committed():
+    """bench.py --superbatch: the fused one-buffer apply A/B.  The
+    committed CPU artifact must show the sets config >=1.3x warm
+    samples/sec over superbatch-off with BIT-EQUAL estimates (the
+    speedup cannot come from computing something else), the mixed
+    four-class cycle collapsing 4 apply dispatches to 1, and the
+    per-interval dispatch/H2D accounting that makes the collapse
+    auditable.  The absolute >=10M samples/sec/chip line applies only
+    to device captures."""
+    d = _committed_artifact("superbatch_apply.json")
+    assert d["mode"] == "superbatch" and d["quick"] is False
+    # the tentpole speedup, with its honesty pin
+    assert d["sets_speedup_warm"] >= 1.3, d["sets_speedup_warm"]
+    assert d["sets_estimates_equal"] is True
+    assert (d["sets_on"]["warm_mean_samples_per_sec"] >=
+            1.3 * d["sets_off"]["warm_mean_samples_per_sec"])
+    # dispatch collapse: the mixed cycle's 4 per-class applies fuse
+    # into exactly one; the legacy arm must NOT regress (still its
+    # 4 — a drop there means the oracle silently changed shape)
+    assert d["mixed_on"]["apply_dispatches_per_cycle"] == 1.0
+    assert d["mixed_off"]["apply_dispatches_per_cycle"] == 4.0
+    # accounting fields travel with both arms (satellite: the
+    # DeviceCostRegistry counters telemetry ships per interval)
+    for arm in ("sets_off", "sets_on"):
+        assert d[arm]["device_dispatches_per_interval"] >= 1.0, arm
+        assert d[arm]["h2d_bytes_per_interval"] > 0, arm
+        assert d[arm]["apply_dispatches_per_interval"] == 1.0, arm
+    assert "platform" in d and "gates" in d
+    if d["platform"] == "tpu":
+        assert d["sets_on"]["warm_mean_samples_per_sec"] >= 10e6
+
+
+@pytest.mark.slow
+def test_superbatch_quick_rerun():
+    """Re-run the fused-apply A/B end to end (quick scale) — the
+    collapse and the estimate-equality gates must be reproducible.
+    The 1.3x speedup is full-scale-only: at 1/10 the members the
+    per-class scatter is too cheap for the fixed plane-transfer cost
+    to win."""
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--superbatch", "--quick"],
+        env={**_ENV, "VENEUR_BENCH_PLATFORM": "cpu"},
+        capture_output=True, text=True, timeout=560,
+        cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr[-2000:]
+    d = json.loads(out.stdout.strip().splitlines()[-1])
+    assert d["sets_estimates_equal"] is True
+    assert d["mixed_dispatches_on"] == 1.0
+    assert d["mixed_dispatches_off"] == 4.0
+    assert d["sets_speedup_warm"] > 0
+
+
+def test_summary_line_superbatch_fields():
+    """The --superbatch summary line carries exactly its verdict (and
+    the normal line never grows the superbatch fields)."""
+    m = _bench_module()
+    sline = m._summary_line({
+        "mode": "superbatch",
+        "sets_speedup_warm": 1.87,
+        "sets_estimates_equal": True,
+        "sets_on": {"warm_mean_samples_per_sec": 4.0e6},
+        "mixed_off": {"apply_dispatches_per_cycle": 4.0},
+        "mixed_on": {"apply_dispatches_per_cycle": 1.0},
+        "platform": "cpu"})
+    assert len(sline) < 1024
+    sd = json.loads(sline)
+    assert sd["sets_speedup_warm"] == 1.87
+    assert sd["sets_estimates_equal"] is True
+    assert sd["mixed_dispatches_off"] == 4.0
+    assert sd["mixed_dispatches_on"] == 1.0
+
+    nd = json.loads(m._summary_line({"platform": "cpu"}))
+    assert "sets_speedup_warm" not in nd
+    assert "mixed_dispatches_on" not in nd
+
+
 def test_summary_line_cardinality_fields():
     """The --cardinality summary line carries exactly its verdict
     (and the normal line never grows the cardinality fields)."""

@@ -541,3 +541,44 @@ def test_performance_doc_covers_adaptive_tiers():
             "unattributed_lost == 0",
     ):
         assert needle in perf, needle
+
+def test_superbatch_metrics_documented():
+    """ISSUE 20 names, pinned explicitly: the dispatch-collapse
+    accounting the superbatch bench gate reads."""
+    for name in (
+            "veneur.device.dispatches_total",
+            "veneur.device.h2d_bytes_total",
+    ):
+        assert name in DOCS, name
+        assert any(name in (ROOT / m).read_text() for m in SCANNED), \
+            name
+    # the /debug/vars surface the same totals ride
+    assert "dispatch_total" in DOCS
+    assert "h2d_bytes_total" in DOCS
+
+
+def test_superbatch_env_var_documented():
+    """ISSUE 20 gate: the superbatch on/off lever must appear in the
+    README env table, the performance doc that explains the buffer,
+    AND docs/observability.md."""
+    readme = (ROOT / "README.md").read_text()
+    perf = (ROOT / "docs" / "performance.md").read_text()
+    for text in (readme, perf, DOCS):
+        assert "VENEUR_TPU_SUPERBATCH" in text
+
+
+def test_performance_doc_covers_superbatch():
+    """The 'Superbatch device apply' section: the buffer schema, the
+    double-buffer overlap, the fallback matrix, the parity oracle,
+    and the committed A/B artifact."""
+    perf = (ROOT / "docs" / "performance.md").read_text()
+    for needle in (
+            "Superbatch device apply",
+            "SBSpec",
+            "bit-identical operands to\nthe per-class oracle",
+            "Fallback matrix",
+            "Two host staging buffers alternate",
+            "bench_results/superbatch_apply.json",
+            "4\napply dispatches to 1",
+    ):
+        assert needle in perf, needle

@@ -1,0 +1,440 @@
+"""Superbatch device apply (ISSUE 20): one fused H2D transfer + one
+dispatch per apply cycle.
+
+The fused path must be a bit-parity twin of the per-class oracle —
+counter sums, gauge last-writes, HLL registers EXACT; t-digest planes
+exact too because the fused step inlines the SAME ranked-merge entry
+points on the SAME padded operands.  Every test here builds an
+off-arm and an on-arm table in the same process (the gate is read at
+table construction) and compares raw interval state, then pins the
+dispatch ledger: the on-arm cycle is exactly ONE table.* dispatch.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from veneur_tpu import observe
+from veneur_tpu.core.table import MetricTable, TableConfig
+from veneur_tpu.ops import hll, superbatch
+from veneur_tpu.protocol import columnar
+
+
+def _mk(monkeypatch, arm: str, **cfg) -> MetricTable:
+    monkeypatch.setenv("VENEUR_TPU_SUPERBATCH", arm)
+    cfg.setdefault("host_set_plane_max_bytes", 0)  # force device sets
+    return MetricTable(TableConfig(**cfg))
+
+
+def _cycle(table: MetricTable, lines: list[bytes]):
+    pb = columnar.ColumnarParser().parse(b"\n".join(lines),
+                                         copy=False)
+    table.ingest_columns(pb)
+    table.device_step(final=True)
+    return table.swap()
+
+
+def _table_kernel_calls() -> dict[str, int]:
+    snap = observe.REGISTRY.snapshot()
+    return {k: v["calls"] for k, v in snap["kernels"].items()
+            if k.startswith("table.")}
+
+
+def _delta(k0: dict, k1: dict) -> dict[str, int]:
+    return {k: k1[k] - k0.get(k, 0) for k in k1
+            if k1[k] != k0.get(k, 0)}
+
+
+def _mixed_lines(n_counter=400, n_gauge=120, n_histo=40,
+                 n_set=150, seed=3) -> list[bytes]:
+    """All four classes in one interval, with the histo batch sparse
+    enough that the ranked shallow path (the superbatch's shape) wins
+    over the host-densified plane."""
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(n_counter):
+        lines.append(f"c.{i % 37}:{(i % 5) + 1}|c".encode())
+    for i in range(n_gauge):
+        lines.append(f"g.{i % 23}:{i % 97}|g".encode())
+    hv = rng.gamma(2.0, 30.0, n_histo)
+    for i in range(n_histo):
+        lines.append(f"h.{i % 29}:{hv[i]:.4f}|h".encode())
+    for i in range(n_set):
+        lines.append(f"s.{i % 5}:m{i % 60}|s".encode())
+    return lines
+
+
+_STATE_KEYS = ("counters", "gauges", "histo_means", "histo_weights",
+               "histo_stats", "hll_regs")
+
+
+def _assert_state_equal(snap_off, snap_on):
+    for key in _STATE_KEYS:
+        a = np.asarray(getattr(snap_off, key))
+        b = np.asarray(getattr(snap_on, key))
+        assert np.array_equal(a, b), key
+
+
+# ----------------------------------------------------------------------
+# fused parity + dispatch ledger
+
+
+def test_fused_parity_all_four_classes_one_cycle(monkeypatch):
+    """One cycle staging all four classes: the fused step's output
+    state is bit-identical to the per-class oracle's, and the on-arm
+    apply is exactly one dispatch."""
+    lines = _mixed_lines()
+    off = _mk(monkeypatch, "off", set_rows=8)
+    snap_off = _cycle(off, lines)
+    on = _mk(monkeypatch, "on", set_rows=8)
+    k0 = _table_kernel_calls()
+    snap_on = _cycle(on, lines)
+    d = _delta(k0, _table_kernel_calls())
+    assert d == {"table.superbatch_apply": 1}, d
+    _assert_state_equal(snap_off, snap_on)
+    # flush-visible values agree too (host-side derivations)
+    assert snap_off.counters is not None
+    est_off = np.asarray(hll.estimate(snap_off.hll_regs))
+    est_on = np.asarray(hll.estimate(snap_on.hll_regs))
+    assert np.array_equal(est_off, est_on)
+
+
+def test_off_arm_dispatches_per_class(monkeypatch):
+    """The oracle arm pays one dispatch per staged class — the 4x
+    the superbatch collapses.  Pinning it keeps the A/B honest."""
+    lines = _mixed_lines()
+    off = _mk(monkeypatch, "off", set_rows=8)
+    _cycle(off, lines)  # absorb row allocation + compiles
+    k0 = _table_kernel_calls()
+    _cycle(off, lines)
+    d = _delta(k0, _table_kernel_calls())
+    assert "table.superbatch_apply" not in d
+    assert sum(d.values()) >= 4, d
+
+
+def test_parity_repeated_cycles(monkeypatch):
+    """Parity holds across cycles (fresh interval state each swap,
+    double buffer alternating slots)."""
+    off = _mk(monkeypatch, "off", set_rows=8)
+    on = _mk(monkeypatch, "on", set_rows=8)
+    for seed in (1, 2, 3):
+        lines = _mixed_lines(seed=seed)
+        _assert_state_equal(_cycle(off, lines), _cycle(on, lines))
+
+
+# ----------------------------------------------------------------------
+# empty-class segments
+
+
+@pytest.mark.parametrize("cls", ["counter", "gauge", "histo", "set"])
+def test_single_class_cycle_parity(monkeypatch, cls):
+    """Cycles staging only ONE class: every other segment is absent
+    from the schema (length 0) and its plane passes through
+    untouched."""
+    lines = {
+        "counter": [f"c.{i % 7}:2|c".encode() for i in range(300)],
+        "gauge": [f"g.{i % 9}:{i}|g".encode() for i in range(200)],
+        "histo": [f"h.{i % 13}:{(i % 50) / 7:.3f}|h".encode()
+                  for i in range(60)],
+        "set": [f"s.{i % 3}:u{i % 40}|s".encode()
+                for i in range(120)],
+    }[cls]
+    off = _mk(monkeypatch, "off", set_rows=8)
+    snap_off = _cycle(off, lines)
+    on = _mk(monkeypatch, "on", set_rows=8)
+    k0 = _table_kernel_calls()
+    snap_on = _cycle(on, lines)
+    d = _delta(k0, _table_kernel_calls())
+    assert d == {"table.superbatch_apply": 1}, d
+    _assert_state_equal(snap_off, snap_on)
+
+
+def test_empty_cycle_no_dispatch(monkeypatch):
+    """A swap with nothing staged must not build a buffer or
+    dispatch."""
+    on = _mk(monkeypatch, "on", set_rows=8)
+    k0 = _table_kernel_calls()
+    on.swap()
+    assert _delta(k0, _table_kernel_calls()) == {}
+
+
+# ----------------------------------------------------------------------
+# set arms: POS scatter vs full-plane union vs compact plane
+
+
+def _set_lines(n_members: int, n_rows: int) -> list[bytes]:
+    return [f"u.{i % n_rows}:m{i}|s".encode()
+            for i in range(n_members)]
+
+
+@pytest.mark.parametrize("n_members,n_rows,arm", [
+    (100, 5, "pos"),          # tiny batch: packed scatter
+    (1300, 5, "plane_full"),  # CPU: whole-pool union beats scatter
+    (13000, 5, "plane"),      # huge batch, few rows: compact plane
+])
+def test_set_arm_selection_and_parity(monkeypatch, n_members,
+                                      n_rows, arm):
+    """All three set arms are register-bit-identical to the oracle
+    (byte max is order-free), and the router picks the expected arm
+    for each shape."""
+    lines = _set_lines(n_members, n_rows)
+    off = _mk(monkeypatch, "off", set_rows=8)
+    snap_off = _cycle(off, lines)
+    on = _mk(monkeypatch, "on", set_rows=8)
+    if on._lib is None and arm != "pos":
+        pytest.skip("plane arms require the native library")
+    w_probe = on._sb_set_pack(
+        ([], [],
+         [np.zeros(n_members, np.int32)],
+         [np.zeros(n_members, np.int32)]))
+    assert w_probe[0] == arm, w_probe[0]
+    snap_on = _cycle(on, lines)
+    assert np.array_equal(np.asarray(snap_off.hll_regs),
+                          np.asarray(snap_on.hll_regs))
+    est_off = np.asarray(hll.estimate(snap_off.hll_regs))
+    est_on = np.asarray(hll.estimate(snap_on.hll_regs))
+    assert np.array_equal(est_off, est_on)
+
+
+def test_host_fold_sets_stay_per_class(monkeypatch):
+    """Small pools take the device-FREE host register plane; the
+    superbatch must not steal them onto the device."""
+    lines = _set_lines(200, 4)
+    on = _mk(monkeypatch, "on", set_rows=8,
+             host_set_plane_max_bytes=64 << 20)
+    k0 = _table_kernel_calls()
+    snap = _cycle(on, lines)
+    d = _delta(k0, _table_kernel_calls())
+    assert "table.superbatch_apply" not in d, d
+    assert snap.host_only_sets
+    assert np.asarray(snap.hll_host_plane).any()
+
+
+# ----------------------------------------------------------------------
+# routing boundaries: shapes the superbatch must NOT take
+
+
+def test_plane_eligible_histo_falls_per_class(monkeypatch):
+    """A dense histo batch (host-densified plane is the smaller
+    transfer) keeps the per-class plane step, bit-identically to the
+    off arm — the shared _plane_choice guarantees the two routers
+    never disagree."""
+    lines = []
+    for i in range(3000):  # ~47 samples/row over all 64 rows: dense
+        lines.append(f"h.{i % 64}:{(i % 40) / 3:.3f}|h".encode())
+    off = _mk(monkeypatch, "off", histo_rows=64)
+    snap_off = _cycle(off, lines)
+    on = _mk(monkeypatch, "on", histo_rows=64)
+    if on._lib is None:
+        pytest.skip("plane step requires the native library")
+    assert on._plane_choice(
+        np.asarray([i % 64 for i in range(3000)], np.int32),
+        np.asarray([(i % 40) / 3 for i in range(3000)], np.float32),
+        True, 3000)[2]
+    k0 = _table_kernel_calls()
+    snap_on = _cycle(on, lines)
+    d = _delta(k0, _table_kernel_calls())
+    assert "table.superbatch_apply" not in d, d
+    _assert_state_equal(snap_off, snap_on)
+
+
+def test_tiered_mode_falls_back_per_class(monkeypatch):
+    """Tier-split rows route per tier partition; superbatch stays out
+    of tiered tables entirely (exactness first)."""
+    monkeypatch.setenv("VENEUR_TPU_PLANE_TIERS", "2")
+    on = _mk(monkeypatch, "on", set_rows=16)
+    assert on.tiers is not None and on._sb_on
+    lines = [f"c.{i % 7}:1|c".encode() for i in range(500)]
+    k0 = _table_kernel_calls()
+    snap = _cycle(on, lines)
+    d = _delta(k0, _table_kernel_calls())
+    assert "table.superbatch_apply" not in d, d
+    assert float(np.asarray(snap.counters).sum()) == 500.0
+
+
+# ----------------------------------------------------------------------
+# pipelined swap concurrency
+
+
+@pytest.mark.parametrize("arm", ["off", "on"])
+def test_pipelined_swap_concurrency_exact_totals(monkeypatch, arm):
+    """Reader threads ingesting counters+sets race begin_swap /
+    complete_swap: totals across every snapshot must be EXACT with
+    the fused apply on — a staged batch that crossed the swap into
+    the wrong buffer (or was double-applied by the fused step) breaks
+    conservation."""
+    table = _mk(monkeypatch, arm, set_rows=8)
+    n_threads, n_rounds, per_packet, n_uniq = 4, 60, 40, 50
+    start = threading.Barrier(n_threads + 1)
+    stop = threading.Event()
+    # the ingest lock readers and begin_swap share, mirroring the
+    # server (begin_swap's contract: "under the caller's ingest
+    # lock"); complete_swap runs OUTSIDE it, racing the appliers
+    ingest_lock = threading.Lock()
+    pkt = b"\n".join(b"hits:1|c\nuniq:%d|s" % (i % n_uniq)
+                     for i in range(per_packet))
+
+    def reader():
+        p = columnar.ColumnarParser()
+        start.wait()
+        for _ in range(n_rounds):
+            pb = p.parse(pkt, copy=False)
+            with ingest_lock:
+                table.ingest_columns(pb)
+            table.device_step()
+
+    snaps = []
+
+    def flusher():
+        start.wait()
+        while not stop.is_set():
+            with ingest_lock:
+                pend = table.begin_swap()
+            snaps.append(table.complete_swap(pend))
+
+    threads = [threading.Thread(target=reader)
+               for _ in range(n_threads)]
+    ft = threading.Thread(target=flusher)
+    for t in threads + [ft]:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    ft.join()
+    snaps.append(table.complete_swap(table.begin_swap()))
+
+    expect = float(n_threads * n_rounds * per_packet)
+    got = sum(float(np.asarray(s.counters).sum()) for s in snaps)
+    assert got == expect, (got, expect)
+    # sets: every interval's registers fold into one plane whose
+    # estimate must see every distinct member (union across snaps)
+    regs = None
+    for s in snaps:
+        r = np.asarray(s.hll_regs)
+        regs = r if regs is None else np.maximum(regs, r)
+    est = float(np.asarray(hll.estimate(regs)).sum())
+    distinct = min(per_packet, n_uniq)
+    assert abs(est - distinct) <= 0.1 * distinct + 3, est
+
+
+# ----------------------------------------------------------------------
+# satellite 1: packed single-array insert is the production form
+
+
+def test_insert_packed_matches_dual_array():
+    rng = np.random.default_rng(11)
+    n, rows = 4096, 16
+    r = rng.integers(0, rows, n, dtype=np.int32)
+    idx = rng.integers(0, hll.M, n, dtype=np.int32)
+    rank = rng.integers(1, 60, n, dtype=np.int32)
+    import jax.numpy as jnp
+    regs = jnp.zeros((rows, hll.M), jnp.uint8)
+    a = np.asarray(hll.insert(regs, r, idx, rank))
+    b = np.asarray(hll.insert_packed(
+        regs, r, hll.pack_positions(idx, rank)))
+    assert np.array_equal(a, b)
+
+
+def test_graft_entry_uses_packed_positions():
+    """__graft_entry__ ships the packed (index << 6 | rank) operand —
+    the single-array form every production set-insert path uses."""
+    import __graft_entry__ as ge
+    import inspect
+    src = inspect.getsource(ge.entry)
+    assert "insert_packed" in src
+    assert "pack_positions" in src
+
+
+# ----------------------------------------------------------------------
+# schema / buffer unit pins
+
+
+def test_layout_segments_contiguous():
+    spec = superbatch.SBSpec(
+        counter_rows=256, gauge_rows=128, histo_n=512,
+        histo_slots=64, histo_sub=32, histo_unit=False,
+        histo_stats=True, compression=100.0, pos_n=1024)
+    off = superbatch.layout(spec)
+    assert off["counter"] == superbatch.HEADER_WORDS
+    assert off["gauge_dense"] == off["counter"] + 256
+    assert off["gauge_mask"] == off["gauge_dense"] + 128
+    assert off["histo_rows"] == off["gauge_mask"] + 128
+    assert off["histo_rank"] == off["histo_rows"] + 512
+    assert off["histo_vals"] == off["histo_rank"] + 512
+    assert off["histo_wts"] == off["histo_vals"] + 512
+    assert off["histo_idx"] == off["histo_wts"] + 512
+    assert off["pos_rows"] == off["histo_idx"] + 32
+    assert off["pos_pk"] == off["pos_rows"] + 1024
+    assert off["total"] == off["pos_pk"] + 1024
+    # unit-weight batches drop the wts segment
+    u = superbatch.layout(spec._replace(histo_unit=True))
+    assert u["histo_idx"] == u["histo_wts"]
+    # plane arm: regs are M/4 words per row; full planes carry no idx
+    p = superbatch.layout(superbatch.SBSpec(plane_rows=8))
+    assert p["plane_regs"] == p["plane_idx"] + 8
+    assert p["total"] == p["plane_regs"] + 8 * (hll.M // 4)
+    pf = superbatch.layout(
+        superbatch.SBSpec(plane_rows=8, plane_full=True))
+    assert pf["plane_regs"] == pf["plane_idx"]
+
+
+def test_fill_header_stamps_magic():
+    spec = superbatch.SBSpec(counter_rows=16)
+    off = superbatch.layout(spec)
+    buf = np.zeros(off["total"], np.int32)
+    superbatch.fill_header(buf, spec, off)
+    assert buf[0] == 0x53425631  # "SBV1"
+    assert buf[1] == off["total"]
+    assert buf[2] == superbatch.HEADER_WORDS
+
+
+def test_double_buffer_alternates_and_grows():
+    db = superbatch.DoubleBuffer()
+    a = db.take(100)
+    b = db.take(100)
+    c = db.take(100)
+    assert len(a) == len(b) == 100
+    # slot reuse: N and N+2 share backing memory, N and N+1 never do
+    assert np.shares_memory(a, c)
+    assert not np.shares_memory(a, b)
+    big = db.take(5000)  # grow-only: reallocates past the old cap
+    assert len(big) == 5000
+    assert not np.shares_memory(big, b)
+
+
+def test_mode_env_parsing(monkeypatch):
+    for raw, want in (("off", "off"), ("0", "off"), ("false", "off"),
+                      ("on", "on"), ("1", "on"), ("true", "on"),
+                      ("auto", "auto"), ("", "auto")):
+        monkeypatch.setenv("VENEUR_TPU_SUPERBATCH", raw)
+        assert superbatch.mode() == want, raw
+    monkeypatch.delenv("VENEUR_TPU_SUPERBATCH")
+    assert superbatch.mode() == "auto"
+    assert superbatch.enabled()
+    assert superbatch.plane_scatter_factor("cpu") == 16
+    assert superbatch.plane_scatter_factor("tpu") == 1
+
+
+# ----------------------------------------------------------------------
+# satellite 2: dispatch + H2D accounting
+
+
+def test_registry_accounts_dispatches_and_h2d(monkeypatch):
+    """The fused apply's one call and its host-buffer bytes land in
+    the DeviceCostRegistry — the counters Telemetry ships as
+    veneur.device.dispatches_total / h2d_bytes_total."""
+    on = _mk(monkeypatch, "on", set_rows=8)
+    t0 = observe.REGISTRY.totals()
+    s0 = observe.REGISTRY.snapshot()["kernels"].get(
+        "table.superbatch_apply", {})
+    _cycle(on, _mixed_lines())
+    t1 = observe.REGISTRY.totals()
+    s1 = observe.REGISTRY.snapshot()["kernels"][
+        "table.superbatch_apply"]
+    assert t1["dispatch_total"] - t0["dispatch_total"] >= 1
+    # the buffer is one int32 host array; its bytes are the cycle's
+    # whole H2D bill for this kernel
+    db = s1["h2d_bytes"] - s0.get("h2d_bytes", 0)
+    assert db > 0 and db % 4 == 0
+    assert (t1["h2d_bytes_total"] - t0["h2d_bytes_total"]) >= db

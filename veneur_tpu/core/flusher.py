@@ -153,6 +153,13 @@ def _gather_rows_jit(plane, idx):
 _gather_rows = observe.instrument("flusher.gather_rows",
                                   _gather_rows_jit)
 
+# mixed-interval host-plane union (raw set traffic + imports in one
+# interval): instrumented so its dispatch and host-plane h2d bytes
+# show up in the per-interval device accounting like every other
+# flush kernel
+_union_host_plane = observe.instrument("flusher.hll_union_host_plane",
+                                       jax.jit(hll.union))
+
 
 def _pad_idx(rows: list[int]) -> tuple[jnp.ndarray, int]:
     from veneur_tpu.core.table import _bucket_len
@@ -489,8 +496,8 @@ class Flusher:
                 if snap.hll_host_plane is not None:
                     # rare mixed interval (raw traffic + imports):
                     # union the host plane in once, then read on device
-                    regs = hll.union(regs,
-                                     jnp.asarray(snap.hll_host_plane))
+                    regs = _union_host_plane(regs,
+                                             snap.hll_host_plane)
                 if fwd:
                     idx, _ = _pad_idx(fwd)
                     devs["fwd_regs"] = _gather_rows(regs, idx)

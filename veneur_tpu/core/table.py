@@ -42,7 +42,7 @@ import numpy as np
 from veneur_tpu import native, observe
 from veneur_tpu.core import tiers as tiersmod
 from veneur_tpu.observe.ledger import ClassDropTally
-from veneur_tpu.ops import hll, segment, tdigest
+from veneur_tpu.ops import hll, segment, superbatch, tdigest
 from veneur_tpu.protocol import columnar, dogstatsd as dsd
 from veneur_tpu.utils import hashing, intern, jitopts
 
@@ -84,6 +84,37 @@ _histo_stats_fold = observe.instrument(
     "table.histo_stats_fold",
     jax.jit(tdigest._combine_row_stats,
             donate_argnums=jitopts.donate(0)))
+# The per-class histo merges dispatch tdigest's jitted entry points;
+# wrap each in the device-cost registry so the per-interval dispatch
+# telemetry (veneur.device.dispatches_total) sees the per-class path
+# and the superbatch A/B comparison is honest.
+
+
+class _TdStep:
+    """Resolves ``tdigest.<name>`` at call time, not wrap time — the
+    branch-engagement tests monkeypatch the module attributes to spy
+    which merge path fired, and a captured reference would go dark."""
+
+    def __init__(self, name: str):
+        self._name = name
+
+    def __call__(self, *args, **kwargs):
+        return getattr(tdigest, self._name)(*args, **kwargs)
+
+    def __getattr__(self, attr):  # _cache_size etc. from the live fn
+        return getattr(getattr(tdigest, self._name), attr)
+
+
+_td_step = {
+    name: observe.instrument("table.td_" + name, _TdStep(name))
+    for name in (
+        "ingest_ranked", "ingest_ranked_unit",
+        "ingest_ranked_rows", "ingest_ranked_unit_rows",
+        "add_samples_ranked", "add_samples_ranked_unit",
+        "add_samples_ranked_rows", "add_samples_ranked_unit_rows",
+        "ingest_plane_pre", "ingest_plane_pre_unit",
+        "add_samples_ranked_scan", "add_samples_ranked_scan_rows",
+        "merge_dense_scan", "merge_dense_scan_rows")}
 
 _MIN_BUCKET = 256
 _MIN_BUCKET_WIDE = 8  # for batches whose rows are whole planes
@@ -673,6 +704,18 @@ class MetricTable:
         # topology is only trustworthy then), None when it resolves
         # off, else the fold object (holds the jitted collective)
         self._collective_fold: object = "unset"
+
+        # superbatch apply (ops/superbatch): pack the whole cycle's
+        # detached staging into ONE host buffer and apply it with ONE
+        # fused dispatch.  Double-buffered so packing cycle N+1
+        # overlaps the device computing cycle N; the per-class path
+        # below stays intact as the bit-parity oracle and the
+        # fallback for tiered tables and fused-ineligible batches.
+        self.superbatch_mode = superbatch.mode()
+        self._sb_on = self.superbatch_mode != "off"
+        self._sb_bufs = superbatch.DoubleBuffer()
+        self._sb_plane_factor = superbatch.plane_scatter_factor(
+            jax.default_backend())
 
         # pipelined apply machinery: device dispatch serializes on
         # _device_lock so staged work applies outside the ingest lock;
@@ -1624,9 +1667,18 @@ class MetricTable:
     def _apply_work(self, w: _StagedWork) -> None:
         """Apply detached staging to its pinned interval state: the
         concat/hash host work and every jitted dispatch — everything
-        the ingest lock must NOT cover.  Caller holds _device_lock."""
+        the ingest lock must NOT cover.  Caller holds _device_lock.
+
+        When the superbatch gate is on (and the table untiered), the
+        fused arm consumes every family the one-buffer schema can
+        carry and nulls it on ``w``; whatever it declines — wire and
+        import merges, plane-densified or deep histo batches, the
+        device-free host set fold — falls through to the per-class
+        dispatches below, which double as the bit-parity oracle."""
         st = w.state
         c = self.config
+        if self._sb_on and self.tiers is None:
+            self._superbatch_apply(w)
         if w.counter is not None:
             self._ensure_fresh(st, "counter")
             st.counters = _counter_dense_step(
@@ -1682,9 +1734,8 @@ class MetricTable:
                 b = _bucket_len(len(srows))
                 st.hll_regs = _hll_step_packed(
                     st.hll_regs,
-                    jnp.asarray(_pad_np(srows, b,
-                                        self._set_pool_rows)),
-                    jnp.asarray(_pad_np(spos, b, 0)))
+                    _pad_np(srows, b, self._set_pool_rows),
+                    _pad_np(spos, b, 0))
         if w.stats_parts is not None:
             rows = np.concatenate([p[0] for p in w.stats_parts])
             vals = np.concatenate([p[1] for p in w.stats_parts])
@@ -1696,8 +1747,7 @@ class MetricTable:
             self._ensure_fresh(st, "histo")
             st.histo_import_stats = _histo_stats_merge(
                 st.histo_import_stats,
-                jnp.asarray(_pad_np(rows, b, c.histo_rows)),
-                jnp.asarray(padded))
+                _pad_np(rows, b, c.histo_rows), padded)
         if w.set_import is not None:
             plane, touched = w.set_import
             # imports fold into the host plane at receive time, so
@@ -1719,8 +1769,275 @@ class MetricTable:
             self._ensure_fresh(st, "hll")
             st.hll_regs = _hll_merge_rows(
                 st.hll_regs,
-                jnp.asarray(_pad_np(rows, b, c.set_rows)),
-                jnp.asarray(padded))
+                _pad_np(rows, b, c.set_rows), padded)
+
+    # ------------------------------------------------------------------
+    # superbatch apply (ops/superbatch): one packed host buffer, one
+    # fused dispatch per apply cycle
+
+    def _superbatch_apply(self, w: _StagedWork) -> None:
+        """Consume every staged family the fused one-buffer schema
+        can carry this cycle, pack them into one int32 host buffer
+        (per-class segments at static offsets, per-class pad
+        sentinels identical to the per-class path's) and apply them
+        with ONE fused jitted dispatch.  Consumed families are
+        nulled on ``w``; everything else stays for the per-class
+        oracle.  Caller holds _device_lock."""
+        st = w.state
+        c = self.config
+        counter = None
+        if w.counter is not None:
+            counter = np.ascontiguousarray(w.counter, np.float32)
+            w.counter = None
+        gauge = None
+        if w.gauge is not None:
+            dense, mask = w.gauge
+            gauge = (np.ascontiguousarray(dense, np.float32),
+                     np.ascontiguousarray(mask, np.int32))
+            w.gauge = None
+        histo = None
+        if w.histo is not None:
+            batch = w.histo.take()
+            w.histo = None
+            if batch is not None:
+                histo = self._sb_histo_pack(st, *batch)
+        sets = None
+        if (w.set_parts is not None and
+                c.set_rows * hll.M > c.host_set_plane_max_bytes):
+            # the host-fold route (small pools) is device-FREE —
+            # nothing the fused dispatch does beats zero dispatches,
+            # so it keeps w.set_parts and the per-class path
+            sets = self._sb_set_pack(w.set_parts)
+            w.set_parts = None
+        if (counter is None and gauge is None and histo is None
+                and sets is None):
+            return
+        kw: dict = {}
+        if counter is not None:
+            kw["counter_rows"] = c.counter_rows
+        if gauge is not None:
+            kw["gauge_rows"] = c.gauge_rows
+        if histo is not None:
+            kw.update(histo[0])
+        if sets is not None:
+            kw.update(sets[1])
+        spec = superbatch.SBSpec(**kw)
+        off = superbatch.layout(spec)
+        buf = self._sb_bufs.take(off["total"])
+        superbatch.fill_header(buf, spec, off)
+        if counter is not None:
+            o = off["counter"]
+            buf[o:o + c.counter_rows].view(np.float32)[:] = counter
+        if gauge is not None:
+            o = off["gauge_dense"]
+            buf[o:o + c.gauge_rows].view(np.float32)[:] = gauge[0]
+            o = off["gauge_mask"]
+            buf[o:o + c.gauge_rows] = gauge[1]
+        if histo is not None:
+            self._sb_fill_histo(buf, off, spec, histo)
+        if sets is not None:
+            self._sb_fill_set(buf, off, spec, sets)
+        args = []
+        if spec.counter_rows:
+            self._ensure_fresh(st, "counter")
+            args.append(st.counters)
+        else:
+            args.append(jnp.zeros(0, jnp.float32))
+        if spec.gauge_rows:
+            self._ensure_fresh(st, "gauge")
+            args.append(st.gauges)
+        else:
+            args.append(jnp.zeros(0, jnp.float32))
+        if spec.histo_n:
+            self._ensure_fresh(st, "histo")
+            args += [st.histo_means, st.histo_weights,
+                     st.histo_stats]
+        else:
+            args += [jnp.zeros(0, jnp.float32) for _ in range(3)]
+        if spec.pos_n or spec.plane_rows:
+            self._ensure_fresh(st, "hll")
+            st.hll_device_touched = True
+            args.append(st.hll_regs)
+        else:
+            args.append(jnp.zeros(0, jnp.uint8))
+        out = superbatch.step(spec, *args, buf)
+        if spec.counter_rows:
+            st.counters = out[0]
+        if spec.gauge_rows:
+            st.gauges = out[1]
+        if spec.histo_n:
+            (st.histo_means, st.histo_weights,
+             st.histo_stats) = out[2:5]
+        if spec.pos_n or spec.plane_rows:
+            st.hll_regs = out[5]
+
+    def _sb_histo_pack(self, st, rows, vals, wts):
+        """Route one histo batch: ride the superbatch when the
+        shallow ranked merge is its transfer shape, else fall to the
+        per-class step (host-densified plane and deep-scan batches
+        ship fewer bytes through their own shapes).  Thresholds are
+        shared with _histo_device_step so the two routers can never
+        disagree.  Returns the packed operands, or None when the
+        batch was handled per-class."""
+        c = self.config
+        n = len(rows)
+        if not n:
+            return None
+        unit = bool(np.all(wts == 1.0))
+        rows = np.ascontiguousarray(rows, np.int32)
+        vals = np.ascontiguousarray(vals, np.float32)
+        if (self._lib is not None and
+                self._plane_choice(rows, vals, unit, n)[2]):
+            self._histo_device_step(st, rows, vals, wts,
+                                    with_stats=True)
+            return None
+        rank, max_count = self._rank(rows)
+        if max_count > self._eff_histo_slots:
+            self._histo_device_step(st, rows, vals, wts,
+                                    with_stats=True)
+            return None
+        b = _bucket_len(n)
+        slots = min(self._eff_histo_slots, _bucket_len(max_count))
+        uniq = np.unique(rows)
+        mb = _bucket_len(len(uniq))
+        sub = mb * 2 <= c.histo_rows
+        if sub:
+            local = np.searchsorted(uniq, rows).astype(np.int32)
+            rows_seg = _pad_np(local, b, mb)
+            idx_seg = _pad_np(uniq.astype(np.int32), mb,
+                              c.histo_rows)
+        else:
+            rows_seg = _pad_np(rows, b, c.histo_rows)
+            idx_seg = None
+        wts_seg = (None if unit else
+                   _pad_np(np.ascontiguousarray(wts, np.float32),
+                           b, 0.0))
+        spec_kw = dict(histo_n=b, histo_slots=slots,
+                       histo_sub=mb if sub else 0, histo_unit=unit,
+                       histo_stats=True, compression=c.compression)
+        return (spec_kw, rows_seg, _pad_np(rank, b, 0),
+                _pad_np(vals, b, 0.0), wts_seg, idx_seg)
+
+    def _sb_fill_histo(self, buf, off, spec, histo) -> None:
+        _kw, rows_seg, rank_seg, vals_seg, wts_seg, idx_seg = histo
+        b = spec.histo_n
+        buf[off["histo_rows"]:off["histo_rows"] + b] = rows_seg
+        buf[off["histo_rank"]:off["histo_rank"] + b] = rank_seg
+        o = off["histo_vals"]
+        buf[o:o + b].view(np.float32)[:] = vals_seg
+        if wts_seg is not None:
+            o = off["histo_wts"]
+            buf[o:o + b].view(np.float32)[:] = wts_seg
+        if idx_seg is not None:
+            o = off["histo_idx"]
+            buf[o:o + spec.histo_sub] = idx_seg
+
+    def _sb_set_pack(self, set_parts):
+        """Choose the fused set arm for the cycle's staged members.
+        Three arms, cheapest viable device op first:
+
+        - compact PLANE (touched rows folded natively into a
+          T-row register plane; device does a row-granular max)
+          when the compact plane is the smaller transfer;
+        - full-plane PLANE (pool-shaped plane; device does one
+          elementwise max) on backends where the packed scatter is
+          the pathological op (XLA CPU: ~200ns per scattered
+          member) and the plane fits the scatter-cost budget;
+        - packed POS scatter otherwise — the per-class oracle's
+          exact operands inside the fused step.
+
+        All arms are register-bit-identical (byte max is
+        order-free).  Returns (arm, spec_kw, parts_rows, parts_pos,
+        touched)."""
+        c = self.config
+        set_rows_l, set_members, pos_rows, pos = set_parts
+        parts_rows: list[np.ndarray] = []
+        parts_pos: list[np.ndarray] = []
+        if set_rows_l:
+            idx, rank = hashing.hash_members(set_members)
+            parts_rows.append(np.asarray(set_rows_l, np.int32))
+            parts_pos.append(hll.pack_positions(idx, rank))
+        parts_rows.extend(np.ascontiguousarray(p, np.int32)
+                          for p in pos_rows)
+        parts_pos.extend(np.ascontiguousarray(p, np.int32)
+                         for p in pos)
+        n = sum(len(p) for p in parts_rows)
+        if not n:
+            return None
+        pool = self._set_pool_rows
+        nb = _bucket_len(n)
+        if self._lib is not None:
+            counts = np.zeros(pool, np.int64)
+            for pr in parts_rows:
+                counts += np.bincount(pr, minlength=pool)[:pool]
+            touched = np.nonzero(counts)[0].astype(np.int32)
+            tb = _bucket_len(len(touched), wide=True)
+            if tb * hll.M <= 8 * nb:
+                return ("plane", dict(plane_rows=tb), parts_rows,
+                        parts_pos, touched)
+            if (self._sb_plane_factor > 1 and
+                    pool * hll.M <= self._sb_plane_factor * 8 * nb):
+                return ("plane_full",
+                        dict(plane_rows=pool, plane_full=True),
+                        parts_rows, parts_pos, None)
+        return ("pos", dict(pos_n=nb), parts_rows, parts_pos, None)
+
+    def _sb_fill_set(self, buf, off, spec, sets) -> None:
+        import ctypes as ct
+        _arm, _kw, parts_rows, parts_pos, touched = sets
+        if spec.pos_n:
+            self._sb_gather(parts_rows, buf, off["pos_rows"],
+                            spec.pos_n, self._set_pool_rows)
+            self._sb_gather(parts_pos, buf, off["pos_pk"],
+                            spec.pos_n, 0)
+            return
+        # plane arms (native lib guaranteed by _sb_set_pack): zero
+        # the register segment, then fold every staged part straight
+        # into it — no intermediate concatenate
+        words = spec.plane_rows * (hll.M // 4)
+        seg = buf[off["plane_regs"]:off["plane_regs"] + words]
+        seg[:] = 0
+        plane_u8 = seg.view(np.uint8)
+        i32p = ct.POINTER(ct.c_int32)
+        u8p = plane_u8.ctypes.data_as(ct.POINTER(ct.c_uint8))
+        remap = None
+        if not spec.plane_full:
+            t = len(touched)
+            remap = np.full(self._set_pool_rows, -1, np.int32)
+            remap[touched] = np.arange(t, dtype=np.int32)
+            o = off["plane_idx"]
+            buf[o:o + t] = touched
+            # pad sentinel = pool rows: dropped by merge_rows'
+            # out-of-bounds scatter mode, same as the per-class path
+            buf[o + t:o + spec.plane_rows] = self._set_pool_rows
+        for pr, pp in zip(parts_rows, parts_pos):
+            if remap is not None:
+                pr = np.ascontiguousarray(remap[pr], np.int32)
+            self._lib.vtpu_hll_plane(
+                pr.ctypes.data_as(i32p), pp.ctypes.data_as(i32p),
+                len(pp), spec.plane_rows, hll.M, u8p)
+
+    def _sb_gather(self, parts, buf, o: int, cap: int,
+                   fill: int) -> None:
+        """Emit staged part arrays directly into one superbatch
+        segment (native vtpu_sb_gather_i32 when available): the
+        concat + pad copy pair collapses into a single pass."""
+        dst = buf[o:o + cap]
+        if self._lib is not None and parts:
+            import ctypes as ct
+            i32p = ct.POINTER(ct.c_int32)
+            k = len(parts)
+            ptrs = (i32p * k)(*(p.ctypes.data_as(i32p)
+                                for p in parts))
+            lens = (ct.c_int64 * k)(*(len(p) for p in parts))
+            self._lib.vtpu_sb_gather_i32(
+                ptrs, lens, k, dst.ctypes.data_as(i32p), cap, fill)
+            return
+        pos = 0
+        for p in parts:
+            dst[pos:pos + len(p)] = p
+            pos += len(p)
+        dst[pos:] = fill
 
     # ------------------------------------------------------------------
     # tiered apply routing (self.tiers is not None; every entry point
@@ -2036,8 +2353,7 @@ class MetricTable:
         np.minimum.at(batch[:, segment.STAT_MIN], rows, vals)
         np.maximum.at(batch[:, segment.STAT_MAX], rows, vals)
         self._ensure_fresh(st, "histo")
-        st.histo_stats = _histo_stats_fold(
-            st.histo_stats, jnp.asarray(batch))
+        st.histo_stats = _histo_stats_fold(st.histo_stats, batch)
 
     def _host_precluster(self, rows, vals, wts
                          ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -2072,6 +2388,43 @@ class MetricTable:
                 (cwv / np.maximum(cw_sum, 1e-30)).astype(np.float32),
                 cw_sum.astype(np.float32))
 
+    def _plane_choice(self, rows, vals, unit, n):
+        """Width / f16 / engagement decision for the host-densified
+        plane ingest — shared by _histo_plane_step and the
+        superbatch router so the two can never disagree about which
+        transfer shape a batch takes.  Returns (width, f16, engage);
+        width == 0 means the batch touched no rows."""
+        c = self.config
+        counts_full = np.bincount(rows, minlength=c.histo_rows)
+        occupied = counts_full[counts_full > 0]
+        if not len(occupied):
+            return 0, False, True
+        w_hi = int(occupied.max())
+        w_p99 = int(np.percentile(occupied, 99.5))
+        # width at 128-lane granularity around the p99.5 row count
+        # (compile-cache variants bounded by histo_slots/128); the
+        # coarse 1.5-step ladder only caps via the max row
+        width = min(max(128, -(-w_p99 // 128) * 128),
+                    _bucket_len(w_hi, wide=True),
+                    self._eff_histo_slots)
+        # f16 plane only for unit-weight batches whose nonzero values
+        # all sit in f16's NORMAL range: rel. quantization there is
+        # 2^-11 (~0.05%), while subnormals (<6.1e-5) would quantize at
+        # percent-level and weights (1/rate, up to 1e5+) could
+        # overflow to inf.  Stats stay exact either way.  The range
+        # scan is skipped for weighted batches (always f32 there).
+        f16 = False
+        if unit and _F16_PLANE:
+            av = np.abs(vals)
+            vmax = float(av.max(initial=0.0))
+            nz = av[av > 0]
+            vmin_nz = float(nz.min()) if len(nz) else 1.0
+            f16 = vmax < 6.0e4 and vmin_nz >= 6.2e-5
+        vbytes = 2 if f16 else 4
+        planes = 1 if unit else 2
+        engage = c.histo_rows * width * vbytes * planes <= 12 * n
+        return width, f16, engage
+
     def _histo_plane_step(self, st, rows, vals, wts, unit):
         """Host-densified plane ingest (native vtpu_dense_plane +
         tdigest.ingest_plane_pre*): ships a dense value plane instead
@@ -2097,34 +2450,10 @@ class MetricTable:
         n = len(rows)
         rows = np.ascontiguousarray(rows, np.int32)
         vals = np.ascontiguousarray(vals, np.float32)
-        counts_full = np.bincount(rows, minlength=c.histo_rows)
-        occupied = counts_full[counts_full > 0]
-        if not len(occupied):
+        width, f16, engage = self._plane_choice(rows, vals, unit, n)
+        if width == 0:
             return True, None
-        w_hi = int(occupied.max())
-        w_p99 = int(np.percentile(occupied, 99.5))
-        # width at 128-lane granularity around the p99.5 row count
-        # (compile-cache variants bounded by histo_slots/128); the
-        # coarse 1.5-step ladder only caps via the max row
-        width = min(max(128, -(-w_p99 // 128) * 128),
-                    _bucket_len(w_hi, wide=True),
-                    self._eff_histo_slots)
-        # f16 plane only for unit-weight batches whose nonzero values
-        # all sit in f16's NORMAL range: rel. quantization there is
-        # 2^-11 (~0.05%), while subnormals (<6.1e-5) would quantize at
-        # percent-level and weights (1/rate, up to 1e5+) could
-        # overflow to inf.  Stats stay exact either way.  The range
-        # scan is skipped for weighted batches (always f32 there).
-        f16 = False
-        if unit and _F16_PLANE:
-            av = np.abs(vals)
-            vmax = float(av.max(initial=0.0))
-            nz = av[av > 0]
-            vmin_nz = float(nz.min()) if len(nz) else 1.0
-            f16 = vmax < 6.0e4 and vmin_nz >= 6.2e-5
-        vbytes = 2 if f16 else 4
-        planes = 1 if unit else 2
-        if c.histo_rows * width * vbytes * planes > 12 * n:
+        if not engage:
             return False, None
         f32p = ct.POINTER(ct.c_float)
         i32p = ct.POINTER(ct.c_int32)
@@ -2165,17 +2494,15 @@ class MetricTable:
         self._ensure_fresh(st, "histo")
         if unit:
             (st.histo_means, st.histo_weights,
-             st.histo_stats) = tdigest.ingest_plane_pre_unit(
+             st.histo_stats) = _td_step["ingest_plane_pre_unit"](
                 st.histo_means, st.histo_weights,
-                st.histo_stats, jnp.asarray(batch_stats),
-                jnp.asarray(counts), jnp.asarray(plane_v),
+                st.histo_stats, batch_stats, counts, plane_v,
                 compression=c.compression)
         else:
             (st.histo_means, st.histo_weights,
-             st.histo_stats) = tdigest.ingest_plane_pre(
+             st.histo_stats) = _td_step["ingest_plane_pre"](
                 st.histo_means, st.histo_weights,
-                st.histo_stats, jnp.asarray(batch_stats),
-                jnp.asarray(plane_v), jnp.asarray(plane_w),
+                st.histo_stats, batch_stats, plane_v, plane_w,
                 compression=c.compression)
         if spill:
             return True, (
@@ -2264,8 +2591,7 @@ class MetricTable:
             plane.ctypes.data_as(ct.POINTER(ct.c_uint8)))
         self._ensure_fresh(st, "hll")
         st.hll_device_touched = True
-        st.hll_regs = _hll_union_plane(st.hll_regs,
-                                       jnp.asarray(plane))
+        st.hll_regs = _hll_union_plane(st.hll_regs, plane)
         return True
 
     def _rank(self, rows: np.ndarray,
@@ -2303,8 +2629,8 @@ class MetricTable:
         c = self.config
         self._ensure_fresh(st, "histo")
         b = _bucket_len(len(rows))
-        vals_dev = jnp.asarray(_pad_np(vals, b, 0.0))
-        rank_dev = jnp.asarray(_pad_np(rank, b, 0))
+        vals_dev = _pad_np(vals, b, 0.0)
+        rank_dev = _pad_np(rank, b, 0)
         # dense-plane width: what the batch's deepest row needs (the
         # old min(histo_slots, b) keyed on the FLAT batch length, so
         # a shallow-but-wide batch shipped an oversized plane and —
@@ -2322,15 +2648,15 @@ class MetricTable:
         sub = mb * 2 <= c.histo_rows
         if sub:
             local = np.searchsorted(uniq, rows).astype(np.int32)
-            rows_dev = jnp.asarray(_pad_np(local, b, mb))
-            idx_dev = jnp.asarray(_pad_np(
-                uniq.astype(np.int32), mb, c.histo_rows))
+            rows_dev = _pad_np(local, b, mb)
+            idx_dev = _pad_np(uniq.astype(np.int32), mb,
+                              c.histo_rows)
         else:
-            rows_dev = jnp.asarray(_pad_np(rows, b, c.histo_rows))
+            rows_dev = _pad_np(rows, b, c.histo_rows)
         if with_stats:
             if unit:
-                fn = (tdigest.ingest_ranked_unit_rows if sub
-                      else tdigest.ingest_ranked_unit)
+                fn = _td_step["ingest_ranked_unit_rows" if sub
+                              else "ingest_ranked_unit"]
                 args = (st.histo_means, st.histo_weights,
                         st.histo_stats)
                 args += (idx_dev,) if sub else ()
@@ -2339,32 +2665,32 @@ class MetricTable:
                     *args, rows_dev, rank_dev, vals_dev,
                     slots=slots, compression=c.compression)
             else:
-                fn = (tdigest.ingest_ranked_rows if sub
-                      else tdigest.ingest_ranked)
+                fn = _td_step["ingest_ranked_rows" if sub
+                              else "ingest_ranked"]
                 args = (st.histo_means, st.histo_weights,
                         st.histo_stats)
                 args += (idx_dev,) if sub else ()
                 (st.histo_means, st.histo_weights,
                  st.histo_stats) = fn(
                     *args, rows_dev, rank_dev, vals_dev,
-                    jnp.asarray(_pad_np(wts, b, 0.0)),
+                    _pad_np(wts, b, 0.0),
                     slots=slots, compression=c.compression)
         elif unit:
-            fn = (tdigest.add_samples_ranked_unit_rows if sub
-                  else tdigest.add_samples_ranked_unit)
+            fn = _td_step["add_samples_ranked_unit_rows" if sub
+                          else "add_samples_ranked_unit"]
             args = (st.histo_means, st.histo_weights)
             args += (idx_dev,) if sub else ()
             st.histo_means, st.histo_weights = fn(
                 *args, rows_dev, rank_dev, vals_dev, slots=slots,
                 compression=c.compression)
         else:
-            fn = (tdigest.add_samples_ranked_rows if sub
-                  else tdigest.add_samples_ranked)
+            fn = _td_step["add_samples_ranked_rows" if sub
+                          else "add_samples_ranked"]
             args = (st.histo_means, st.histo_weights)
             args += (idx_dev,) if sub else ()
             st.histo_means, st.histo_weights = fn(
                 *args, rows_dev, rank_dev, vals_dev,
-                jnp.asarray(_pad_np(wts, b, 0.0)),
+                _pad_np(wts, b, 0.0),
                 slots=slots, compression=c.compression)
 
     def _digest_merge_scan(self, st, rows, vals, wts, rank,
@@ -2403,41 +2729,39 @@ class MetricTable:
             plane_v[local, rank] = vals
             plane_w[local, rank] = wts
             if sub:
-                idx_dev = jnp.asarray(_pad_np(
-                    uniq.astype(np.int32), mb, c.histo_rows))
+                idx_dev = _pad_np(uniq.astype(np.int32), mb,
+                                  c.histo_rows)
                 st.histo_means, st.histo_weights = \
-                    tdigest.merge_dense_scan_rows(
+                    _td_step["merge_dense_scan_rows"](
                         st.histo_means, st.histo_weights,
-                        idx_dev, jnp.asarray(plane_v),
-                        jnp.asarray(plane_w), slots=eff,
+                        idx_dev, plane_v, plane_w, slots=eff,
                         n_chunks=nc, compression=c.compression)
             else:
                 st.histo_means, st.histo_weights = \
-                    tdigest.merge_dense_scan(
+                    _td_step["merge_dense_scan"](
                         st.histo_means, st.histo_weights,
-                        jnp.asarray(plane_v), jnp.asarray(plane_w),
-                        slots=eff, n_chunks=nc,
+                        plane_v, plane_w, slots=eff, n_chunks=nc,
                         compression=c.compression)
             return
         # padding rank nc*eff is past every chunk's live window, so
         # padded entries drop without needing a row-id sentinel
-        vals_dev = jnp.asarray(_pad_np(vals, b, 0.0))
-        rank_dev = jnp.asarray(_pad_np(rank, b, nc * eff))
-        wts_dev = jnp.asarray(_pad_np(wts, b, 0.0))
+        vals_dev = _pad_np(vals, b, 0.0)
+        rank_dev = _pad_np(rank, b, nc * eff)
+        wts_dev = _pad_np(wts, b, 0.0)
         if sub:
-            rows_dev = jnp.asarray(_pad_np(local, b, mb))
-            idx_dev = jnp.asarray(_pad_np(
-                uniq.astype(np.int32), mb, c.histo_rows))
+            rows_dev = _pad_np(local, b, mb)
+            idx_dev = _pad_np(uniq.astype(np.int32), mb,
+                              c.histo_rows)
             st.histo_means, st.histo_weights = \
-                tdigest.add_samples_ranked_scan_rows(
+                _td_step["add_samples_ranked_scan_rows"](
                     st.histo_means, st.histo_weights, idx_dev,
                     rows_dev, rank_dev, vals_dev, wts_dev,
                     slots=eff, n_chunks=nc,
                     compression=c.compression)
         else:
-            rows_dev = jnp.asarray(_pad_np(rows, b, c.histo_rows))
+            rows_dev = _pad_np(rows, b, c.histo_rows)
             st.histo_means, st.histo_weights = \
-                tdigest.add_samples_ranked_scan(
+                _td_step["add_samples_ranked_scan"](
                     st.histo_means, st.histo_weights, rows_dev,
                     rank_dev, vals_dev, wts_dev,
                     slots=eff, n_chunks=nc,

@@ -380,6 +380,18 @@ class Telemetry:
             dev["readback_bytes_total"]
         count("veneur.device.readback_bytes_total",
               self._delta("device_readback_bytes"))
+        # dispatch count and host->device transfer volume: the pair
+        # the superbatch apply path exists to collapse — a rising
+        # per-interval dispatch delta under VENEUR_TPU_SUPERBATCH=on
+        # means staged work is falling back per-class
+        self.server.stats["device_dispatches"] = \
+            dev["dispatch_total"]
+        count("veneur.device.dispatches_total",
+              self._delta("device_dispatches"))
+        self.server.stats["device_h2d_bytes"] = \
+            dev["h2d_bytes_total"]
+        count("veneur.device.h2d_bytes_total",
+              self._delta("device_h2d_bytes"))
         # adaptive sketch tiers (core/tiers.py): per-class/per-tier
         # sketch memory as gauges and the boundary's cumulative
         # movement counters as deltas.  Absent entirely when the

@@ -159,6 +159,10 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.vtpu_hll_plane.restype = None
     lib.vtpu_hll_plane.argtypes = [
         i32p, i32p, i64, ctypes.c_int32, ctypes.c_int32, u8p]
+    lib.vtpu_sb_gather_i32.restype = None
+    lib.vtpu_sb_gather_i32.argtypes = [
+        ctypes.POINTER(i32p), i64p, ctypes.c_int32, i32p, i64,
+        ctypes.c_int32]
     lib.vtpu_hll_plane_stats.restype = None
     lib.vtpu_hll_plane_stats.argtypes = [
         i32p, i32p, i64, ctypes.c_int32, ctypes.c_int32, u8p, f64p,

@@ -1490,6 +1490,27 @@ void vtpu_hll_plane(const int32_t* rows, const int32_t* packed,
   }
 }
 
+// Superbatch segment gather: concatenate k staged part arrays
+// directly into one int32 buffer segment and sentinel-fill the
+// bucket-padded tail.  The parse path stages one packed-position
+// part per ingested batch, so a reader-sharded interval carries
+// hundreds of parts; emitting them straight into the superbatch
+// segment replaces a numpy concatenate + pad copy pair per class.
+void vtpu_sb_gather_i32(const int32_t* const* parts,
+                        const int64_t* lens, int32_t k,
+                        int32_t* dst, int64_t cap, int32_t fill) {
+  int64_t o = 0;
+  for (int32_t i = 0; i < k; i++) {
+    int64_t len = lens[i];
+    if (len > cap - o) len = cap - o;
+    if (len > 0) {
+      std::memcpy(dst + o, parts[i], (size_t)len * sizeof(int32_t));
+      o += len;
+    }
+  }
+  for (; o < cap; o++) dst[o] = fill;
+}
+
 // vtpu_hll_plane plus incremental per-row LogLog-Beta sufficient
 // statistics: ez[r] counts zero registers, inv_sum[r] tracks
 // sum_j 2^-reg_j.  Maintaining them at fold time makes the flush

@@ -25,6 +25,8 @@ import os
 import threading
 import time
 
+import numpy as np
+
 _COST_ANALYSIS = os.environ.get(
     "VENEUR_TPU_COST_ANALYSIS", "1").lower() not in ("0", "false",
                                                      "off")
@@ -35,7 +37,7 @@ class _Entry:
     registry lock)."""
 
     __slots__ = ("calls", "compiles", "compile_ns", "call_ns",
-                 "flops", "bytes_accessed")
+                 "flops", "bytes_accessed", "h2d_bytes")
 
     def __init__(self):
         self.calls = 0
@@ -46,13 +48,19 @@ class _Entry:
         # newest shape bucket is the one the current interval runs)
         self.flops = 0.0
         self.bytes_accessed = 0.0
+        # host->device transfer volume: bytes of HOST (numpy)
+        # operands handed to the jit, which device_puts them at
+        # dispatch.  Already-device-resident args cost nothing and
+        # count nothing, so call sites pass staging arrays raw.
+        self.h2d_bytes = 0
 
     def snapshot(self) -> dict:
         return {"calls": self.calls, "compiles": self.compiles,
                 "compile_duration_ns": self.compile_ns,
                 "dispatch_duration_ns": self.call_ns,
                 "est_flops_per_call": self.flops,
-                "est_bytes_accessed_per_call": self.bytes_accessed}
+                "est_bytes_accessed_per_call": self.bytes_accessed,
+                "h2d_bytes": self.h2d_bytes}
 
 
 class InstrumentedJit:
@@ -101,7 +109,9 @@ class InstrumentedJit:
         cost = None
         if compiled and _COST_ANALYSIS:
             cost = self._cost(args, kwargs)
-        self._registry._record(self.name, dt, compiled, cost)
+        h2d = sum(a.nbytes for a in args
+                  if isinstance(a, np.ndarray))
+        self._registry._record(self.name, dt, compiled, cost, h2d)
         return out
 
     def _cost(self, args, kwargs) -> dict | None:
@@ -162,11 +172,12 @@ class DeviceCostRegistry:
         return InstrumentedJit(name, fn, self)
 
     def _record(self, name: str, dt_ns: int, compiled: bool,
-                cost: dict | None) -> None:
+                cost: dict | None, h2d_bytes: int = 0) -> None:
         with self._lock:
             e = self._entries.setdefault(name, _Entry())
             e.calls += 1
             e.call_ns += dt_ns
+            e.h2d_bytes += int(h2d_bytes)
             if compiled:
                 e.compiles += 1
                 e.compile_ns += dt_ns
@@ -210,8 +221,12 @@ class DeviceCostRegistry:
                                      for e in self._entries.values()),
                 "compile_duration_ns": sum(
                     e.compile_ns for e in self._entries.values()),
+                "dispatch_total": sum(
+                    e.calls for e in self._entries.values()),
                 "dispatch_duration_ns": sum(
                     e.call_ns for e in self._entries.values()),
+                "h2d_bytes_total": sum(
+                    e.h2d_bytes for e in self._entries.values()),
                 "readback_bytes_total": self._readback_bytes,
                 "compile_cache_hits": self._cache_hits,
                 "compile_cache_misses": self._cache_misses,
@@ -225,6 +240,10 @@ class DeviceCostRegistry:
                             for name, e in self._entries.items()},
                 "readers": {name: r.snapshot()
                             for name, r in self._readers.items()},
+                "dispatch_total": sum(
+                    e.calls for e in self._entries.values()),
+                "h2d_bytes_total": sum(
+                    e.h2d_bytes for e in self._entries.values()),
                 "readback_bytes_total": self._readback_bytes,
                 "compile_cache_hits": self._cache_hits,
                 "compile_cache_misses": self._cache_misses,
